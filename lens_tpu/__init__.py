@@ -1,0 +1,30 @@
+"""lens_tpu — a TPU-native agent-based cell-colony simulation framework.
+
+A ground-up rebuild of the capabilities of CovertLab/Lens (multiscale,
+agent-based cell simulation: per-cell composites of biochemical Process
+modules coupled through a shared 2D diffusion lattice), re-architected for
+TPU execution. The design (some layers land incrementally — see git log
+for what exists at any given commit):
+
+- the whole colony is ONE JAX/XLA SPMD program: homogeneous agent state is
+  stacked into a single device pytree and ``vmap``-ed across the agent axis
+  (where the reference runs one OS process per cell: ``lens/actor/inner.py``,
+  reconstructed — see SURVEY.md header for mount caveat);
+- inter-agent "messages" (the reference's Kafka exchange windows,
+  ``lens/actor/outer.py``) are pure index/gather ops in HBM;
+- the environment's diffusion lattice (``lens/environment/lattice.py``) is a
+  Pallas stencil kernel co-resident with agent state;
+- scaling across chips uses ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives over ICI/DCN instead of a message broker.
+
+The load-bearing API kept from the reference is the Process plugin contract:
+``next_update(timestep, states) -> update`` against named state stores, with
+declarative updater/divider semantics, composed by topology wiring.
+"""
+
+__version__ = "0.1.0"
+
+from lens_tpu.core.process import Process
+from lens_tpu.core.engine import Compartment
+
+__all__ = ["Process", "Compartment", "__version__"]

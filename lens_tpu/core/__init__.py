@@ -1,0 +1,5 @@
+from lens_tpu.core.process import Process
+from lens_tpu.core.engine import Compartment
+from lens_tpu.core.state import apply_update, UPDATERS, DIVIDERS
+
+__all__ = ["Process", "Compartment", "apply_update", "UPDATERS", "DIVIDERS"]

@@ -1,0 +1,223 @@
+"""The compartment engine: compose Processes into one pure, jittable step.
+
+The reference's inner loop (reconstructed: ``Compartment.update`` in
+``lens/actor/process.py``; hot path in SURVEY.md §3.2) is::
+
+    for process in processes: update = process.next_update(dt, states)
+    for store: state.apply_update(...)
+
+The rebuild keeps those semantics exactly — every mechanistic process sees
+the state as of the start of the step; updates merge afterwards via each
+variable's declared updater; derivers then run in order against the merged
+state — but packages the whole thing as a **pure function**
+``step(state, dt) -> state`` that is jittable, vmappable across an agent
+axis, and scannable over inner timesteps. That single design move replaces
+the reference's per-cell OS processes and Kafka exchange windows with one
+SPMD program (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.core.process import Deriver, Process, is_schema_leaf
+from lens_tpu.core.state import apply_update, divide_state
+from lens_tpu.core.topology import Path, TopologySpec, normalize_topology
+from lens_tpu.utils.dicts import deep_merge, flatten_paths, get_path, set_path
+
+
+class Compartment:
+    """A wired set of Processes sharing a state tree.
+
+    Parameters
+    ----------
+    processes:
+        Ordered mapping name -> Process instance. Instances of ``Deriver``
+        run after the mechanistic merge, in order.
+    topology:
+        Mapping process name -> {port: store path}. See ``core.topology``.
+
+    The constructor builds, from the declared schemas alone:
+
+    - ``initial_state()``: the nested-dict pytree of jnp defaults,
+    - ``updaters`` / ``dividers`` / ``emit_paths``: per-path merge,
+      division, and emission metadata.
+
+    ``step`` and ``run`` are pure functions of the state pytree.
+    """
+
+    def __init__(self, processes: Mapping[str, Process], topology: TopologySpec):
+        self.processes: Dict[str, Process] = dict(processes)
+        self.topology = normalize_topology(topology)
+        missing = set(self.processes) - set(self.topology)
+        if missing:
+            raise ValueError(f"processes missing from topology: {sorted(missing)}")
+
+        self.mechanistic = {
+            name: p for name, p in self.processes.items() if not isinstance(p, Deriver)
+        }
+        self.derivers = {
+            name: p for name, p in self.processes.items() if isinstance(p, Deriver)
+        }
+
+        self.updaters: Dict[Path, str] = {}
+        self.dividers: Dict[Path, str] = {}
+        self.emit_paths: List[Path] = []
+        self._defaults: dict = {}
+        self._build_schema()
+
+    # -- schema assembly -----------------------------------------------------
+
+    def _resolve(self, name: str, port: str) -> Path:
+        ports = self.topology[name]
+        if port not in ports:
+            raise ValueError(f"process {name!r} port {port!r} missing from topology")
+        return ports[port]
+
+    def _build_schema(self) -> None:
+        for name, process in self.processes.items():
+            for port, variables in process.ports_schema().items():
+                base = self._resolve(name, port)
+                for var, leaf in variables.items():
+                    if not is_schema_leaf(leaf):
+                        raise ValueError(
+                            f"{name}.{port}.{var}: schema leaf needs '_default'"
+                        )
+                    path = base + (var,)
+                    default = jnp.asarray(leaf["_default"])
+                    if path in self.updaters:
+                        # Shared variable: declarations must agree — silent
+                        # first-wins hides wiring bugs.
+                        prev_default = get_path(self._defaults, path)
+                        conflicts = []
+                        if leaf.get("_updater", self.updaters[path]) != self.updaters[path]:
+                            conflicts.append("_updater")
+                        if leaf.get("_divider", self.dividers[path]) != self.dividers[path]:
+                            conflicts.append("_divider")
+                        if leaf.get("_emit", path in self.emit_paths) != (
+                            path in self.emit_paths
+                        ):
+                            conflicts.append("_emit")
+                        if not np.array_equal(
+                            np.asarray(prev_default), np.asarray(default)
+                        ):
+                            conflicts.append("_default")
+                        if conflicts:
+                            raise ValueError(
+                                f"{name}.{port}.{var}: conflicting declarations "
+                                f"for shared path {path}: {conflicts}"
+                            )
+                        continue
+                    self.updaters[path] = leaf.get("_updater", "accumulate")
+                    self.dividers[path] = leaf.get("_divider", "split")
+                    if leaf.get("_emit", True):
+                        self.emit_paths.append(path)
+                    self._defaults = set_path(self._defaults, path, default)
+
+    def initial_state(self, overrides: Mapping | None = None) -> dict:
+        state = jax.tree.map(lambda x: x, self._defaults)  # deep copy of dicts
+        if overrides:
+            known = set(self.updaters)
+            for path, _ in flatten_paths(overrides):
+                if path not in known:
+                    raise KeyError(
+                        f"initial_state override {path} does not match any "
+                        f"schema variable (typo?)"
+                    )
+            state = deep_merge(state, overrides)
+        return jax.tree.map(jnp.asarray, state)
+
+    # -- views ---------------------------------------------------------------
+
+    def _port_view(self, state: dict, name: str) -> Dict[str, Dict[str, Any]]:
+        view: Dict[str, Dict[str, Any]] = {}
+        for port, variables in self.processes[name].ports_schema().items():
+            base = self._resolve(name, port)
+            store = get_path(state, base)
+            view[port] = {var: store[var] for var in variables}
+        return view
+
+    def _absolute_update(self, name: str, update: Mapping) -> dict:
+        """Re-root a port-structured update at its topology paths."""
+        tree: dict = {}
+        for port, variables in update.items():
+            base = self._resolve(name, port)
+            for var, delta in variables.items():
+                tree = set_path(tree, base + (var,), delta)
+        return tree
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, state: dict, timestep) -> dict:
+        """One engine step: all mechanistic updates off the pre-step state,
+        merged in declaration order; then derivers in order."""
+        updates = []
+        for name in self.mechanistic:
+            view = self._port_view(state, name)
+            updates.append(
+                self._absolute_update(name, self.processes[name].next_update(timestep, view))
+            )
+        for update in updates:
+            state = apply_update(state, update, self.updaters)
+        for name in self.derivers:
+            view = self._port_view(state, name)
+            update = self._absolute_update(
+                name, self.processes[name].next_update(timestep, view)
+            )
+            state = apply_update(state, update, self.updaters)
+        return state
+
+    def run(
+        self,
+        state: dict,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+    ) -> Tuple[dict, dict]:
+        """Advance ``total_time`` in increments of ``timestep`` via ``lax.scan``.
+
+        Returns ``(final_state, trajectory)`` where ``trajectory`` stacks the
+        emitted state every ``emit_every`` steps along a leading time axis.
+        The scan is the jit/compile unit — one trace regardless of step
+        count (SURVEY.md §7 step 2: "jit the whole exchange window").
+        """
+        n_steps = int(round(total_time / timestep))
+        if abs(n_steps * timestep - total_time) > 1e-6 * max(abs(total_time), 1.0):
+            raise ValueError(
+                f"total_time={total_time} is not an integer multiple of "
+                f"timestep={timestep} (would silently simulate "
+                f"{n_steps * timestep})"
+            )
+        if n_steps % emit_every != 0:
+            raise ValueError("total steps must be a multiple of emit_every")
+
+        def body(carry, _):
+            def inner(c, _):
+                return self.step(c, timestep), None
+
+            carry, _ = jax.lax.scan(inner, carry, None, length=emit_every)
+            return carry, self.emit(carry)
+
+        state, trajectory = jax.lax.scan(
+            body, state, None, length=n_steps // emit_every
+        )
+        return state, trajectory
+
+    # -- emission / division -------------------------------------------------
+
+    def emit(self, state: dict) -> dict:
+        """The emittable slice of the state tree (paths with ``_emit``)."""
+        out: dict = {}
+        for path in self.emit_paths:
+            out = set_path(out, path, get_path(state, path))
+        return out
+
+    def divide(self, state: dict, key: jax.Array) -> Tuple[dict, dict]:
+        """Split a single agent's state into two daughters per the declared
+        dividers (the rebuild's analogue of the reference's division
+        handshake, SURVEY.md §3.3)."""
+        return divide_state(state, key, self.dividers)

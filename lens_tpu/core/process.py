@@ -1,0 +1,105 @@
+"""The Process plugin contract — the load-bearing abstraction of the framework.
+
+The reference defines a ``Process`` base class whose subclasses declare named
+ports ("roles") and implement ``next_update(timestep, states) -> update``
+returning a delta-update dict (reconstructed: ``lens/actor/process.py``,
+corroborated by BASELINE.json's north star; SURVEY.md §1 L2/L2.5). The
+rebuild keeps this contract exactly, with two TPU-first strengthenings:
+
+1. ``next_update`` MUST be a pure, traceable function of ``(timestep,
+   states)`` — no Python side effects, no data-dependent Python control
+   flow. This is what lets the engine ``jit`` a whole exchange window and
+   ``vmap`` it across 100k agents.
+2. The port schema is declarative: every variable declares its default
+   value, updater (merge rule) and divider (division rule), so the engine
+   can build the stacked state tree and merge machinery without running
+   any process code.
+
+Schema leaf descriptors are dicts with keys:
+``_default`` (scalar/array), ``_updater`` (see core.state.UPDATERS),
+``_divider`` (see core.state.DIVIDERS), ``_emit`` (bool — include in
+emitter output).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+
+from lens_tpu.utils.dicts import deep_merge
+
+SchemaLeaf = Dict[str, Any]
+PortsSchema = Dict[str, Dict[str, SchemaLeaf]]
+
+LEAF_KEYS = frozenset({"_default", "_updater", "_divider", "_emit"})
+
+
+def is_schema_leaf(node: Any) -> bool:
+    return isinstance(node, Mapping) and "_default" in node
+
+
+class Process:
+    """Base class for all biochemical/mechanistic process modules.
+
+    Subclasses override:
+
+    - ``defaults``: class-level dict of parameters.
+    - ``ports_schema()``: declare ports -> variables -> schema leaves.
+    - ``next_update(timestep, states)``: pure function from the port-view of
+      the state to an update dict with the same port/variable structure.
+
+    Parameters are resolved at construction (``defaults`` <- ``config``) and
+    must be treated as static: arrays/floats baked into the traced
+    computation.
+    """
+
+    defaults: Dict[str, Any] = {}
+    name: str = "process"
+
+    def __init__(self, config: Mapping | None = None):
+        self.config = deep_merge(self.defaults, config)
+
+    # -- declarative surface -------------------------------------------------
+
+    def ports_schema(self) -> PortsSchema:
+        raise NotImplementedError
+
+    # -- dynamics ------------------------------------------------------------
+
+    def next_update(self, timestep, states: Mapping) -> Dict[str, Dict[str, Any]]:
+        """Compute this process's contribution for one timestep.
+
+        ``states`` maps port name -> {variable: value} (a read-only view the
+        engine assembled through the topology). The return value mirrors
+        that structure; each leaf is merged by the variable's declared
+        updater. Must be pure and jnp-traceable.
+        """
+        raise NotImplementedError
+
+    # -- convenience ---------------------------------------------------------
+
+    def initial_state(self) -> Dict[str, Dict[str, Any]]:
+        """Port-structured defaults (as jnp arrays) from the schema."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for port, variables in self.ports_schema().items():
+            out[port] = {
+                var: jnp.asarray(leaf["_default"]) for var, leaf in variables.items()
+            }
+        return out
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class Deriver(Process):
+    """A Process that computes derived/bookkeeping state (not mechanistic).
+
+    The reference runs derivers after each engine step to keep quantities
+    like volume-from-mass and concentrations-from-counts consistent
+    (reconstructed: ``lens/processes/derive_*.py``, SURVEY.md §2). Derivers
+    use ``_updater: set`` leaves and run after all mechanistic updates are
+    merged, in registration order.
+    """
+
+    name = "deriver"

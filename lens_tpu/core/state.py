@@ -1,0 +1,175 @@
+"""State stores: declarative update-merge and division semantics.
+
+The reference's ``State`` objects accumulate per-process delta updates and
+apply them between engine steps (reconstructed: ``State.apply_update`` in
+``lens/actor/process.py``, SURVEY.md §2 — mount empty, see SURVEY header).
+That merge semantics is the subtlest part of the contract surface
+(SURVEY.md §7 "hard parts"), so the rebuild makes it fully declarative:
+
+- every state variable carries an **updater** name (how a process delta is
+  merged into the current value), and
+- a **divider** name (how the value splits between two daughter cells).
+
+Everything here is pure ``jnp`` on array leaves, so updaters run inside
+``jit``/``vmap``/``scan`` with no Python branching on data.
+
+Updaters
+--------
+``accumulate``              value + delta               (the reference default)
+``nonnegative_accumulate``  max(value + delta, 0)
+``set``                     delta (overwrite)
+``null``                    value (ignore delta)
+
+Dividers
+--------
+``split``     each daughter gets value / 2   (counts, mass, volume)
+``copy``      each daughter gets value       (concentrations, parameters)
+``zero``      daughters restart from 0       (clocks, accumulated flux)
+``binomial``  stochastic integer split: daughter A ~ Binomial(n, 0.5)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.utils.dicts import Path, flatten_paths, set_path
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# Updaters: (current_value, delta) -> new_value
+# ---------------------------------------------------------------------------
+
+
+def _accumulate(value: Array, delta: Array) -> Array:
+    return value + delta
+
+
+def _nonnegative_accumulate(value: Array, delta: Array) -> Array:
+    return jnp.maximum(value + delta, 0.0)
+
+
+def _set(value: Array, delta: Array) -> Array:
+    del value
+    return delta
+
+
+def _null(value: Array, delta: Array) -> Array:
+    del delta
+    return value
+
+
+UPDATERS: Dict[str, Callable[[Array, Array], Array]] = {
+    "accumulate": _accumulate,
+    "nonnegative_accumulate": _nonnegative_accumulate,
+    "set": _set,
+    "null": _null,
+}
+
+# ---------------------------------------------------------------------------
+# Dividers: (value, key) -> (daughter_a, daughter_b)
+# ---------------------------------------------------------------------------
+
+
+def _div_split(value: Array, key: Array) -> Tuple[Array, Array]:
+    del key
+    half = value / 2
+    return half, half
+
+
+def _div_copy(value: Array, key: Array) -> Tuple[Array, Array]:
+    del key
+    return value, value
+
+
+def _div_zero(value: Array, key: Array) -> Tuple[Array, Array]:
+    del key
+    z = jnp.zeros_like(value)
+    return z, z
+
+
+def _div_binomial(value: Array, key: Array) -> Tuple[Array, Array]:
+    # Integer-valued molecule counts partition binomially between daughters.
+    # Normal approximation keeps the draw O(1) and fixed-shape; exact for the
+    # large counts it is meant for, clipped into [0, n] for small ones.
+    n = jnp.asarray(value, jnp.float32)
+    mean = n / 2.0
+    std = jnp.sqrt(jnp.maximum(n, 0.0)) / 2.0
+    draw = mean + std * jax.random.normal(key, jnp.shape(value))
+    a = jnp.clip(jnp.round(draw), 0.0, jnp.maximum(n, 0.0))
+    return a.astype(value.dtype), (n - a).astype(value.dtype)
+
+
+DIVIDERS: Dict[str, Callable[[Array, Array], Tuple[Array, Array]]] = {
+    "split": _div_split,
+    "copy": _div_copy,
+    "zero": _div_zero,
+    "binomial": _div_binomial,
+}
+
+# ---------------------------------------------------------------------------
+# Schema-driven application
+# ---------------------------------------------------------------------------
+
+
+def apply_update(
+    state: dict,
+    update: Mapping,
+    updaters: Mapping[Path, str] | None = None,
+) -> dict:
+    """Merge one update tree into a state tree.
+
+    ``update`` mirrors a sub-structure of ``state``; each leaf is merged via
+    the updater registered for its path (default ``accumulate``, matching
+    the reference's delta-update convention).
+    """
+    updaters = updaters or {}
+
+    def merge(path: Path, node: Any, upd: Any) -> Any:
+        if isinstance(upd, Mapping):
+            if not isinstance(node, Mapping):
+                raise TypeError(
+                    f"update has a dict at {path} but state has a leaf there"
+                )
+            out = dict(node)
+            for key, sub in upd.items():
+                if key not in node:
+                    raise KeyError(f"update path {path + (key,)} not in state")
+                out[key] = merge(path + (key,), node[key], sub)
+            return out
+        if isinstance(node, Mapping):
+            raise TypeError(
+                f"update has a leaf at {path} but state has a dict there"
+            )
+        fn = UPDATERS[updaters.get(path, "accumulate")]
+        return fn(node, upd)
+
+    return merge((), state, update)
+
+
+def divide_state(
+    state: dict,
+    key: Array,
+    dividers: Mapping[Path, str] | None = None,
+) -> Tuple[dict, dict]:
+    """Split one agent's state tree into two daughter trees.
+
+    The reference serializes daughter state dicts through the division
+    handshake (reconstructed: ``Inner.divide``, SURVEY.md §3.3); here the
+    split is a pure function usable inside ``jit`` — the colony layer turns
+    it into "write two rows of the stacked state".
+    """
+    dividers = dividers or {}
+    leaves = list(flatten_paths(state))
+    keys = jax.random.split(key, max(len(leaves), 1))
+    out_a: dict = state
+    out_b: dict = state
+    for (path, value), k in zip(leaves, keys):
+        fn = DIVIDERS[dividers.get(path, "split")]
+        a, b = fn(value, k)
+        out_a = set_path(out_a, path, a)
+        out_b = set_path(out_b, path, b)
+    return out_a, out_b

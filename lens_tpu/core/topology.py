@@ -1,0 +1,38 @@
+"""Topology wiring: mapping process ports onto shared state stores.
+
+The reference wires each process's ports ("roles") to named state stores via
+a topology dict on the compartment (reconstructed: ``Compartment`` in
+``lens/actor/process.py``, SURVEY.md §1 L2.5). The rebuild keeps the same
+dict-of-dicts surface::
+
+    topology = {
+        "transport": {"internal": ("cell",), "external": ("boundary", "external")},
+        "growth":    {"global": ("global",)},
+    }
+
+Paths are tuples of store names (a bare string is promoted to a 1-tuple).
+The engine resolves ``port + variable`` to an absolute path in the state
+pytree; variables from different processes wired to the same path share
+state — that IS the inter-process communication mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Sequence, Tuple, Union
+
+Path = Tuple[str, ...]
+TopologySpec = Mapping[str, Mapping[str, Union[str, Sequence[str]]]]
+
+
+def normalize_path(path: Union[str, Sequence[str]]) -> Path:
+    if isinstance(path, str):
+        return (path,)
+    return tuple(path)
+
+
+def normalize_topology(topology: TopologySpec) -> Dict[str, Dict[str, Path]]:
+    """Canonicalize a topology spec to {process: {port: path tuple}}."""
+    return {
+        process: {port: normalize_path(path) for port, path in ports.items()}
+        for process, ports in topology.items()
+    }

@@ -1,0 +1,3 @@
+from lens_tpu.ops.integrate import odeint_window, rk4_step, heun_step, euler_step
+
+__all__ = ["odeint_window", "rk4_step", "heun_step", "euler_step"]

@@ -1,0 +1,26 @@
+"""Process library + registry.
+
+The reference maps agent-type names to constructors in its boot layer
+(reconstructed: ``lens/actor/boot.py``, SURVEY.md §1 L5). The rebuild keeps
+a simple name -> class registry so experiment configs can be pure data.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from lens_tpu.core.process import Process
+
+process_registry: Dict[str, Type[Process]] = {}
+
+
+def register(cls: Type[Process]) -> Type[Process]:
+    process_registry[cls.name] = cls
+    return cls
+
+
+# Import for registration side effects.
+from lens_tpu.processes.glucose_pts import GlucosePTS  # noqa: E402
+from lens_tpu.processes.toggle_switch import ToggleSwitch  # noqa: E402
+
+__all__ = ["process_registry", "register", "GlucosePTS", "ToggleSwitch"]

@@ -1,0 +1,96 @@
+"""Glucose uptake (PTS-style) — the 2-species ODE transport Process.
+
+Benchmark config 0 (BASELINE.json): "Single E. coli agent, 2-species
+glucose-uptake ODE Process, 100 sim-sec". The reference's kinetic transport
+process integrates an uptake ODE with ``scipy.odeint`` inside
+``next_update`` (reconstructed: ``lens/processes/*transport*.py``,
+SURVEY.md §2); here the window is integrated with the framework's
+scan-based RK4 (``ops.integrate.odeint_window``).
+
+Model: Michaelis–Menten uptake of external glucose into an internal pool
+that is consumed first-order (feeding growth downstream)::
+
+    uptake  = vmax * G_ext / (km + G_ext)          [mM/s]
+    dG_ext/dt = -uptake * density                  (environment drawdown)
+    dG_int/dt = +uptake - k_consume * G_int
+
+The accumulated external drawdown is also reported on an ``exchange`` port
+so the lattice layer can apply it to the cell's local field bin
+(SURVEY.md §3.2 exchange semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.ops.integrate import odeint_window
+from lens_tpu.processes import register
+
+
+@register
+class GlucosePTS(Process):
+    name = "glucose_pts"
+
+    defaults = {
+        "vmax": 1.5,        # mM/s max uptake rate
+        "km": 0.2,          # mM half-saturation
+        "k_consume": 0.1,   # 1/s internal consumption
+        "density": 0.01,    # env drawdown per unit uptake (cell/env volume ratio)
+        "substeps": 10,     # RK4 substeps per process window (static)
+        "method": "rk4",
+    }
+
+    def ports_schema(self):
+        return {
+            "internal": {
+                "glucose_internal": {
+                    "_default": 0.0,
+                    "_updater": "nonnegative_accumulate",
+                    "_divider": "split",
+                },
+            },
+            "external": {
+                "glucose_external": {
+                    "_default": 10.0,
+                    "_updater": "nonnegative_accumulate",
+                    "_divider": "copy",   # a concentration, not an amount
+                },
+            },
+            "exchange": {
+                # net uptake this window, in concentration units; consumed
+                # (zeroed) by the lattice exchange step.
+                "glucose_flux": {
+                    "_default": 0.0,
+                    "_updater": "accumulate",
+                    "_divider": "zero",
+                },
+            },
+        }
+
+    def _rhs(self, t, y, args):
+        g_ext, g_int = y
+        c = self.config
+        uptake = c["vmax"] * g_ext / (c["km"] + g_ext)
+        return (
+            -uptake * c["density"],
+            uptake - c["k_consume"] * g_int,
+        )
+
+    def next_update(self, timestep, states):
+        g_ext0 = states["external"]["glucose_external"]
+        g_int0 = states["internal"]["glucose_internal"]
+        n = max(int(self.config["substeps"]), 1)
+        g_ext, g_int = odeint_window(
+            self._rhs,
+            (g_ext0, g_int0),
+            0.0,
+            jnp.float32(timestep) / n,
+            n,
+            method=self.config["method"],
+        )
+        return {
+            "internal": {"glucose_internal": g_int - g_int0},
+            "external": {"glucose_external": g_ext - g_ext0},
+            "exchange": {"glucose_flux": g_ext0 - g_ext},
+        }

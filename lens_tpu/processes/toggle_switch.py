@@ -1,0 +1,78 @@
+"""Genetic toggle switch — the 4-species ODE expression Process.
+
+Benchmark config 1 (BASELINE.json): "1k-agent colony, 4-species
+toggle-switch ODE per agent, no lattice". A Gardner–Cantor–Collins (2000)
+mutual-repression switch with explicit mRNA and protein for each arm::
+
+    dmU/dt = a / (1 + (PV/k)^n) - dm * mU
+    dPU/dt = kt * mU - dp * PU
+    dmV/dt = a / (1 + (PU/k)^n) - dm * mV
+    dPV/dt = kt * mV - dp * PV
+
+This is the colony-scale vmap workhorse: no environment coupling, so it
+isolates agent-axis stacking/scaling (SURVEY.md §7 step 4). Fills the
+reference's gene-expression process slot (reconstructed:
+``lens/processes/`` expression modules, SURVEY.md §2) with TPU-friendly
+pure-jnp kinetics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.ops.integrate import odeint_window
+from lens_tpu.processes import register
+
+
+@register
+class ToggleSwitch(Process):
+    name = "toggle_switch"
+
+    defaults = {
+        "alpha": 2.0,     # max transcription rate
+        "k": 1.0,         # repression threshold
+        "n_hill": 2.0,    # Hill coefficient
+        "d_m": 1.0,       # mRNA degradation 1/s
+        "k_t": 1.0,       # translation rate 1/s
+        "d_p": 0.5,       # protein degradation 1/s
+        "substeps": 10,
+        "method": "rk4",
+    }
+
+    def ports_schema(self):
+        leaf = lambda default: {
+            "_default": default,
+            "_updater": "nonnegative_accumulate",
+            "_divider": "split",
+        }
+        return {
+            "internal": {
+                "mrna_u": leaf(0.5),
+                "protein_u": leaf(2.0),
+                "mrna_v": leaf(0.1),
+                "protein_v": leaf(0.1),
+            },
+        }
+
+    def _rhs(self, t, y, args):
+        m_u, p_u, m_v, p_v = y
+        c = self.config
+        hill = lambda p: c["alpha"] / (1.0 + (p / c["k"]) ** c["n_hill"])
+        return (
+            hill(p_v) - c["d_m"] * m_u,
+            c["k_t"] * m_u - c["d_p"] * p_u,
+            hill(p_u) - c["d_m"] * m_v,
+            c["k_t"] * m_v - c["d_p"] * p_v,
+        )
+
+    def next_update(self, timestep, states):
+        s = states["internal"]
+        y0 = (s["mrna_u"], s["protein_u"], s["mrna_v"], s["protein_v"])
+        n = max(int(self.config["substeps"]), 1)
+        y = odeint_window(
+            self._rhs, y0, 0.0, jnp.float32(timestep) / n, n,
+            method=self.config["method"],
+        )
+        names = ("mrna_u", "protein_u", "mrna_v", "protein_v")
+        return {"internal": {k: yf - y0_ for k, yf, y0_ in zip(names, y, y0)}}

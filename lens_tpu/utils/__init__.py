@@ -1,0 +1,3 @@
+from lens_tpu.utils.dicts import deep_merge, get_path, set_path, flatten_paths
+
+__all__ = ["deep_merge", "get_path", "set_path", "flatten_paths"]

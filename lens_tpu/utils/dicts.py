@@ -1,0 +1,77 @@
+"""Nested-dict helpers.
+
+The reference keeps all simulation state and configuration in nested dicts
+merged through boot functions (reconstructed: ``lens/utils/dict_utils.py``,
+SURVEY.md §2). The rebuild keeps the same deep-merge semantics because the
+state tree IS a JAX pytree of nested dicts: these helpers are the only
+"schema language" the engine needs.
+
+All functions are pure and operate on plain dicts, so they are safe to call
+at trace time inside ``jit`` (the dict structure is static; only leaves are
+traced arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Mapping, Sequence, Tuple
+
+Path = Tuple[str, ...]
+
+
+def deep_merge(base: dict, override: Mapping | None) -> dict:
+    """Recursively merge ``override`` into a copy of ``base``.
+
+    Dicts merge key-wise; any non-dict leaf in ``override`` replaces the
+    corresponding value in ``base``. Mirrors the reference's config-merge
+    behavior (agent type defaults <- experiment overrides).
+    """
+    if override is None:
+        return dict(base)
+    out = dict(base)
+    for key, value in override.items():
+        if key in out and isinstance(out[key], dict) and isinstance(value, Mapping):
+            out[key] = deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def get_path(tree: Mapping, path: Sequence[str]) -> Any:
+    """Fetch the value at a nested ``path`` (tuple of keys) in ``tree``."""
+    node: Any = tree
+    for key in path:
+        node = node[key]
+    return node
+
+
+def set_path(tree: dict, path: Sequence[str], value: Any) -> dict:
+    """Return a copy of ``tree`` with ``value`` stored at nested ``path``.
+
+    Copy-on-write along the path only — siblings are shared, which keeps
+    this cheap at trace time and referentially transparent for JAX.
+    """
+    if not path:
+        if not isinstance(value, Mapping):
+            raise ValueError("cannot replace the root with a non-mapping")
+        return dict(value)
+    out = dict(tree)
+    node = out
+    for key in path[:-1]:
+        child = node.get(key, {})
+        if not isinstance(child, Mapping):
+            raise KeyError(f"path {tuple(path)} crosses non-dict node at {key!r}")
+        child = dict(child)
+        node[key] = child
+        node = child
+    node[path[-1]] = value
+    return out
+
+
+def flatten_paths(tree: Mapping, prefix: Path = ()) -> Iterator[Tuple[Path, Any]]:
+    """Yield ``(path, leaf)`` for every non-dict leaf in ``tree``."""
+    for key, value in tree.items():
+        path = prefix + (key,)
+        if isinstance(value, Mapping):
+            yield from flatten_paths(value, path)
+        else:
+            yield path, value

@@ -1,0 +1,32 @@
+"""Test configuration: force a pure 8-device virtual CPU mesh.
+
+Two environment problems are handled here, both before any jax backend
+initializes:
+
+1. This box injects an ``axon`` (TPU-tunnel) PJRT hook into every python
+   process (sitecustomize via PYTHONPATH, gated on PALLAS_AXON_POOL_IPS)
+   which forces ``jax_platforms="axon,cpu"``; when the tunnel relay is
+   down, axon backend init blocks the whole suite in a retry loop. The
+   env var ``JAX_PLATFORMS=cpu`` does NOT override the hook, but setting
+   the jax *config* after import does — the plugin stays registered but
+   is never initialized, so nothing dials the relay.
+2. Multi-chip sharding is tested without real chips by exposing 8 virtual
+   host devices (SURVEY.md §4: the TPU-native analogue of "multi-node
+   without a real cluster").
+
+A persistent compilation cache keeps re-runs fast on this 1-core box.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax  # noqa: E402  (import after env setup is the whole point)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,132 @@
+"""ODE processes (configs 0, 1) vs scipy oracles — the correctness anchor.
+
+SURVEY.md §4: numerical parity tests against a small pure-scipy oracle of
+each BASELINE.json config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.integrate import odeint as scipy_odeint
+
+from lens_tpu.core.engine import Compartment
+from lens_tpu.processes.glucose_pts import GlucosePTS
+from lens_tpu.processes.toggle_switch import ToggleSwitch
+
+
+def glucose_compartment(config=None):
+    return Compartment(
+        processes={"transport": GlucosePTS(config)},
+        topology={
+            "transport": {
+                "internal": ("cell",),
+                "external": ("boundary",),
+                "exchange": ("exchange",),
+            }
+        },
+    )
+
+
+def test_config0_single_agent_vs_scipy():
+    """Config 0: single agent, 2-species glucose ODE, 100 sim-sec."""
+    comp = glucose_compartment()
+    state = comp.initial_state()
+    final, traj = comp.run(state, 100.0, 1.0)
+
+    c = GlucosePTS.defaults
+
+    def rhs(y, t):
+        g_ext, g_int = y
+        uptake = c["vmax"] * g_ext / (c["km"] + g_ext)
+        return [-uptake * c["density"], uptake - c["k_consume"] * g_int]
+
+    ref = scipy_odeint(rhs, [10.0, 0.0], np.linspace(0.0, 100.0, 101))[-1]
+    np.testing.assert_allclose(
+        float(final["boundary"]["glucose_external"]), ref[0], rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(final["cell"]["glucose_internal"]), ref[1], rtol=1e-4
+    )
+    # exchange accumulated = total drawdown of external concentration
+    np.testing.assert_allclose(
+        float(final["exchange"]["glucose_flux"]),
+        10.0 - ref[0],
+        rtol=1e-4,
+    )
+    assert traj["cell"]["glucose_internal"].shape == (100,)
+
+
+def test_toggle_switch_bistability():
+    """The switch must latch to the arm favored by initial conditions."""
+    comp = Compartment(
+        processes={"switch": ToggleSwitch()},
+        topology={"switch": {"internal": ("cell",)}},
+    )
+    # U-favored start (defaults) -> protein_u high, protein_v low
+    final_u, _ = comp.run(comp.initial_state(), 50.0, 1.0)
+    assert float(final_u["cell"]["protein_u"]) > 5 * float(
+        final_u["cell"]["protein_v"]
+    )
+    # mirrored start -> latches the other way
+    flipped = comp.initial_state(
+        {"cell": {"mrna_u": 0.1, "protein_u": 0.1, "mrna_v": 0.5, "protein_v": 2.0}}
+    )
+    final_v, _ = comp.run(flipped, 50.0, 1.0)
+    assert float(final_v["cell"]["protein_v"]) > 5 * float(
+        final_v["cell"]["protein_u"]
+    )
+
+
+def test_toggle_switch_vs_scipy():
+    c = ToggleSwitch.defaults
+
+    def rhs(y, t):
+        m_u, p_u, m_v, p_v = y
+        hill = lambda p: c["alpha"] / (1.0 + (p / c["k"]) ** c["n_hill"])
+        return [
+            hill(p_v) - c["d_m"] * m_u,
+            c["k_t"] * m_u - c["d_p"] * p_u,
+            hill(p_u) - c["d_m"] * m_v,
+            c["k_t"] * m_v - c["d_p"] * p_v,
+        ]
+
+    comp = Compartment(
+        processes={"switch": ToggleSwitch()},
+        topology={"switch": {"internal": ("cell",)}},
+    )
+    final, _ = comp.run(comp.initial_state(), 20.0, 1.0)
+    ref = scipy_odeint(rhs, [0.5, 2.0, 0.1, 0.1], np.linspace(0, 20.0, 201))[-1]
+    got = [
+        float(final["cell"][k])
+        for k in ("mrna_u", "protein_u", "mrna_v", "protein_v")
+    ]
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_vmapped_colony_step():
+    """1k-agent toggle-switch colony — one vmapped engine step (config 1 core)."""
+    comp = Compartment(
+        processes={"switch": ToggleSwitch()},
+        topology={"switch": {"internal": ("cell",)}},
+    )
+    n = 1024
+    state = comp.initial_state()
+    key = jax.random.PRNGKey(0)
+    batched = jax.tree.map(
+        lambda x: x
+        * jax.random.uniform(key, (n,), minval=0.5, maxval=1.5).astype(x.dtype),
+        state,
+    )
+    step = jax.jit(jax.vmap(lambda s: comp.step(s, 1.0)))
+    out = step(batched)
+    assert out["cell"]["protein_u"].shape == (n,)
+    assert bool(jnp.all(jnp.isfinite(out["cell"]["protein_u"])))
+
+
+def test_process_registry_populated():
+    """Regression: @register must actually be applied (caught in verify)."""
+    from lens_tpu.processes import process_registry
+
+    assert "glucose_pts" in process_registry
+    assert "toggle_switch" in process_registry
+    assert process_registry["glucose_pts"] is GlucosePTS

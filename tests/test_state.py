@@ -1,0 +1,78 @@
+"""Updater/divider semantics — the subtlest part of the contract surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lens_tpu.core.state import UPDATERS, apply_update, divide_state
+
+
+def test_accumulate_default():
+    state = {"store": {"x": jnp.float32(1.0)}}
+    out = apply_update(state, {"store": {"x": 2.0}})
+    assert float(out["store"]["x"]) == 3.0
+    # original untouched (pure)
+    assert float(state["store"]["x"]) == 1.0
+
+
+def test_set_and_null_updaters():
+    state = {"a": jnp.float32(5.0), "b": jnp.float32(5.0)}
+    out = apply_update(
+        state, {"a": 1.0, "b": 1.0},
+        updaters={("a",): "set", ("b",): "null"},
+    )
+    assert float(out["a"]) == 1.0
+    assert float(out["b"]) == 5.0
+
+
+def test_nonnegative_accumulate_clips():
+    state = {"x": jnp.float32(1.0)}
+    out = apply_update(state, {"x": -10.0}, updaters={("x",): "nonnegative_accumulate"})
+    assert float(out["x"]) == 0.0
+
+
+def test_unknown_path_raises():
+    with pytest.raises(KeyError):
+        apply_update({"a": jnp.float32(0.0)}, {"missing": 1.0})
+
+
+def test_apply_update_under_jit():
+    updaters = {("x",): "accumulate", ("y",): "set"}
+
+    @jax.jit
+    def step(state):
+        return apply_update(state, {"x": 1.0, "y": 9.0}, updaters)
+
+    out = step({"x": jnp.float32(0.0), "y": jnp.float32(0.0)})
+    assert float(out["x"]) == 1.0
+    assert float(out["y"]) == 9.0
+
+
+def test_divide_split_copy_zero():
+    state = {
+        "mass": jnp.float32(2.0),
+        "conc": jnp.float32(7.0),
+        "clock": jnp.float32(3.0),
+    }
+    dividers = {("mass",): "split", ("conc",): "copy", ("clock",): "zero"}
+    a, b = divide_state(state, jax.random.PRNGKey(0), dividers)
+    assert float(a["mass"]) == 1.0 and float(b["mass"]) == 1.0
+    assert float(a["conc"]) == 7.0 and float(b["conc"]) == 7.0
+    assert float(a["clock"]) == 0.0 and float(b["clock"]) == 0.0
+
+
+def test_divide_binomial_conserves_counts():
+    n = jnp.float32(10000.0)
+    a, b = divide_state(
+        {"counts": n}, jax.random.PRNGKey(1), {("counts",): "binomial"}
+    )
+    total = float(a["counts"]) + float(b["counts"])
+    assert total == 10000.0
+    # roughly half each (4 sigma ~ 200)
+    assert abs(float(a["counts"]) - 5000.0) < 250.0
+
+
+def test_updater_registry_complete():
+    for name in ("accumulate", "nonnegative_accumulate", "set", "null"):
+        assert name in UPDATERS
