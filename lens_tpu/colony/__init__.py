@@ -1,0 +1,3 @@
+from lens_tpu.colony.colony import Colony, ColonyState
+
+__all__ = ["Colony", "ColonyState"]

@@ -1,0 +1,262 @@
+"""The colony layer: a whole population of cells as ONE device pytree.
+
+This is the rebuild's replacement for the reference's entire actor runtime.
+Where the reference runs one OS process per cell, spawns daughters through
+a shepherd supervisor, and synchronizes over Kafka (reconstructed:
+``lens/actor/inner.py``, ``shepherd.py``, SURVEY.md §1 L3-L4), the colony
+stacks homogeneous agent state along a leading **agent axis** of fixed
+``capacity`` and:
+
+- steps every agent with one ``vmap`` of the compartment step
+  (BASELINE.json north star: "stacked into a single device pytree and
+  each ODE-style Process.next_update vmap'd across all cells");
+- tracks liveness with an **alive mask** — "agent death" is clearing a
+  bit, never a shape change;
+- implements division as **row activation**: the parent row is
+  overwritten with daughter A, daughter B is scattered into a free row,
+  per the per-variable dividers declared in the schema
+  (SURVEY.md §3.3: the reference's spawn-two-processes handshake
+  "collapses to activate two rows in the alive-mask").
+
+Everything is fixed-shape and branch-free, so the whole colony step —
+biology, division, bookkeeping — jits into a single XLA program that can
+later be sharded over the agent axis with ``shard_map``.
+
+Determinism: dead rows are frozen (their state does not evolve), so a
+colony trajectory is bitwise-reproducible for a fixed seed regardless of
+how many rows are active — the rebuild's answer to the reference's
+exchange-window barrier ordering (SURVEY.md §5 "race detection").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.core.engine import Compartment
+from lens_tpu.core.schedule import scan_schedule
+from lens_tpu.core.state import DIVIDERS
+from lens_tpu.core.topology import Path, normalize_path
+from lens_tpu.utils.dicts import flatten_paths, get_path, set_path
+
+
+class ColonyState(NamedTuple):
+    """The full simulation state of a colony — one pytree, one device.
+
+    agents:  stacked agent state; every leaf has leading dim = capacity.
+    alive:   bool[capacity] — which rows are live cells.
+    key:     PRNG state consumed by division (and stochastic processes).
+    step:    int32 scalar — global step counter (drives emit cadence,
+             deterministic per-step randomness).
+    """
+
+    agents: dict
+    alive: jax.Array
+    key: jax.Array
+    step: jax.Array
+
+
+def _bcast(mask: jax.Array, leaf: jax.Array) -> jax.Array:
+    """Broadcast a [capacity] mask against a [capacity, ...] leaf."""
+    return mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
+
+
+class Colony:
+    """Fixed-capacity population of one compartment type.
+
+    Parameters
+    ----------
+    compartment:
+        The wired ``Compartment`` describing a single agent's biology.
+    capacity:
+        Maximum number of rows (preallocated). Division beyond capacity is
+        deterministically suppressed: the parent simply does not divide
+        that step (and will retry next step while a row is free).
+    division_trigger:
+        Optional path into the agent state tree holding a boolean/0-1
+        variable; rows where it is nonzero (and alive) divide this step.
+        ``None`` disables division entirely.
+    """
+
+    def __init__(
+        self,
+        compartment: Compartment,
+        capacity: int,
+        division_trigger: Optional[Path | str] = None,
+    ):
+        self.compartment = compartment
+        self.capacity = int(capacity)
+        self.division_trigger = (
+            normalize_path(division_trigger) if division_trigger is not None else None
+        )
+        if self.division_trigger is not None and (
+            self.division_trigger not in compartment.updaters
+        ):
+            raise ValueError(
+                f"division_trigger {self.division_trigger} is not a schema "
+                f"variable of the compartment"
+            )
+
+    # -- construction --------------------------------------------------------
+
+    def initial_state(
+        self,
+        n_alive: int,
+        overrides: Mapping | None = None,
+        key: jax.Array | None = None,
+    ) -> ColonyState:
+        """Stack the compartment's initial state into ``capacity`` rows,
+        with the first ``n_alive`` marked alive.
+
+        ``overrides`` may carry per-agent leading axes (shape
+        ``[capacity, ...]``) or scalars (broadcast to all rows).
+        """
+        if not 0 <= n_alive <= self.capacity:
+            raise ValueError(f"n_alive={n_alive} not in [0, {self.capacity}]")
+        single = self.compartment.initial_state()
+        agents = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.capacity,) + x.shape).copy(), single
+        )
+        if overrides:
+            for path, value in flatten_paths(overrides):
+                if path not in self.compartment.updaters:
+                    raise KeyError(f"override {path} is not a schema variable")
+                value = jnp.asarray(value)
+                base = get_path(agents, path)
+                if value.ndim == base.ndim:  # per-agent array
+                    if value.shape[0] != self.capacity:
+                        raise ValueError(
+                            f"per-agent override {path} has leading dim "
+                            f"{value.shape[0]}, expected capacity={self.capacity}"
+                        )
+                    agents = set_path(agents, path, value.astype(base.dtype))
+                else:
+                    agents = set_path(
+                        agents, path, jnp.broadcast_to(value, base.shape).astype(base.dtype)
+                    )
+        alive = jnp.arange(self.capacity) < n_alive
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        return ColonyState(
+            agents=agents, alive=alive, key=key, step=jnp.int32(0)
+        )
+
+    # -- stepping ------------------------------------------------------------
+
+    def step_biology(self, cs: ColonyState, timestep: float) -> ColonyState:
+        """Run every Process on every row (no division, no step counter)."""
+        if self.compartment.has_stochastic:
+            step_key = jax.random.fold_in(cs.key, cs.step)
+            agent_keys = jax.random.split(step_key, self.capacity)
+            stepped = jax.vmap(
+                lambda s, k: self.compartment.step(s, timestep, k)
+            )(cs.agents, agent_keys)
+        else:
+            stepped = jax.vmap(
+                lambda s: self.compartment.step(s, timestep)
+            )(cs.agents)
+        # Freeze dead rows: no NaN creep, bitwise determinism independent of
+        # how many rows happen to be active.
+        agents = jax.tree.map(
+            lambda new, old: jnp.where(_bcast(cs.alive, new), new, old),
+            stepped,
+            cs.agents,
+        )
+        return cs._replace(agents=agents)
+
+    def step_division(self, cs: ColonyState) -> ColonyState:
+        """Apply divisions per the trigger variable (no-op if disabled)."""
+        if self.division_trigger is None:
+            return cs
+        key, sub = jax.random.split(cs.key)
+        agents, alive = self._divide(cs.agents, cs.alive, sub)
+        return cs._replace(agents=agents, alive=alive, key=key)
+
+    def step(self, cs: ColonyState, timestep: float) -> ColonyState:
+        """One exchange-window step for the whole colony. Pure; jittable.
+
+        Spatial wrappers call the two phases separately so exchange fluxes
+        can be applied to the environment BETWEEN biology and division —
+        otherwise the division dividers (exchange is ``_divider: zero``)
+        would discard a window's uptake before the field is debited.
+        """
+        cs = self.step_biology(cs, timestep)
+        cs = self.step_division(cs)
+        return cs._replace(step=cs.step + 1)
+
+    def run(
+        self, cs: ColonyState, total_time: float, timestep: float, emit_every: int = 1
+    ) -> Tuple[ColonyState, dict]:
+        """Scan ``step`` over ``total_time``; emit colony slices periodically.
+
+        The emitted trajectory carries ``alive`` alongside the agent slice so
+        offline analysis can mask dead rows (SURVEY.md §5 emitter design).
+        """
+        return scan_schedule(
+            lambda c: self.step(c, timestep), self.emit, cs,
+            total_time, timestep, emit_every,
+        )
+
+    # -- division ------------------------------------------------------------
+
+    def _divide(
+        self, agents: dict, alive: jax.Array, key: jax.Array
+    ) -> Tuple[dict, jax.Array]:
+        """Vectorized division: all triggered rows split at once.
+
+        Fixed-shape algorithm (no data-dependent shapes):
+        1. ``triggers`` = alive rows whose trigger variable is nonzero.
+        2. Free rows are enumerated with ``nonzero(size=capacity)``; the
+           k-th triggering parent claims the k-th free row. Parents ranked
+           beyond the number of free rows are suppressed (stay undivided).
+        3. Every schema leaf is split by its declared divider into
+           (daughter_a, daughter_b) for all rows; daughter A overwrites the
+           parent row, daughter B is scattered to the claimed row.
+        """
+        cap = self.capacity
+        trig_val = get_path(agents, self.division_trigger)
+        triggers = alive & (trig_val > 0)
+
+        free_rows = jnp.nonzero(~alive, size=cap, fill_value=cap)[0]  # [cap]
+        n_free = jnp.sum(~alive)
+        # rank of each triggering parent among triggers (0-based)
+        rank = jnp.cumsum(triggers) - 1
+        can_divide = triggers & (rank < n_free)
+        # daughter slot per row (cap = "no slot"; scatter drops OOB)
+        slot = jnp.where(can_divide, free_rows[jnp.clip(rank, 0, cap - 1)], cap)
+
+        leaves = list(flatten_paths(agents))
+        keys = jax.random.split(key, max(len(leaves), 1))
+        out = agents
+        for (path, value), leaf_key in zip(leaves, keys):
+            divider = DIVIDERS[self.compartment.dividers.get(path, "split")]
+            row_keys = jax.random.split(leaf_key, cap)
+            # vmap the scalar divider across the agent axis
+            a, b = jax.vmap(divider)(value, row_keys)
+            new_val = jnp.where(_bcast(can_divide, value), a, value)
+            # scatter daughter B into claimed slots; 'drop' ignores slot==cap
+            # (only can_divide rows have slot < cap, so nothing else lands)
+            new_val = new_val.at[slot].set(b, mode="drop")
+            out = set_path(out, path, new_val)
+
+        alive = alive.at[slot].set(True, mode="drop")
+        return out, alive
+
+    # -- emission ------------------------------------------------------------
+
+    def emit(self, cs_or_agents, alive: jax.Array | None = None) -> dict:
+        """Colony emit slice: schema ``_emit`` paths + the alive mask."""
+        if isinstance(cs_or_agents, ColonyState):
+            agents, alive = cs_or_agents.agents, cs_or_agents.alive
+        else:
+            agents = cs_or_agents
+        out: dict = {}
+        for path in self.compartment.emit_paths:
+            out = set_path(out, path, get_path(agents, path))
+        out["alive"] = alive
+        return out
+
+    def n_alive(self, cs: ColonyState) -> jax.Array:
+        return jnp.sum(cs.alive)

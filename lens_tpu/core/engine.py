@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lens_tpu.core.process import Deriver, Process, is_schema_leaf
+from lens_tpu.core.schedule import scan_schedule
 from lens_tpu.core.state import apply_update, divide_state
 from lens_tpu.core.topology import Path, TopologySpec, normalize_topology
 from lens_tpu.utils.dicts import deep_merge, flatten_paths, get_path, set_path
@@ -152,23 +153,40 @@ class Compartment:
 
     # -- stepping ------------------------------------------------------------
 
-    def step(self, state: dict, timestep) -> dict:
+    @property
+    def has_stochastic(self) -> bool:
+        return any(p.stochastic for p in self.processes.values())
+
+    def step(self, state: dict, timestep, key: Optional[jax.Array] = None) -> dict:
         """One engine step: all mechanistic updates off the pre-step state,
-        merged in declaration order; then derivers in order."""
-        updates = []
-        for name in self.mechanistic:
-            view = self._port_view(state, name)
-            updates.append(
-                self._absolute_update(name, self.processes[name].next_update(timestep, view))
+        merged in declaration order; then derivers in order.
+
+        ``key`` is required iff any process is stochastic; the engine
+        derives an independent subkey per stochastic process.
+        """
+        if self.has_stochastic and key is None:
+            raise ValueError(
+                "this compartment has stochastic processes; step() needs a key"
             )
+        order = list(self.processes)
+
+        def run_process(view_state: dict, name: str) -> dict:
+            process = self.processes[name]
+            view = self._port_view(view_state, name)
+            if process.stochastic:
+                update = process.next_update(
+                    timestep, view, key=jax.random.fold_in(key, order.index(name))
+                )
+            else:
+                update = process.next_update(timestep, view)
+            return self._absolute_update(name, update)
+
+        updates = [run_process(state, n) for n in self.mechanistic]
         for update in updates:
             state = apply_update(state, update, self.updaters)
         for name in self.derivers:
-            view = self._port_view(state, name)
-            update = self._absolute_update(
-                name, self.processes[name].next_update(timestep, view)
-            )
-            state = apply_update(state, update, self.updaters)
+            # derivers see the merged state (view rebuilt against it)
+            state = apply_update(state, run_process(state, name), self.updaters)
         return state
 
     def run(
@@ -177,6 +195,7 @@ class Compartment:
         total_time: float,
         timestep: float,
         emit_every: int = 1,
+        key: Optional[jax.Array] = None,
     ) -> Tuple[dict, dict]:
         """Advance ``total_time`` in increments of ``timestep`` via ``lax.scan``.
 
@@ -185,25 +204,21 @@ class Compartment:
         The scan is the jit/compile unit — one trace regardless of step
         count (SURVEY.md §7 step 2: "jit the whole exchange window").
         """
-        n_steps = int(round(total_time / timestep))
-        if abs(n_steps * timestep - total_time) > 1e-6 * max(abs(total_time), 1.0):
+        if self.has_stochastic and key is None:
             raise ValueError(
-                f"total_time={total_time} is not an integer multiple of "
-                f"timestep={timestep} (would silently simulate "
-                f"{n_steps * timestep})"
+                "this compartment has stochastic processes; run() needs a key"
             )
-        if n_steps % emit_every != 0:
-            raise ValueError("total steps must be a multiple of emit_every")
+        if key is None:
+            key = jax.random.PRNGKey(0)  # unused, but keeps the carry uniform
 
-        def body(carry, _):
-            def inner(c, _):
-                return self.step(c, timestep), None
+        def step_fn(carry):
+            s, k = carry
+            k, sub = jax.random.split(k)
+            return (self.step(s, timestep, sub), k)
 
-            carry, _ = jax.lax.scan(inner, carry, None, length=emit_every)
-            return carry, self.emit(carry)
-
-        state, trajectory = jax.lax.scan(
-            body, state, None, length=n_steps // emit_every
+        (state, _), trajectory = scan_schedule(
+            step_fn, lambda c: self.emit(c[0]), (state, key),
+            total_time, timestep, emit_every,
         )
         return state, trajectory
 

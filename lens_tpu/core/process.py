@@ -56,6 +56,11 @@ class Process:
 
     defaults: Dict[str, Any] = {}
     name: str = "process"
+    #: Stochastic processes receive a ``key=`` kwarg in ``next_update``
+    #: (a fresh per-agent, per-step PRNG key supplied by the engine).
+    #: Randomness must be fixed-shape (Poisson/normal draws, not
+    #: variable-length event lists) to stay jit/vmap-compatible.
+    stochastic: bool = False
 
     def __init__(self, config: Mapping | None = None):
         self.config = deep_merge(self.defaults, config)
@@ -67,13 +72,16 @@ class Process:
 
     # -- dynamics ------------------------------------------------------------
 
-    def next_update(self, timestep, states: Mapping) -> Dict[str, Dict[str, Any]]:
+    def next_update(
+        self, timestep, states: Mapping, key=None
+    ) -> Dict[str, Dict[str, Any]]:
         """Compute this process's contribution for one timestep.
 
         ``states`` maps port name -> {variable: value} (a read-only view the
         engine assembled through the topology). The return value mirrors
         that structure; each leaf is merged by the variable's declared
-        updater. Must be pure and jnp-traceable.
+        updater. Must be pure and jnp-traceable. ``key`` is only passed
+        when ``stochastic = True``.
         """
         raise NotImplementedError
 

@@ -1,0 +1,53 @@
+"""Shared run-loop scaffolding: validated scan over timesteps with emits.
+
+Compartment.run, Colony.run and SpatialColony.run all advance a carry by
+``total_time`` in ``timestep`` increments and emit a slice every
+``emit_every`` steps. The validation (duration divisibility — silently
+simulating a different duration is the failure mode) and the nested-scan
+shape live here once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+
+
+def n_steps_for(total_time: float, timestep: float) -> int:
+    """Step count, insisting total_time is an integer multiple of timestep."""
+    n_steps = int(round(total_time / timestep))
+    if abs(n_steps * timestep - total_time) > 1e-6 * max(abs(total_time), 1.0):
+        raise ValueError(
+            f"total_time={total_time} is not an integer multiple of "
+            f"timestep={timestep} (would silently simulate {n_steps * timestep})"
+        )
+    return n_steps
+
+
+def scan_schedule(
+    step_fn: Callable[[Any], Any],
+    emit_fn: Callable[[Any], Any],
+    carry: Any,
+    total_time: float,
+    timestep: float,
+    emit_every: int = 1,
+) -> Tuple[Any, Any]:
+    """``lax.scan`` ``step_fn`` for total_time/timestep steps, collecting
+    ``emit_fn(carry)`` every ``emit_every`` steps (stacked on a leading
+    time axis). One trace regardless of step count."""
+    n_steps = n_steps_for(total_time, timestep)
+    if emit_every < 1 or n_steps % emit_every != 0:
+        raise ValueError(
+            f"total steps ({n_steps}) must be a positive multiple of "
+            f"emit_every ({emit_every})"
+        )
+
+    def body(c, _):
+        def inner(c, _):
+            return step_fn(c), None
+
+        c, _ = jax.lax.scan(inner, c, None, length=emit_every)
+        return c, emit_fn(c)
+
+    return jax.lax.scan(body, carry, None, length=n_steps // emit_every)

@@ -1,0 +1,4 @@
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.environment.spatial import SpatialColony, SpatialState
+
+__all__ = ["Lattice", "SpatialColony", "SpatialState"]

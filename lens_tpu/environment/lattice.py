@@ -1,0 +1,157 @@
+"""The spatial environment: molecular fields on a 2D lattice.
+
+The reference's outer agent owns a 2D diffusion lattice of molecular
+fields ``[molecule, x, y]`` with per-window diffusion, exchange-flux
+application, and media changes (reconstructed:
+``EnvironmentSpatialLattice`` in ``lens/environment/lattice.py``,
+SURVEY.md §2 — path corroborated by BASELINE.json). The rebuild keeps the
+same responsibilities but as a pure function library over a ``[M, H, W]``
+array co-resident with agent state in HBM; the "outer agent" as a concurrent
+process disappears (SURVEY.md §2 parallelism table).
+
+Units: fields hold concentrations (mM). A cell occupying a bin exchanges
+amounts; ``counts_to_conc = 1 / (bin_volume * N_A)``-style factors are
+collapsed into a single configurable ``exchange_scale``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.ops.diffusion import diffuse, stable_substeps
+
+
+class Lattice:
+    """Static configuration + pure field-update functions.
+
+    Parameters
+    ----------
+    molecules: ordered molecule names; index = channel in the field array.
+    shape: (H, W) bins.
+    size: physical edge lengths (h, w) in um; dx = size/shape (square bins).
+    diffusion: per-molecule diffusion coefficient (um^2/s), dict or scalar.
+    initial: per-molecule initial concentration (uniform), dict or scalar.
+    exchange_scale: concentration change per unit of agent exchange flux
+        landing in one bin (collapses bin volume/Avogadro bookkeeping).
+    """
+
+    def __init__(
+        self,
+        molecules: Sequence[str],
+        shape: Tuple[int, int] = (256, 256),
+        size: Tuple[float, float] | None = None,
+        diffusion: Dict[str, float] | float = 600.0,
+        initial: Dict[str, float] | float = 10.0,
+        exchange_scale: float = 1.0,
+        timestep: float = 1.0,
+        impl: str = "auto",
+    ):
+        self.molecules = list(molecules)
+        self.shape = tuple(shape)
+        self.size = tuple(size) if size is not None else (float(shape[0]), float(shape[1]))
+        self.dx = self.size[0] / self.shape[0]
+        if abs(self.size[1] / self.shape[1] - self.dx) > 1e-9:
+            raise ValueError("bins must be square (size/shape equal per axis)")
+        if isinstance(diffusion, dict):
+            self.diffusion = jnp.asarray(
+                [float(diffusion[m]) for m in self.molecules], jnp.float32
+            )
+        else:
+            self.diffusion = jnp.full((len(self.molecules),), float(diffusion), jnp.float32)
+        if isinstance(initial, dict):
+            self._initial = [float(initial[m]) for m in self.molecules]
+        else:
+            self._initial = [float(initial)] * len(self.molecules)
+        self.exchange_scale = float(exchange_scale)
+        self.timestep = float(timestep)
+        self.impl = impl
+        d_max = float(jnp.max(self.diffusion)) if self.molecules else 0.0
+        self.n_substeps = stable_substeps(d_max, self.timestep, self.dx)
+        self.alpha = self.diffusion * (self.timestep / self.n_substeps) / (self.dx * self.dx)
+
+    # -- construction --------------------------------------------------------
+
+    def initial_fields(self) -> jnp.ndarray:
+        h, w = self.shape
+        return jnp.stack(
+            [jnp.full((h, w), c, jnp.float32) for c in self._initial]
+        )
+
+    def index(self, molecule: str) -> int:
+        return self.molecules.index(molecule)
+
+    # -- pure field ops ------------------------------------------------------
+
+    def step_fields(self, fields: jnp.ndarray) -> jnp.ndarray:
+        """One environment timestep of diffusion (all substeps)."""
+        return diffuse(fields, self.alpha, self.n_substeps, impl=self.impl)
+
+    def bin_of(self, locations: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Map continuous agent locations [N, 2] (um) to bin indices."""
+        ij = jnp.floor(locations / self.dx).astype(jnp.int32)
+        i = jnp.clip(ij[:, 0], 0, self.shape[0] - 1)
+        j = jnp.clip(ij[:, 1], 0, self.shape[1] - 1)
+        return i, j
+
+    def occupancy(
+        self, locations: jnp.ndarray, alive: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Live-agent count per bin: [H, W]."""
+        i, j = self.bin_of(locations)
+        return (
+            jnp.zeros(self.shape, jnp.float32)
+            .at[i, j]
+            .add(alive.astype(jnp.float32))
+        )
+
+    def local_concentrations(
+        self,
+        fields: jnp.ndarray,
+        locations: jnp.ndarray,
+        alive: jnp.ndarray | None = None,
+        share_bins: bool = True,
+    ) -> jnp.ndarray:
+        """Gather each agent's local concentration: [N, M].
+
+        This IS the reference's outer->inner ENVIRONMENT_UPDATE message
+        (SURVEY.md §3.2), reduced to one gather.
+
+        With ``share_bins`` (default), co-located agents see the bin
+        concentration divided by the bin's live occupancy AND by
+        ``exchange_scale``. Since a transport process can take up at most
+        what it sees, and the scatter multiplies fluxes back by
+        ``exchange_scale``, collective uptake then never exceeds the bin
+        content — exact mass conservation, where the reference's
+        end-of-window flux application could overdraw a shared site.
+        """
+        i, j = self.bin_of(locations)
+        local = fields[:, i, j].T
+        if share_bins:
+            if alive is None:
+                raise ValueError("share_bins needs the alive mask")
+            occ = self.occupancy(locations, alive)[i, j]
+            local = local / (
+                jnp.maximum(occ, 1.0)[:, None] * self.exchange_scale
+            )
+        return local
+
+    def apply_exchanges(
+        self,
+        fields: jnp.ndarray,
+        locations: jnp.ndarray,
+        exchange: jnp.ndarray,
+        alive: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """Scatter-add agent uptake(-)/secretion(+) into their bins.
+
+        exchange: [N, M] net flux for the window (positive = secreted into
+        the environment). The inner->outer CELL_UPDATE message as one
+        scatter. Dead rows are masked out.
+        """
+        i, j = self.bin_of(locations)
+        contrib = exchange * alive[:, None] * self.exchange_scale
+        updated = fields.at[:, i, j].add(contrib.T)
+        return jnp.maximum(updated, 0.0)

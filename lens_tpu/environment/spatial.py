@@ -1,0 +1,206 @@
+"""SpatialColony: colony + lattice coupled through pure index ops.
+
+This module is the rebuild of the reference's whole outer/inner exchange
+machinery (SURVEY.md §3.2): where the reference's outer agent broadcasts
+local concentrations over Kafka, waits on a barrier for every inner
+agent's exchange fluxes, then applies them to the lattice, here one pure
+``step`` does, in order:
+
+1. **gather**   — each agent's ``external`` port variables are overwritten
+   with its bin's concentrations (ENVIRONMENT_UPDATE as one gather);
+2. **biology**  — one vmapped colony step (all Processes + division);
+3. **scatter**  — each agent's ``exchange`` accumulators are added into
+   its bin and zeroed (CELL_UPDATE as one scatter-add);
+4. **fields**   — diffusion substeps advance the lattice.
+
+The barrier is implicit: step 3 happens after step 2 for every agent by
+construction. No broker, no messages, no waiting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.colony.colony import Colony, ColonyState, _bcast
+from lens_tpu.core.schedule import scan_schedule
+from lens_tpu.core.topology import Path, normalize_path
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.utils.dicts import get_path, set_path
+
+
+class SpatialState(NamedTuple):
+    colony: ColonyState
+    fields: jax.Array  # [M, H, W]
+
+
+class FieldPort(NamedTuple):
+    """Wiring of one lattice molecule into the agent state tree."""
+
+    local: Path      # agent path overwritten with the bin concentration
+    exchange: Path   # agent path accumulating net secretion (consumed)
+
+
+class SpatialColony:
+    """A Colony embedded in a Lattice.
+
+    field_ports: molecule name -> FieldPort (or (local, exchange) tuple).
+    location_path: agent path of the [2] position leaf (um).
+    """
+
+    def __init__(
+        self,
+        colony: Colony,
+        lattice: Lattice,
+        field_ports: Mapping[str, FieldPort | Tuple],
+        location_path: Path | str = ("boundary", "location"),
+        share_bins: bool = True,
+    ):
+        self.colony = colony
+        self.lattice = lattice
+        self.share_bins = bool(share_bins)
+        self.location_path = normalize_path(location_path)
+        self.field_ports: Dict[str, FieldPort] = {}
+        known = colony.compartment.updaters
+        if self.location_path not in known:
+            raise ValueError(f"location path {self.location_path} not in schema")
+        for mol, port in field_ports.items():
+            if mol not in lattice.molecules:
+                raise ValueError(f"molecule {mol!r} not on the lattice")
+            port = FieldPort(normalize_path(port[0]), normalize_path(port[1]))
+            for path in port:
+                if path not in known:
+                    raise ValueError(f"field port path {path} not in schema")
+            self.field_ports[mol] = port
+
+    # -- construction --------------------------------------------------------
+
+    def initial_state(
+        self,
+        n_alive: int,
+        key: jax.Array,
+        overrides: Mapping | None = None,
+        locations: jax.Array | None = None,
+    ) -> SpatialState:
+        """Colony rows + uniform fields. Locations default to uniform random
+        placement over the domain (live rows only; dead rows parked at 0)."""
+        cs = self.colony.initial_state(n_alive, overrides=overrides, key=key)
+        if locations is None:
+            lkey = jax.random.fold_in(key, 0x10C)
+            h, w = self.lattice.size
+            locations = jax.random.uniform(
+                lkey,
+                (self.colony.capacity, 2),
+                minval=jnp.zeros(2),
+                maxval=jnp.asarray([h, w]),
+            )
+        agents = set_path(
+            cs.agents,
+            self.location_path,
+            jnp.asarray(locations, jnp.float32),
+        )
+        cs = cs._replace(agents=agents)
+        return SpatialState(colony=cs, fields=self.lattice.initial_fields())
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, ss: SpatialState, timestep: float) -> SpatialState:
+        if abs(timestep - self.lattice.timestep) > 1e-9:
+            raise ValueError(
+                f"timestep={timestep} != lattice.timestep="
+                f"{self.lattice.timestep}: the lattice precomputes its "
+                f"diffusion substeps for its own timestep — construct the "
+                f"Lattice with the timestep you run at"
+            )
+        cs, fields = ss
+        locations = get_path(cs.agents, self.location_path)
+
+        # 1. gather: overwrite each agent's local-env variables (bin-shared:
+        # co-located agents split the bin, so uptake cannot overdraw it)
+        local = self.lattice.local_concentrations(
+            fields, locations, cs.alive, share_bins=self.share_bins
+        )  # [N, M]
+        agents = cs.agents
+        for mol, port in self.field_ports.items():
+            col = local[:, self.lattice.index(mol)]
+            prev = get_path(agents, port.local)
+            # dead rows keep their previous value (mask hygiene)
+            agents = set_path(
+                agents, port.local, jnp.where(cs.alive, col, prev)
+            )
+        cs = cs._replace(agents=agents)
+
+        # 2. biology — processes only; division is deferred until the
+        # exchange is applied (its dividers zero the accumulators)
+        cs = self.colony.step_biology(cs, timestep)
+
+        # 3. scatter: debit/credit the PRE-STEP bins — the bins whose
+        # concentrations the transport processes actually saw. (Motility
+        # may have moved the agent this step; debiting the new bin could
+        # overdraw it, and the >=0 clamp would then create mass.)
+        agents = cs.agents
+        exchange = jnp.stack(
+            [
+                get_path(agents, self.field_ports[mol].exchange)
+                if mol in self.field_ports
+                else jnp.zeros(self.colony.capacity)
+                for mol in self.lattice.molecules
+            ],
+            axis=1,
+        )  # [N, M]
+        fields = self.lattice.apply_exchanges(
+            fields, locations, exchange, cs.alive
+        )
+        for mol, port in self.field_ports.items():
+            agents = set_path(
+                agents,
+                port.exchange,
+                jnp.zeros_like(get_path(agents, port.exchange)),
+            )
+        cs = cs._replace(agents=agents)
+
+        # 4. division (row activation) now that accumulators are drained;
+        # then clip every agent onto the lattice — motility processes need
+        # not know the domain geometry (it lives here, once)
+        cs = self.colony.step_division(cs)
+        agents = cs.agents
+        loc = get_path(agents, self.location_path)
+        h, w = self.lattice.size
+        loc = jnp.clip(
+            loc,
+            jnp.zeros(2, loc.dtype),
+            jnp.asarray([h, w], loc.dtype) - 1e-3,
+        )
+        cs = cs._replace(
+            agents=set_path(agents, self.location_path, loc),
+            step=cs.step + 1,
+        )
+
+        # 5. diffusion
+        fields = self.lattice.step_fields(fields)
+        return SpatialState(colony=cs, fields=fields)
+
+    def run(
+        self,
+        ss: SpatialState,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+    ) -> Tuple[SpatialState, dict]:
+        def emit_fn(carry):
+            emit = self.colony.emit(carry.colony)
+            emit["fields"] = carry.fields
+            return emit
+
+        return scan_schedule(
+            lambda c: self.step(c, timestep), emit_fn, ss,
+            total_time, timestep, emit_every,
+        )
+
+    # -- diagnostics ---------------------------------------------------------
+
+    def total_field_mass(self, ss: SpatialState) -> jax.Array:
+        """Sum over bins per molecule (conservation checks)."""
+        return jnp.sum(ss.fields, axis=(1, 2))

@@ -1,0 +1,144 @@
+"""2D diffusion stencils — the environment lattice's hot kernel.
+
+The reference advances its molecular fields with a finite-difference
+diffusion step in numpy/scipy (reconstructed:
+``lens/environment/lattice.py`` ``run_diffusion``, SURVEY.md §3.2 — one of
+the two hot loops BASELINE.json targets). Here the 5-point FTCS stencil
+
+    F' = F + (D * dt / dx^2) * (F_up + F_down + F_left + F_right - 4 F)
+
+with no-flux (Neumann) boundaries is provided in two implementations:
+
+- ``diffuse_xla``: pad+slice shifts, fused by XLA — the portable baseline;
+- ``diffuse_pallas``: a Pallas TPU kernel holding the whole field slab in
+  VMEM and scanning substeps on-core, so one HBM round-trip covers all
+  substeps of an exchange window (the XLA path reads/writes HBM per
+  substep unless XLA manages to fuse the scan — it usually doesn't).
+
+``diffuse`` dispatches by backend; both paths are numerically identical
+(same order of adds), which the tests assert.
+
+Stability: FTCS needs alpha = D*dt/dx^2 <= 0.25 in 2D. Callers pick the
+substep count; ``stable_substeps`` computes the minimum.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def stable_substeps(d_max: float, dt: float, dx: float, safety: float = 0.9) -> int:
+    """Minimum FTCS substeps for stability: alpha <= 0.25 * safety."""
+    if d_max <= 0.0:
+        return 1
+    alpha = d_max * dt / (dx * dx)
+    return max(1, math.ceil(alpha / (0.25 * safety)))
+
+
+def _neumann_laplacian(f: jnp.ndarray) -> jnp.ndarray:
+    """5-point Laplacian with edge-clamped (no-flux) boundaries.
+
+    f: [..., H, W]. Edge clamping makes the boundary-normal gradient zero,
+    so total mass is conserved exactly (up to float addition order).
+    """
+    up = jnp.concatenate([f[..., :1, :], f[..., :-1, :]], axis=-2)
+    down = jnp.concatenate([f[..., 1:, :], f[..., -1:, :]], axis=-2)
+    left = jnp.concatenate([f[..., :, :1], f[..., :, :-1]], axis=-1)
+    right = jnp.concatenate([f[..., :, 1:], f[..., :, -1:]], axis=-1)
+    return up + down + left + right - 4.0 * f
+
+
+def diffuse_xla(
+    fields: jnp.ndarray,
+    alpha: jnp.ndarray,
+    n_substeps: int,
+) -> jnp.ndarray:
+    """FTCS diffusion, XLA implementation.
+
+    fields: [M, H, W]; alpha: [M] = D*dt_sub/dx^2 per molecule (already
+    divided by n_substeps).
+    """
+    a = alpha.reshape(-1, 1, 1)
+
+    def body(f, _):
+        return f + a * _neumann_laplacian(f), None
+
+    out, _ = jax.lax.scan(body, fields, None, length=n_substeps)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def diffuse_pallas(
+    fields: jnp.ndarray,
+    alpha: jnp.ndarray,
+    n_substeps: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """FTCS diffusion as a Pallas TPU kernel, gridded over molecules.
+
+    Each grid step pulls one [H, W] slab into VMEM, runs every substep
+    there, and writes back once — substeps cost zero extra HBM traffic.
+    A 256x256 f32 slab is 256 KiB, comfortably inside ~16 MiB VMEM.
+    """
+    m, h, w = fields.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[pl.BlockSpec((1, h, w), lambda i, *_: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, h, w), lambda i, *_: (i, 0, 0)),
+    )
+
+    def kernel(alpha_sref, f_ref, out_ref):
+        i = pl.program_id(0)
+        f = f_ref[0]
+        a = alpha_sref[i]
+
+        def body(_, f):
+            up = jnp.concatenate([f[:1, :], f[:-1, :]], axis=0)
+            down = jnp.concatenate([f[1:, :], f[-1:, :]], axis=0)
+            left = jnp.concatenate([f[:, :1], f[:, :-1]], axis=1)
+            right = jnp.concatenate([f[:, 1:], f[:, -1:]], axis=1)
+            return f + a * (up + down + left + right - 4.0 * f)
+
+        out_ref[0] = jax.lax.fori_loop(0, n_substeps, body, f)
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(fields.shape, fields.dtype),
+        interpret=interpret,
+    )(alpha, fields)
+
+
+@functools.partial(jax.jit, static_argnames=("n_substeps", "impl"))
+def diffuse(
+    fields: jnp.ndarray,
+    alpha: jnp.ndarray,
+    n_substeps: int,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Dispatching entry point. ``alpha`` = D*dt_sub/dx^2, shape [M].
+
+    impl: 'auto' (pallas on TPU, xla elsewhere), 'xla', 'pallas',
+    'pallas_interpret' (for CPU tests of the kernel logic).
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return diffuse_xla(fields, alpha, n_substeps)
+    if impl == "pallas":
+        return diffuse_pallas(fields, alpha, n_substeps)
+    if impl == "pallas_interpret":
+        return diffuse_pallas(fields, alpha, n_substeps, interpret=True)
+    raise ValueError(f"unknown impl {impl!r}")

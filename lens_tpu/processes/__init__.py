@@ -22,5 +22,19 @@ def register(cls: Type[Process]) -> Type[Process]:
 # Import for registration side effects.
 from lens_tpu.processes.glucose_pts import GlucosePTS  # noqa: E402
 from lens_tpu.processes.toggle_switch import ToggleSwitch  # noqa: E402
+from lens_tpu.processes.growth import DivideTrigger, Growth  # noqa: E402
+from lens_tpu.processes.mm_transport import (  # noqa: E402
+    BrownianMotility,
+    MichaelisMentenTransport,
+)
 
-__all__ = ["process_registry", "register", "GlucosePTS", "ToggleSwitch"]
+__all__ = [
+    "process_registry",
+    "register",
+    "GlucosePTS",
+    "ToggleSwitch",
+    "Growth",
+    "DivideTrigger",
+    "MichaelisMentenTransport",
+    "BrownianMotility",
+]

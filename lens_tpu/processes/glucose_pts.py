@@ -58,8 +58,10 @@ class GlucosePTS(Process):
                 },
             },
             "exchange": {
-                # net uptake this window, in concentration units; consumed
-                # (zeroed) by the lattice exchange step.
+                # net SECRETION this window (negative = uptake), in env
+                # concentration units; consumed (zeroed) by the lattice
+                # exchange step. Sign convention shared by all spatially
+                # coupled processes (see processes/mm_transport.py).
                 "glucose_flux": {
                     "_default": 0.0,
                     "_updater": "accumulate",
@@ -92,5 +94,5 @@ class GlucosePTS(Process):
         return {
             "internal": {"glucose_internal": g_int - g_int0},
             "external": {"glucose_external": g_ext - g_ext0},
-            "exchange": {"glucose_flux": g_ext0 - g_ext},
+            "exchange": {"glucose_flux": g_ext - g_ext0},
         }

@@ -1,0 +1,68 @@
+"""Growth and division-trigger processes.
+
+The reference pairs a mass-accumulation growth process with a division
+deriver that trips when the cell doubles (reconstructed:
+``lens/processes/``, derivers in SURVEY.md §2 "Division/growth"). Here
+growth is exponential in volume and the trigger is a plain schema variable
+the colony layer watches (``Colony(division_trigger=...)``) — division
+itself is row activation, not a handshake.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Deriver, Process
+from lens_tpu.processes import register
+
+
+@register
+class Growth(Process):
+    """Exponential volume growth: V(t+dt) = V(t) * exp(rate * dt)."""
+
+    name = "growth"
+    defaults = {"rate": 0.0005}  # 1/s  (~23 min doubling, E. coli-ish)
+
+    def ports_schema(self):
+        return {
+            "global": {
+                "volume": {
+                    "_default": 1.0,
+                    "_updater": "accumulate",
+                    "_divider": "split",
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        v = states["global"]["volume"]
+        return {"global": {"volume": v * (jnp.exp(self.config["rate"] * timestep) - 1.0)}}
+
+
+@register
+class DivideTrigger(Deriver):
+    """Sets ``divide = volume >= threshold`` (the colony watches this)."""
+
+    name = "divide_trigger"
+    defaults = {"threshold": 2.0}
+
+    def ports_schema(self):
+        return {
+            "global": {
+                "volume": {"_default": 1.0, "_divider": "split"},
+                "divide": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        v = states["global"]["volume"]
+        return {
+            "global": {
+                "divide": (v >= self.config["threshold"]).astype(jnp.float32)
+            }
+        }

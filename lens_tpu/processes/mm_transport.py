@@ -1,0 +1,124 @@
+"""Michaelis–Menten nutrient transport — the spatial-coupling Process.
+
+Benchmark config 2 (BASELINE.json): "10k agents on 256x256 diffusion
+lattice, Michaelis–Menten transport Process". Fills the reference's
+transport-process slot for lattice-coupled runs (reconstructed:
+``lens/processes/*transport*.py`` + exchange semantics of
+``lens/actor/inner.py`` ``generate_inner_update``, SURVEY.md §3.2).
+
+Port conventions for spatially coupled processes:
+
+- ``external``: local environment concentrations at the cell's bin.
+  Declared ``_updater: null`` — the process never writes it; the spatial
+  wrapper overwrites it from the field gather each window (the
+  ENVIRONMENT_UPDATE direction).
+- ``exchange``: accumulated NET SECRETION in environment units (negative
+  = uptake). The spatial wrapper scatters it into the field and zeroes it
+  (the CELL_UPDATE direction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.processes import register
+
+
+@register
+class MichaelisMentenTransport(Process):
+    name = "mm_transport"
+
+    defaults = {
+        "vmax": 0.1,      # mM/s at saturation
+        "km": 0.5,        # mM
+        "yield_": 0.1,    # internal pool produced per unit taken up
+        "k_consume": 0.05,  # 1/s first-order drain of the internal pool
+        "molecule": "glucose",
+    }
+
+    def ports_schema(self):
+        mol = self.config["molecule"]
+        return {
+            "external": {
+                mol: {"_default": 10.0, "_updater": "null", "_divider": "copy"},
+            },
+            "internal": {
+                f"{mol}_internal": {
+                    "_default": 0.0,
+                    "_updater": "nonnegative_accumulate",
+                    "_divider": "split",
+                },
+            },
+            "exchange": {
+                f"{mol}_exchange": {
+                    "_default": 0.0,
+                    "_updater": "accumulate",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        mol = self.config["molecule"]
+        c = self.config
+        s_ext = states["external"][mol]
+        pool = states["internal"][f"{mol}_internal"]
+        uptake = c["vmax"] * s_ext / (c["km"] + s_ext) * timestep
+        # cannot take up more than is locally available
+        uptake = jnp.minimum(uptake, s_ext)
+        return {
+            "internal": {
+                f"{mol}_internal": c["yield_"] * uptake
+                - c["k_consume"] * pool * timestep
+            },
+            "exchange": {f"{mol}_exchange": -uptake},
+        }
+
+
+@register
+class BrownianMotility(Process):
+    """Diffusive cell movement on the lattice.
+
+    The reference's run/tumble motility lives in the outer lattice agent
+    (reconstructed: ``lens/environment/lattice.py`` ``update_locations``,
+    SURVEY.md §2); here movement is an ordinary stochastic Process owning
+    the cell's ``location`` so chemotactic variants can replace it without
+    touching the environment code.
+    """
+
+    name = "brownian_motility"
+    stochastic = True
+
+    defaults = {
+        "sigma": 0.5,    # um / sqrt(s) random-walk scale
+        # Optional clip bounds (um). Default None: unbounded — when run
+        # under a SpatialColony the wrapper clips to the lattice domain
+        # (the geometry lives in one place); set explicitly only for
+        # standalone use.
+        "domain": None,
+    }
+
+    def ports_schema(self):
+        return {
+            "boundary": {
+                "location": {
+                    "_default": jnp.zeros(2, jnp.float32),
+                    "_updater": "set",
+                    "_divider": "copy",
+                },
+            },
+        }
+
+    def next_update(self, timestep, states, key=None):
+        loc = states["boundary"]["location"]
+        step = self.config["sigma"] * jnp.sqrt(timestep) * jax.random.normal(
+            key, (2,)
+        )
+        new = loc + step
+        if self.config["domain"] is not None:
+            h, w = self.config["domain"]
+            new = jnp.clip(new, jnp.zeros(2), jnp.asarray([h, w]) - 1e-3)
+        return {"boundary": {"location": new}}

@@ -1,0 +1,143 @@
+"""Colony layer: stacking, alive-mask, division-as-row-activation.
+
+The hard parts list (SURVEY.md §7): division with fixed shapes, capacity
+preallocation, mask hygiene, determinism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lens_tpu.colony import Colony
+from lens_tpu.core.engine import Compartment
+from lens_tpu.processes.growth import DivideTrigger, Growth
+from lens_tpu.processes.toggle_switch import ToggleSwitch
+
+GROW_RATE = 0.01  # fast-growing test cells: doubling time ~69.3 s
+
+
+def growth_colony(capacity, n_alive=1, threshold=2.0):
+    comp = Compartment(
+        processes={
+            "growth": Growth({"rate": GROW_RATE}),
+            "divide_trigger": DivideTrigger({"threshold": threshold}),
+        },
+        topology={
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+    colony = Colony(comp, capacity, division_trigger=("global", "divide"))
+    return colony, colony.initial_state(n_alive)
+
+
+def test_initial_state_shapes_and_mask():
+    colony, cs = growth_colony(capacity=8, n_alive=3)
+    assert cs.agents["global"]["volume"].shape == (8,)
+    np.testing.assert_array_equal(
+        np.asarray(cs.alive), [True] * 3 + [False] * 5
+    )
+
+
+def test_growth_without_division():
+    colony, cs = growth_colony(capacity=4, n_alive=1, threshold=1e9)
+    cs2, _ = colony.run(cs, 50.0, 1.0)
+    v = float(cs2.agents["global"]["volume"][0])
+    np.testing.assert_allclose(v, np.exp(GROW_RATE * 50.0), rtol=1e-4)
+    assert int(colony.n_alive(cs2)) == 1
+
+
+def test_dead_rows_frozen():
+    colony, cs = growth_colony(capacity=4, n_alive=2, threshold=1e9)
+    cs2, _ = colony.run(cs, 10.0, 1.0)
+    # dead rows keep their untouched default volume
+    v = np.asarray(cs2.agents["global"]["volume"])
+    assert v[2] == 1.0 and v[3] == 1.0
+    assert v[0] > 1.0 and v[1] > 1.0
+
+
+def test_division_doubles_population_and_conserves_volume():
+    colony, cs = growth_colony(capacity=16, n_alive=1)
+    # volume hits 2.0 at t = ln(2)/rate ~ 69.3s -> first division at step 70
+    step = jax.jit(lambda c: colony.step(c, 1.0))
+    for _ in range(75):
+        cs = step(cs)
+    assert int(colony.n_alive(cs)) == 2
+    v = np.asarray(cs.agents["global"]["volume"])[np.asarray(cs.alive)]
+    # each daughter got half of just-over-2.0, then grew a little
+    assert all(0.9 < x < 1.2 for x in v)
+    # divide flag cleared on both daughters (divider 'zero' + deriver resets)
+    d = np.asarray(cs.agents["global"]["divide"])[np.asarray(cs.alive)]
+    assert all(x == 0.0 for x in d)
+
+
+def test_population_growth_exponential():
+    colony, cs = growth_colony(capacity=64, n_alive=1)
+    cs2, _ = colony.run(cs, 300.0, 1.0, emit_every=300)
+    # ~4.3 doublings in 300s: expect 16-32 cells, well under capacity
+    n = int(colony.n_alive(cs2))
+    assert 16 <= n <= 32
+    # all alive volumes in [1, 2.2)
+    v = np.asarray(cs2.agents["global"]["volume"])[np.asarray(cs2.alive)]
+    assert v.min() >= 0.9 and v.max() < 2.2
+
+
+def test_capacity_clamp_no_overflow():
+    colony, cs = growth_colony(capacity=4, n_alive=1)
+    cs2, _ = colony.run(cs, 400.0, 1.0, emit_every=400)
+    assert int(colony.n_alive(cs2)) == 4
+    # suppressed parents keep growing past threshold rather than crashing
+    v = np.asarray(cs2.agents["global"]["volume"])
+    assert np.all(np.isfinite(v))
+
+
+def test_determinism_same_seed():
+    colony, cs = growth_colony(capacity=16, n_alive=1)
+    a, _ = colony.run(cs, 100.0, 1.0, emit_every=100)
+    b, _ = colony.run(cs, 100.0, 1.0, emit_every=100)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_emit_trajectory_contains_alive():
+    colony, cs = growth_colony(capacity=8, n_alive=1)
+    _, traj = colony.run(cs, 100.0, 1.0, emit_every=50)
+    assert traj["alive"].shape == (2, 8)
+    assert traj["global"]["volume"].shape == (2, 8)
+    # divide flag is _emit False -> excluded
+    assert "divide" not in traj["global"]
+
+
+def test_bad_trigger_path_raises():
+    comp = Compartment(
+        processes={"growth": Growth()},
+        topology={"growth": {"global": ("global",)}},
+    )
+    with pytest.raises(ValueError):
+        Colony(comp, 4, division_trigger=("global", "nope"))
+
+
+def test_per_agent_overrides():
+    colony, _ = growth_colony(capacity=4, n_alive=4, threshold=1e9)
+    cs = colony.initial_state(
+        4, overrides={"global": {"volume": jnp.array([1.0, 2.0, 3.0, 4.0])}}
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cs.agents["global"]["volume"]), [1.0, 2.0, 3.0, 4.0]
+    )
+    with pytest.raises(KeyError):
+        colony.initial_state(4, overrides={"global": {"typo": 1.0}})
+
+
+def test_config1_toggle_colony_1k():
+    """Config 1: 1k-agent toggle-switch colony, no lattice, one jitted run."""
+    comp = Compartment(
+        processes={"switch": ToggleSwitch()},
+        topology={"switch": {"internal": ("cell",)}},
+    )
+    colony = Colony(comp, capacity=1024)
+    cs = colony.initial_state(1024)
+    cs2, traj = jax.jit(lambda c: colony.run(c, 10.0, 1.0, emit_every=10))(cs)
+    assert traj["cell"]["protein_u"].shape == (1, 1024)
+    assert bool(jnp.all(jnp.isfinite(cs2.agents["cell"]["protein_u"])))

@@ -47,10 +47,10 @@ def test_config0_single_agent_vs_scipy():
     np.testing.assert_allclose(
         float(final["cell"]["glucose_internal"]), ref[1], rtol=1e-4
     )
-    # exchange accumulated = total drawdown of external concentration
+    # exchange accumulates net secretion: negative of the total drawdown
     np.testing.assert_allclose(
         float(final["exchange"]["glucose_flux"]),
-        10.0 - ref[0],
+        ref[0] - 10.0,
         rtol=1e-4,
     )
     assert traj["cell"]["glucose_internal"].shape == (100,)

@@ -1,0 +1,187 @@
+"""SpatialColony: gather/scatter exchange, conservation, motility (config 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lens_tpu.colony import Colony
+from lens_tpu.core.engine import Compartment
+from lens_tpu.environment import Lattice, SpatialColony
+from lens_tpu.processes.mm_transport import (
+    BrownianMotility,
+    MichaelisMentenTransport,
+)
+
+
+def make_spatial(
+    capacity=64,
+    n_alive=64,
+    shape=(32, 32),
+    sigma=0.5,
+    d=2.0,
+    yield_=1.0,
+    k_consume=0.0,
+    seed=0,
+):
+    comp = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport(
+                {"yield_": yield_, "k_consume": k_consume}
+            ),
+            "motility": BrownianMotility(
+                {"sigma": sigma, "domain": (float(shape[0]), float(shape[1]))}
+            ),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "motility": {"boundary": ("boundary",)},
+        },
+    )
+    colony = Colony(comp, capacity)
+    lattice = Lattice(
+        molecules=["glucose"],
+        shape=shape,
+        size=(float(shape[0]), float(shape[1])),
+        diffusion=d,
+        initial=10.0,
+        timestep=1.0,
+    )
+    spatial = SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            "glucose": (("boundary", "external", "glucose"),
+                        ("boundary", "exchange", "glucose_exchange")),
+        },
+        location_path=("boundary", "location"),
+    )
+    ss = spatial.initial_state(n_alive, jax.random.PRNGKey(seed))
+    return spatial, ss
+
+
+def test_agents_deplete_local_field():
+    spatial, ss = make_spatial(d=0.0, sigma=0.0)  # no diffusion, no movement
+    ss2, _ = spatial.run(ss, 10.0, 1.0, emit_every=10)
+    f = np.asarray(ss2.fields[0])
+    assert f.min() < 10.0 - 0.5  # occupied bins drained
+    assert f.max() <= 10.0 + 1e-5  # nothing created
+
+
+def test_mass_conservation_field_plus_internal():
+    """With yield=1, k_consume=0: field loss == total internal pool."""
+    spatial, ss = make_spatial(yield_=1.0, k_consume=0.0, sigma=0.3)
+    total0 = float(spatial.total_field_mass(ss)[0])
+    ss2, _ = spatial.run(ss, 20.0, 1.0, emit_every=20)
+    total1 = float(spatial.total_field_mass(ss2)[0])
+    internal = float(
+        jnp.sum(
+            ss2.colony.agents["cell"]["glucose_internal"]
+            * ss2.colony.alive
+        )
+    )
+    np.testing.assert_allclose(total0 - total1, internal, rtol=1e-3)
+
+
+def test_dead_rows_do_not_uptake():
+    spatial, ss = make_spatial(capacity=64, n_alive=0, d=0.0, sigma=0.0)
+    ss2, _ = spatial.run(ss, 10.0, 1.0, emit_every=10)
+    np.testing.assert_allclose(np.asarray(ss2.fields), 10.0, rtol=1e-6)
+
+
+def test_motility_moves_and_stays_in_domain():
+    spatial, ss = make_spatial(sigma=1.0)
+    loc0 = np.asarray(ss.colony.agents["boundary"]["location"])
+    ss2, _ = spatial.run(ss, 20.0, 1.0, emit_every=20)
+    loc1 = np.asarray(ss2.colony.agents["boundary"]["location"])
+    assert np.any(np.abs(loc1 - loc0) > 0.1)
+    assert loc1.min() >= 0.0 and loc1.max() <= 32.0
+
+
+def test_diffusion_refills_depleted_bins():
+    spatial, ss = make_spatial(d=2.0, sigma=0.0)
+    ss2, _ = spatial.run(ss, 30.0, 1.0, emit_every=30)
+    f = np.asarray(ss2.fields[0])
+    # with diffusion on, drained bins pull from neighbors: the field stays
+    # smoother than the no-diffusion case
+    spatial0, ss0 = make_spatial(d=0.0, sigma=0.0)
+    ss0b, _ = spatial0.run(ss0, 30.0, 1.0, emit_every=30)
+    f0 = np.asarray(ss0b.fields[0])
+    assert f.std() < f0.std()
+
+
+def test_run_is_jittable_and_emits_fields():
+    spatial, ss = make_spatial(capacity=32, n_alive=32, shape=(16, 16))
+    run = jax.jit(lambda s: spatial.run(s, 5.0, 1.0, emit_every=5))
+    ss2, traj = run(ss)
+    assert traj["fields"].shape == (1, 1, 16, 16)
+    assert bool(jnp.all(jnp.isfinite(traj["fields"])))
+
+
+def test_bad_wiring_raises():
+    spatial, _ = make_spatial(capacity=8, n_alive=8, shape=(16, 16))
+    with pytest.raises(ValueError):
+        SpatialColony(
+            spatial.colony,
+            spatial.lattice,
+            field_ports={"glucose": (("nope",), ("boundary", "exchange", "x"))},
+        )
+    with pytest.raises(ValueError):
+        SpatialColony(
+            spatial.colony,
+            spatial.lattice,
+            field_ports={"lactose": (("boundary", "external", "glucose"),
+                                     ("boundary", "exchange", "glucose_exchange"))},
+        )
+
+
+def test_exact_conservation_with_division_and_motility():
+    """Regression (caught in verify): division used to zero the exchange
+    accumulator before the field was debited, and the scatter hit the
+    post-motility bin — both created mass. Field + internal pool must be
+    exactly constant (float32 tolerance) through division epochs."""
+    from lens_tpu.processes.growth import DivideTrigger, Growth
+
+    comp = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport(
+                {"yield_": 1.0, "k_consume": 0.0, "vmax": 0.4}
+            ),
+            "motility": BrownianMotility({"sigma": 0.4, "domain": (16.0, 16.0)}),
+            "growth": Growth({"rate": 0.03}),
+            "divide": DivideTrigger({"threshold": 2.0}),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "motility": {"boundary": ("boundary",)},
+            "growth": {"global": ("global",)},
+            "divide": {"global": ("global",)},
+        },
+    )
+    colony = Colony(comp, capacity=64, division_trigger=("global", "divide"))
+    lattice = Lattice(
+        molecules=["glucose"], shape=(16, 16), size=(16.0, 16.0),
+        diffusion=1.0, initial=10.0, timestep=1.0,
+    )
+    spatial = SpatialColony(
+        colony, lattice,
+        field_ports={"glucose": (("boundary", "external", "glucose"),
+                                 ("boundary", "exchange", "glucose_exchange"))},
+    )
+    ss = spatial.initial_state(4, jax.random.PRNGKey(7))
+    total0 = float(spatial.total_field_mass(ss)[0])
+    ss2, _ = spatial.run(ss, 120.0, 1.0, emit_every=120)
+    assert int(jnp.sum(ss2.colony.alive)) > 8  # divisions happened
+    total1 = float(spatial.total_field_mass(ss2)[0])
+    internal = float(
+        jnp.sum(ss2.colony.agents["cell"]["glucose_internal"] * ss2.colony.alive)
+    )
+    np.testing.assert_allclose(total0, total1 + internal, rtol=2e-5)
