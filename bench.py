@@ -1,0 +1,64 @@
+"""Headline benchmark: agent-steps/sec/chip on the flagship lattice colony.
+
+Measures the BASELINE.json metric — "agent-steps/sec/chip (10k-agent
+E. coli colony, dt=1s)" — on whatever accelerator jax's default backend
+provides (the driver runs this on one real TPU chip). The model is the
+config-2 flagship: Michaelis–Menten transport + growth + division +
+Brownian motility on a 256x256 glucose diffusion lattice, 10,240 agents.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` is measured against the north-star target of 10,000
+agent-steps/sec/chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+NORTH_STAR = 10_000.0  # agent-steps/sec/chip (BASELINE.json north_star)
+
+
+def main() -> None:
+    import jax
+
+    from lens_tpu.models import ecoli_lattice
+
+    capacity = int(os.environ.get("BENCH_AGENTS", 10240))
+    sim_seconds = float(os.environ.get("BENCH_SIM_SECONDS", 32.0))
+    spatial, _ = ecoli_lattice({"capacity": capacity})
+
+    ss = spatial.initial_state(capacity, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def window(state):
+        state, _ = spatial.run(state, sim_seconds, 1.0, emit_every=int(sim_seconds))
+        return state
+
+    # Warm-up: compile + one full window (also primes the persistent cache).
+    ss = jax.block_until_ready(window(ss))
+
+    t0 = time.perf_counter()
+    ss = jax.block_until_ready(window(ss))
+    elapsed = time.perf_counter() - t0
+
+    agent_steps = capacity * sim_seconds  # dt=1s -> one agent-step per sim-sec
+    value = agent_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "agent-steps/sec/chip (10k-agent E. coli colony, dt=1s)",
+                "value": round(value, 1),
+                "unit": "agent-steps/sec/chip",
+                "vs_baseline": round(value / NORTH_STAR, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -252,9 +252,11 @@ class Colony:
             agents, alive = cs_or_agents.agents, cs_or_agents.alive
         else:
             agents = cs_or_agents
-        out: dict = {}
-        for path in self.compartment.emit_paths:
-            out = set_path(out, path, get_path(agents, path))
+            if alive is None:
+                raise ValueError(
+                    "emit(agents_dict) needs the alive mask explicitly"
+                )
+        out = self.compartment.emit(agents)
         out["alive"] = alive
         return out
 

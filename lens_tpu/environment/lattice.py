@@ -150,6 +150,14 @@ class Lattice:
         exchange: [N, M] net flux for the window (positive = secreted into
         the environment). The inner->outer CELL_UPDATE message as one
         scatter. Dead rows are masked out.
+
+        Conservation caveat: the final ``>= 0`` clamp floors overdrawn
+        bins, which CREATES mass (agents already banked their uptake).
+        Overdraw is impossible when gathers use ``share_bins=True`` (each
+        co-located agent sees only its share, and transport caps uptake
+        at what it sees); with ``share_bins=False`` co-located agents can
+        collectively overdraw, so conservation checks only hold in the
+        shared-bin configuration.
         """
         i, j = self.bin_of(locations)
         contrib = exchange * alive[:, None] * self.exchange_scale

@@ -87,6 +87,15 @@ class SpatialColony:
         """Colony rows + uniform fields. Locations default to uniform random
         placement over the domain (live rows only; dead rows parked at 0)."""
         cs = self.colony.initial_state(n_alive, overrides=overrides, key=key)
+        if locations is not None:
+            locations = jnp.asarray(locations)
+            expected = (self.colony.capacity, 2)
+            if locations.shape != expected:
+                raise ValueError(
+                    f"locations has shape {locations.shape}, expected "
+                    f"{expected} (rows for ALL capacity slots, not just "
+                    f"n_alive; dead rows' values are ignored)"
+                )
         if locations is None:
             lkey = jax.random.fold_in(key, 0x10C)
             h, w = self.lattice.size

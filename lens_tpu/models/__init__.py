@@ -1,0 +1,28 @@
+"""Model compositions: pre-wired cell types and colony builders.
+
+The reference ships pre-wired compartments — processes + topology for
+named cell types — in its composites/boot layer (reconstructed:
+``lens/composites/`` / ``lens/environment/boot.py``, SURVEY.md §2
+"Composites"). This package is the rebuild's equivalent: factory functions
+that assemble a ``Compartment`` (and, for spatial models, a
+``SpatialColony``) from a config dict, so experiment configs stay pure
+data.
+"""
+
+from lens_tpu.models.composites import (
+    composite_registry,
+    register_composite,
+    ecoli_lattice,
+    grow_divide,
+    minimal_ode,
+    toggle_colony,
+)
+
+__all__ = [
+    "composite_registry",
+    "register_composite",
+    "ecoli_lattice",
+    "grow_divide",
+    "minimal_ode",
+    "toggle_colony",
+]

@@ -1,0 +1,164 @@
+"""Composite factories: named cell types as (processes, topology) bundles.
+
+Mirrors the reference's composite layer, where boot functions assemble a
+compartment from processes + topology for a named agent type
+(reconstructed: ``lens/environment/boot.py`` agent-type constructors,
+SURVEY.md §1 L5, §2 "Composites"). Factories take a plain config dict
+(deep-merged over defaults, same semantics as process configs) and return
+wired objects, so the experiment layer can treat model choice as data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Tuple
+
+from lens_tpu.colony.colony import Colony
+from lens_tpu.core.engine import Compartment
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.environment.spatial import SpatialColony
+from lens_tpu.processes import (
+    BrownianMotility,
+    DivideTrigger,
+    GlucosePTS,
+    Growth,
+    MichaelisMentenTransport,
+    ToggleSwitch,
+)
+from lens_tpu.utils.dicts import deep_merge
+
+composite_registry: Dict[str, Callable[..., Any]] = {}
+
+
+def register_composite(fn: Callable[..., Any]) -> Callable[..., Any]:
+    composite_registry[fn.__name__] = fn
+    return fn
+
+
+def _cfg(defaults: dict, config: Mapping | None) -> dict:
+    return deep_merge(defaults, config)
+
+
+@register_composite
+def minimal_ode(config: Mapping | None = None) -> Compartment:
+    """Config 0: single-agent glucose-uptake ODE cell (CPU-reference model)."""
+    c = _cfg({"glucose_pts": {}}, config)
+    return Compartment(
+        processes={"glucose_pts": GlucosePTS(c["glucose_pts"])},
+        topology={
+            "glucose_pts": {
+                "internal": ("cell",),
+                "external": ("environment",),
+                "exchange": ("boundary", "exchange"),
+            },
+        },
+    )
+
+
+@register_composite
+def toggle_colony(config: Mapping | None = None) -> Compartment:
+    """Config 1: 4-species toggle-switch expression cell (no lattice)."""
+    c = _cfg({"toggle_switch": {}, "growth": {}, "divide": {}}, config)
+    return Compartment(
+        processes={
+            "toggle_switch": ToggleSwitch(c["toggle_switch"]),
+            "growth": Growth(c["growth"]),
+            "divide_trigger": DivideTrigger(c["divide"]),
+        },
+        topology={
+            "toggle_switch": {"internal": ("cell",)},
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+
+
+@register_composite
+def grow_divide(config: Mapping | None = None) -> Compartment:
+    """Minimal growth+division cell (the division-machinery exerciser)."""
+    c = _cfg({"growth": {}, "divide": {}}, config)
+    return Compartment(
+        processes={
+            "growth": Growth(c["growth"]),
+            "divide_trigger": DivideTrigger(c["divide"]),
+        },
+        topology={
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+
+
+@register_composite
+def ecoli_lattice(
+    config: Mapping | None = None,
+) -> Tuple[SpatialColony, Compartment]:
+    """Config 2 flagship: E. coli-like cells on a diffusion lattice.
+
+    Michaelis–Menten glucose transport + exponential growth + division +
+    Brownian motility, coupled to a shared glucose field. This is the
+    rebuild of the reference's standard lattice experiment (outer lattice
+    agent + N transport/growth inner agents, reconstructed:
+    ``lens/environment/boot.py`` lattice experiment, SURVEY.md §3.1).
+    Returns ``(spatial, compartment)``; build state via
+    ``spatial.initial_state(n_alive, key)``.
+    """
+    c = _cfg(
+        {
+            "capacity": 10240,
+            "shape": (256, 256),
+            "size": None,            # defaults to 10 um bins
+            "diffusion": 600.0,      # um^2/s, glucose-ish
+            "initial_glucose": 10.0,  # mM
+            "timestep": 1.0,
+            "transport": {},
+            "growth": {},
+            "divide": {},
+            "motility": {"sigma": 0.5},
+            "division": True,
+        },
+        config,
+    )
+    processes = {
+        "transport": MichaelisMentenTransport(c["transport"]),
+        "growth": Growth(c["growth"]),
+        "divide_trigger": DivideTrigger(c["divide"]),
+        "motility": BrownianMotility(c["motility"]),
+    }
+    topology = {
+        "transport": {
+            "external": ("boundary", "external"),
+            "internal": ("cell",),
+            "exchange": ("boundary", "exchange"),
+        },
+        "growth": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+        "motility": {"boundary": ("boundary",)},
+    }
+    compartment = Compartment(processes=processes, topology=topology)
+    colony = Colony(
+        compartment,
+        capacity=int(c["capacity"]),
+        division_trigger=("global", "divide") if c["division"] else None,
+    )
+    shape = tuple(c["shape"])
+    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
+    lattice = Lattice(
+        molecules=["glucose"],
+        shape=shape,
+        size=size,
+        diffusion=c["diffusion"],
+        initial=c["initial_glucose"],
+        timestep=c["timestep"],
+    )
+    spatial = SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            "glucose": (
+                ("boundary", "external", "glucose"),
+                ("boundary", "exchange", "glucose_exchange"),
+            ),
+        },
+        location_path=("boundary", "location"),
+    )
+    return spatial, compartment
