@@ -146,10 +146,15 @@ class Colony:
     # -- stepping ------------------------------------------------------------
 
     def step_biology(self, cs: ColonyState, timestep: float) -> ColonyState:
-        """Run every Process on every row (no division, no step counter)."""
+        """Run every Process on every row (no division, no step counter).
+
+        Shape-polymorphic over the row count (``cs.alive.shape[0]``), not
+        pinned to ``self.capacity`` — so the same code steps a per-device
+        block inside ``shard_map`` (lens_tpu.parallel.runner).
+        """
         if self.compartment.has_stochastic:
             step_key = jax.random.fold_in(cs.key, cs.step)
-            agent_keys = jax.random.split(step_key, self.capacity)
+            agent_keys = jax.random.split(step_key, cs.alive.shape[0])
             stepped = jax.vmap(
                 lambda s, k: self.compartment.step(s, timestep, k)
             )(cs.agents, agent_keys)
@@ -214,8 +219,12 @@ class Colony:
         3. Every schema leaf is split by its declared divider into
            (daughter_a, daughter_b) for all rows; daughter A overwrites the
            parent row, daughter B is scattered to the claimed row.
+
+        Shape-polymorphic: ``cap`` is the row count of the arrays passed
+        in, so a shard_map block divides within its own rows (per-shard
+        free-row pools — see lens_tpu.parallel.runner).
         """
-        cap = self.capacity
+        cap = alive.shape[0]
         trig_val = get_path(agents, self.division_trigger)
         triggers = alive & (trig_val > 0)
 

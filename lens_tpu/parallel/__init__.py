@@ -1,0 +1,35 @@
+"""Distributed execution: device meshes, sharding specs, explicit SPMD.
+
+The reference scales by spawning one OS process per cell and wiring them
+through a Kafka/Zookeeper broker (reconstructed: ``lens/actor/shepherd.py``
++ actor topics, SURVEY.md §2 "distributed communication backend"). The
+rebuild's backend is the TPU interconnect itself: a
+``jax.sharding.Mesh`` with two logical axes —
+
+- ``agents``: data parallelism over cells (the agent axis of every
+  stacked state leaf is split across devices);
+- ``space``: domain decomposition of the lattice (field rows split
+  across devices, stencil halos exchanged with ``ppermute``).
+
+Collectives (``psum`` for global occupancy/exchange reduction,
+``all_gather`` for field assembly, ``ppermute`` for halos) ride ICI
+within a slice and DCN across slices — there is no broker tier at all.
+"""
+
+from lens_tpu.parallel.mesh import (
+    colony_pspecs,
+    make_mesh,
+    mesh_shardings,
+    spatial_pspecs,
+)
+from lens_tpu.parallel.halo import diffuse_halo
+from lens_tpu.parallel.runner import ShardedSpatialColony
+
+__all__ = [
+    "make_mesh",
+    "mesh_shardings",
+    "colony_pspecs",
+    "spatial_pspecs",
+    "diffuse_halo",
+    "ShardedSpatialColony",
+]

@@ -1,0 +1,60 @@
+"""Halo-exchange diffusion: the spatially sharded stencil.
+
+Each device owns a horizontal strip ``[M, H/n, W]`` of the field. Every
+FTCS substep needs one row of neighbor data on each side, exchanged with
+``lax.ppermute`` over the ``space`` mesh axis — the rebuild's moral
+equivalent of context/sequence-parallel ring exchange (SURVEY.md §5
+"long-context"), and the explicit-collective replacement for the halo
+traffic XLA inserts on the auto-partitioned path.
+
+Global boundaries stay Neumann (edge-clamped), matching
+``ops.diffusion._neumann_laplacian`` bit-for-bit: the first/last shard
+substitutes its own edge row for the missing halo.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def diffuse_halo(
+    strip: jnp.ndarray,
+    alpha: jnp.ndarray,
+    n_substeps: int,
+    axis_name: str,
+    n_shards: int,
+) -> jnp.ndarray:
+    """FTCS substeps on a field strip with ppermute halo exchange.
+
+    Must run inside shard_map with ``axis_name`` a mesh axis of size
+    ``n_shards`` (static). strip: [M, H_local, W]; alpha: [M].
+
+    Strips are ordered by ``axis_index``: shard i owns global rows
+    [i*H_local, (i+1)*H_local).
+    """
+    a = alpha.reshape(-1, 1, 1)
+    idx = lax.axis_index(axis_name)
+    send_down = [(i, i + 1) for i in range(n_shards - 1)]  # my last row -> i+1's top halo
+    send_up = [(i + 1, i) for i in range(n_shards - 1)]    # my first row -> i-1's bottom halo
+
+    def substep(_, f):
+        if n_shards > 1:
+            top_halo = lax.ppermute(f[:, -1:, :], axis_name, send_down)
+            bottom_halo = lax.ppermute(f[:, :1, :], axis_name, send_up)
+        else:
+            top_halo = jnp.zeros_like(f[:, :1, :])
+            bottom_halo = jnp.zeros_like(f[:, -1:, :])
+        # Global Neumann boundary: edge shards clamp to their own edge row
+        # (ppermute leaves non-receivers zero-filled, so overwrite).
+        top_halo = jnp.where(idx == 0, f[:, :1, :], top_halo)
+        bottom_halo = jnp.where(idx == n_shards - 1, f[:, -1:, :], bottom_halo)
+
+        up = jnp.concatenate([top_halo, f[:, :-1, :]], axis=1)
+        down = jnp.concatenate([f[:, 1:, :], bottom_halo], axis=1)
+        left = jnp.concatenate([f[:, :, :1], f[:, :, :-1]], axis=2)
+        right = jnp.concatenate([f[:, :, 1:], f[:, :, -1:]], axis=2)
+        return f + a * (up + down + left + right - 4.0 * f)
+
+    return lax.fori_loop(0, n_substeps, substep, strip)

@@ -1,0 +1,82 @@
+"""Mesh construction and sharding specs for colony/spatial state.
+
+One place defines how simulation state maps onto devices, so the jit
+(auto-partitioned) path, the shard_map (explicit-collective) path, and
+the driver's multichip dry run all agree. Replaces the reference's
+"which host runs which agent process" bookkeeping in the shepherd
+(reconstructed: ``lens/actor/shepherd.py``, SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AGENTS_AXIS = "agents"
+SPACE_AXIS = "space"
+
+
+def make_mesh(
+    n_agents: Optional[int] = None,
+    n_space: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A 2D (agents x space) mesh over ``devices`` (default: all).
+
+    ``n_agents`` defaults to ``len(devices) // n_space``. Either axis may
+    be 1 (pure agent-DP or pure spatial decomposition).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_agents is None:
+        if len(devices) % n_space:
+            raise ValueError(f"{len(devices)} devices not divisible by n_space={n_space}")
+        n_agents = len(devices) // n_space
+    n = n_agents * n_space
+    if n > len(devices):
+        raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    return Mesh(
+        np.asarray(devices[:n]).reshape(n_agents, n_space),
+        axis_names=(AGENTS_AXIS, SPACE_AXIS),
+    )
+
+
+def colony_pspecs(colony_state) -> "jax.tree_util.PyTreeDef":
+    """PartitionSpecs for a ColonyState: agent leaves split on the agent
+    axis, PRNG key and step counter replicated."""
+    agents = jax.tree.map(
+        lambda leaf: P(AGENTS_AXIS, *([None] * (leaf.ndim - 1))),
+        colony_state.agents,
+    )
+    return type(colony_state)(
+        agents=agents, alive=P(AGENTS_AXIS), key=P(), step=P()
+    )
+
+
+def spatial_pspecs(spatial_state) -> "jax.tree_util.PyTreeDef":
+    """PartitionSpecs for a SpatialState: colony as above; fields [M, H, W]
+    split along H on the space axis (replicated across the agent axis)."""
+    return type(spatial_state)(
+        colony=colony_pspecs(spatial_state.colony),
+        fields=P(None, SPACE_AXIS, None),
+    )
+
+
+def mesh_shardings(mesh: Mesh, pspecs):
+    """Turn a pytree of PartitionSpecs into NamedShardings on ``mesh``."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def validate_divisible(capacity: int, field_h: int, mesh: Mesh) -> None:
+    n_a = mesh.shape[AGENTS_AXIS]
+    n_s = mesh.shape[SPACE_AXIS]
+    if capacity % n_a:
+        raise ValueError(f"capacity {capacity} not divisible by agents axis {n_a}")
+    if field_h % n_s:
+        raise ValueError(f"field height {field_h} not divisible by space axis {n_s}")

@@ -1,0 +1,218 @@
+"""ShardedSpatialColony: the explicit-collective SPMD colony step.
+
+The same gather -> biology -> scatter -> division -> diffusion sequence as
+``environment.spatial.SpatialColony.step`` (which replaces the reference's
+Kafka exchange window, SURVEY.md §3.2), but written as a ``shard_map``
+program over a 2D (agents x space) mesh with every cross-device movement
+an explicit XLA collective:
+
+- field strips assemble with ``all_gather`` over the space axis;
+- bin occupancy and exchange deltas reduce with ``psum`` over the agent
+  axis (global occupancy is what keeps shared-bin mass conservation
+  exact across shards);
+- diffusion halos move with ``ppermute`` (parallel.halo);
+- division is per-shard: each device's block of rows has its own
+  free-row pool, so row activation never crosses a shard boundary
+  (capacity pressure is felt per shard, not globally — by design).
+
+PRNG discipline: the ColonyState key stays replicated; every stochastic
+use folds in the shard's ``axis_index`` so shards draw independent
+streams while the stored key advances identically everywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from lens_tpu.core.schedule import scan_schedule
+from lens_tpu.environment.spatial import SpatialColony, SpatialState
+from lens_tpu.parallel.mesh import (
+    AGENTS_AXIS,
+    SPACE_AXIS,
+    mesh_shardings,
+    spatial_pspecs,
+    validate_divisible,
+)
+from lens_tpu.utils.dicts import get_path, set_path
+
+
+class ShardedSpatialColony:
+    """Wraps a SpatialColony with a mesh-sharded step/run.
+
+    The wrapped ``spatial`` provides all wiring (field ports, location
+    path, share_bins) and the per-block biology; this class only owns the
+    collectives. Deterministic composites produce trajectories equal to
+    the unsharded path (tested); stochastic composites draw per-shard
+    streams, so trajectories differ from unsharded by PRNG layout only.
+    """
+
+    def __init__(self, spatial: SpatialColony, mesh: Mesh):
+        validate_divisible(
+            spatial.colony.capacity, spatial.lattice.shape[0], mesh
+        )
+        self.spatial = spatial
+        self.mesh = mesh
+        self.n_space = mesh.shape[SPACE_AXIS]
+        self._step = None  # built lazily (needs an example state's pspecs)
+
+    # -- construction --------------------------------------------------------
+
+    def initial_state(self, n_alive: int, key, **kwargs) -> SpatialState:
+        """Build on host, then place per the mesh sharding layout."""
+        ss = self.spatial.initial_state(n_alive, key, **kwargs)
+        return jax.device_put(
+            ss, mesh_shardings(self.mesh, spatial_pspecs(ss))
+        )
+
+    # -- the SPMD step -------------------------------------------------------
+
+    def _block_step(self, ss: SpatialState, timestep: float) -> SpatialState:
+        """Per-device block program. Runs inside shard_map."""
+        spatial, lattice, colony = self.spatial, self.spatial.lattice, self.spatial.colony
+        cs, strip = ss.colony, ss.fields
+        a_idx = lax.axis_index(AGENTS_AXIS)
+        s_idx = lax.axis_index(SPACE_AXIS)
+        h_local = strip.shape[1]
+
+        # Assemble the full field: place the strip in a zero canvas and
+        # psum over the space axis (an all-gather in psum clothing; psum
+        # lets the VMA checker prove the result is space-invariant).
+        m, _, w = strip.shape
+        h_full = h_local * self.n_space
+        full_fields = lax.psum(
+            lax.dynamic_update_slice_in_dim(
+                jnp.zeros((m, h_full, w), strip.dtype), strip, s_idx * h_local, axis=1
+            ),
+            SPACE_AXIS,
+        )  # [M, H, W]
+        locations = get_path(cs.agents, spatial.location_path)
+        i, j = lattice.bin_of(locations)
+
+        # 1. gather local concentrations, with GLOBAL occupancy (psum over
+        # the agent axis) so shared-bin accounting spans shards
+        local = full_fields[:, i, j].T  # [rows, M]
+        if spatial.share_bins:
+            occ = lax.psum(
+                lattice.occupancy(locations, cs.alive), AGENTS_AXIS
+            )
+            local = local / (
+                jnp.maximum(occ[i, j], 1.0)[:, None] * lattice.exchange_scale
+            )
+        agents = cs.agents
+        for mol, port in spatial.field_ports.items():
+            col = local[:, lattice.index(mol)]
+            prev = get_path(agents, port.local)
+            agents = set_path(agents, port.local, jnp.where(cs.alive, col, prev))
+        cs = cs._replace(agents=agents)
+
+        # 2. biology on this block; stochastic draws fold in the shard id
+        shard_key = jax.random.fold_in(cs.key, a_idx)
+        cs = colony.step_biology(cs._replace(key=shard_key), timestep)
+        cs = cs._replace(key=ss.colony.key)
+
+        # 3. scatter exchanges into PRE-STEP bins; reduce over agent shards
+        agents = cs.agents
+        rows = cs.alive.shape[0]
+        exchange = jnp.stack(
+            [
+                get_path(agents, spatial.field_ports[mol].exchange)
+                if mol in spatial.field_ports
+                else jnp.zeros(rows)
+                for mol in lattice.molecules
+            ],
+            axis=1,
+        )  # [rows, M]
+        contrib = exchange * cs.alive[:, None] * lattice.exchange_scale
+        delta = (
+            jnp.zeros_like(full_fields).at[:, i, j].add(contrib.T)
+        )
+        delta = lax.psum(delta, AGENTS_AXIS)
+        strip = jnp.maximum(
+            strip + lax.dynamic_slice_in_dim(delta, s_idx * h_local, h_local, axis=1),
+            0.0,
+        )
+        for mol, port in spatial.field_ports.items():
+            agents = set_path(
+                agents, port.exchange,
+                jnp.zeros_like(get_path(agents, port.exchange)),
+            )
+        cs = cs._replace(agents=agents)
+
+        # 4. per-shard division, then clip locations onto the domain
+        if colony.division_trigger is not None:
+            key, sub = jax.random.split(cs.key)
+            sub = jax.random.fold_in(sub, a_idx)
+            d_agents, d_alive = colony._divide(cs.agents, cs.alive, sub)
+            cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
+        agents = cs.agents
+        loc = get_path(agents, spatial.location_path)
+        h, w = lattice.size
+        loc = jnp.clip(
+            loc, jnp.zeros(2, loc.dtype), jnp.asarray([h, w], loc.dtype) - 1e-3
+        )
+        cs = cs._replace(
+            agents=set_path(agents, spatial.location_path, loc),
+            step=cs.step + 1,
+        )
+
+        # 5. diffusion on the strip with ppermute halos
+        from lens_tpu.parallel.halo import diffuse_halo
+
+        strip = diffuse_halo(
+            strip, lattice.alpha, lattice.n_substeps, SPACE_AXIS, self.n_space
+        )
+        return SpatialState(colony=cs, fields=strip)
+
+    def step_fn(self, example: SpatialState, timestep: float):
+        """Build the jitted shard_map step for states shaped like ``example``."""
+        if abs(timestep - self.spatial.lattice.timestep) > 1e-9:
+            raise ValueError(
+                f"timestep={timestep} != lattice.timestep="
+                f"{self.spatial.lattice.timestep}: the lattice precomputes "
+                f"its diffusion substeps — construct it with the run timestep"
+            )
+        specs = spatial_pspecs(example)
+        body = jax.shard_map(
+            partial(self._block_step, timestep=timestep),
+            mesh=self.mesh,
+            in_specs=(specs,),
+            out_specs=specs,
+        )
+        return jax.jit(body)
+
+    def step(self, ss: SpatialState, timestep: float) -> SpatialState:
+        if self._step is None:
+            self._step = self.step_fn(ss, timestep)
+            self._step_dt = timestep
+        elif self._step_dt != timestep:
+            raise ValueError("timestep changed between step() calls; rebuild via step_fn")
+        return self._step(ss)
+
+    def run(
+        self,
+        ss: SpatialState,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+    ) -> Tuple[SpatialState, dict]:
+        """Scan the sharded step; emits slice the sharded state directly
+        (XLA propagates the layout — no host round-trips inside the loop)."""
+        step = self.step_fn(ss, timestep)
+
+        def emit_fn(carry):
+            emit = self.spatial.colony.emit(carry.colony)
+            emit["fields"] = carry.fields
+            return emit
+
+        run = jax.jit(
+            lambda s: scan_schedule(
+                step, emit_fn, s, total_time, timestep, emit_every
+            )
+        )
+        return run(ss)
