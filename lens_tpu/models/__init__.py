@@ -14,6 +14,7 @@ from lens_tpu.models.composites import (
     register_composite,
     ecoli_lattice,
     grow_divide,
+    hybrid_cell,
     minimal_ode,
     toggle_colony,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "register_composite",
     "ecoli_lattice",
     "grow_divide",
+    "hybrid_cell",
     "minimal_ode",
     "toggle_colony",
 ]

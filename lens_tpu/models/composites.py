@@ -22,6 +22,7 @@ from lens_tpu.processes import (
     GlucosePTS,
     Growth,
     MichaelisMentenTransport,
+    StochasticExpression,
     ToggleSwitch,
 )
 from lens_tpu.utils.dicts import deep_merge
@@ -82,6 +83,43 @@ def grow_divide(config: Mapping | None = None) -> Compartment:
             "divide_trigger": DivideTrigger(c["divide"]),
         },
         topology={
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+
+
+@register_composite
+def hybrid_cell(config: Mapping | None = None) -> Compartment:
+    """Config 4 cell: hybrid tau-leap Gillespie + ODE per agent.
+
+    Stochastic gene expression (discrete counts, tau-leaping) runs beside
+    deterministic glucose-uptake ODE kinetics and growth/division in the
+    same compartment — the engine's per-step merge is what couples the
+    two integrators (the reference runs mixed ODE/stochastic process sets
+    the same way, reconstructed: SURVEY.md §2 process inventory).
+
+    Mixed-species colonies: override the ``rates`` store per-agent at
+    ``Colony.initial_state`` (see StochasticExpression docstring).
+    """
+    c = _cfg(
+        {"expression": {}, "glucose_pts": {}, "growth": {}, "divide": {}},
+        config,
+    )
+    return Compartment(
+        processes={
+            "expression": StochasticExpression(c["expression"]),
+            "glucose_pts": GlucosePTS(c["glucose_pts"]),
+            "growth": Growth(c["growth"]),
+            "divide_trigger": DivideTrigger(c["divide"]),
+        },
+        topology={
+            "expression": {"counts": ("counts",), "rates": ("rates",)},
+            "glucose_pts": {
+                "internal": ("cell",),
+                "external": ("environment",),
+                "exchange": ("boundary", "exchange"),
+            },
             "growth": {"global": ("global",)},
             "divide_trigger": {"global": ("global",)},
         },

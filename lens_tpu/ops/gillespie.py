@@ -1,0 +1,99 @@
+"""Stochastic chemical kinetics: fixed-shape tau-leaping (+ exact SSA oracle).
+
+The reference's stochastic expression processes draw discrete reaction
+events per timestep (reconstructed: ``lens/processes/`` stochastic
+transcription/translation modules, SURVEY.md §2 "Gene expression
+processes"). Exact Gillespie SSA is shape-hostile on TPU — each step
+fires ONE reaction at a data-dependent time — so the device path is
+**tau-leaping** (Gillespie 2001): within a leap ``tau``, each reaction
+channel fires ``Poisson(a_r(x) * tau)`` times, all channels at once,
+fixed shapes throughout (SURVEY.md §7 "Gillespie on TPU").
+
+Negativity control: candidate event counts are capped per reaction by the
+firings its consumed species can support from the pre-leap state
+(``floor(x_s / |nu_rs|)`` min over consumed species). Concurrent
+reactions draining the same species can still jointly overshoot, so a
+final clamp floors counts at zero; shrink ``tau`` (more substeps) until
+the cap/clamp rate is negligible — the tests quantify the resulting bias
+against exact SSA and analytic stationary moments.
+
+``ssa_exact`` is a host-side numpy oracle (the reference-fidelity
+implementation tests compare against); never call it in device code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+PropensityFn = Callable[[Array], Array]  # counts [S] -> propensities [R]
+
+
+def tau_leap_step(
+    key: Array,
+    counts: Array,
+    stoich: Array,
+    propensity_fn: PropensityFn,
+    tau: Array | float,
+) -> Array:
+    """One tau-leap: counts [S] -> counts [S]. Pure, jit/vmap-safe.
+
+    stoich: [R, S] net change per firing of each reaction.
+    """
+    a = propensity_fn(counts)  # [R]
+    events = jax.random.poisson(key, jnp.maximum(a, 0.0) * tau)  # [R] int
+    events = events.astype(jnp.float32)
+    # Cap each channel by what its consumed species allow (pre-leap).
+    consumed = jnp.maximum(-stoich, 0.0)  # [R, S] units consumed per firing
+    supportable = jnp.where(
+        consumed > 0, counts[None, :] / jnp.maximum(consumed, 1e-12), jnp.inf
+    )  # [R, S]
+    max_fire = jnp.floor(jnp.min(supportable, axis=1))  # [R]
+    events = jnp.minimum(events, max_fire)
+    new = counts + events @ stoich
+    return jnp.maximum(new, 0.0)
+
+
+def tau_leap_window(
+    key: Array,
+    counts: Array,
+    stoich: Array,
+    propensity_fn: PropensityFn,
+    timestep: Array | float,
+    n_substeps: int,
+) -> Array:
+    """Advance ``timestep`` in ``n_substeps`` leaps via lax.scan."""
+    tau = timestep / n_substeps
+    keys = jax.random.split(key, n_substeps)
+
+    def body(c, k):
+        return tau_leap_step(k, c, stoich, propensity_fn, tau), None
+
+    out, _ = jax.lax.scan(body, counts, keys)
+    return out
+
+
+def ssa_exact(
+    rng: np.random.Generator,
+    counts: np.ndarray,
+    stoich: np.ndarray,
+    propensity_fn: Callable[[np.ndarray], np.ndarray],
+    t_end: float,
+) -> np.ndarray:
+    """Exact Gillespie direct method (host-side numpy oracle for tests)."""
+    x = np.asarray(counts, dtype=np.float64).copy()
+    t = 0.0
+    while True:
+        a = np.maximum(np.asarray(propensity_fn(x), dtype=np.float64), 0.0)
+        a0 = a.sum()
+        if a0 <= 0:
+            return x
+        t += rng.exponential(1.0 / a0)
+        if t >= t_end:
+            return x
+        r = rng.choice(len(a), p=a / a0)
+        x = np.maximum(x + stoich[r], 0.0)

@@ -59,7 +59,9 @@ class ShardedSpatialColony:
         self.spatial = spatial
         self.mesh = mesh
         self.n_space = mesh.shape[SPACE_AXIS]
-        self._step = None  # built lazily (needs an example state's pspecs)
+        self._step = None      # built lazily (needs an example state's pspecs)
+        self._step_dt = None
+        self._run_cache = {}   # (total_time, timestep, emit_every) -> jitted run
 
     # -- construction --------------------------------------------------------
 
@@ -186,13 +188,16 @@ class ShardedSpatialColony:
         )
         return jax.jit(body)
 
-    def step(self, ss: SpatialState, timestep: float) -> SpatialState:
+    def _cached_step(self, ss: SpatialState, timestep: float):
         if self._step is None:
             self._step = self.step_fn(ss, timestep)
             self._step_dt = timestep
         elif self._step_dt != timestep:
-            raise ValueError("timestep changed between step() calls; rebuild via step_fn")
-        return self._step(ss)
+            raise ValueError("timestep changed between calls; rebuild via step_fn")
+        return self._step
+
+    def step(self, ss: SpatialState, timestep: float) -> SpatialState:
+        return self._cached_step(ss, timestep)(ss)
 
     def run(
         self,
@@ -202,17 +207,23 @@ class ShardedSpatialColony:
         emit_every: int = 1,
     ) -> Tuple[SpatialState, dict]:
         """Scan the sharded step; emits slice the sharded state directly
-        (XLA propagates the layout — no host round-trips inside the loop)."""
-        step = self.step_fn(ss, timestep)
+        (XLA propagates the layout — no host round-trips inside the loop).
+        Compiled programs are cached per (total_time, timestep, emit_every),
+        sharing the cached step with ``step()``."""
+        step = self._cached_step(ss, timestep)
+        cache_key = (total_time, timestep, emit_every)
+        run = self._run_cache.get(cache_key)
+        if run is None:
 
-        def emit_fn(carry):
-            emit = self.spatial.colony.emit(carry.colony)
-            emit["fields"] = carry.fields
-            return emit
+            def emit_fn(carry):
+                emit = self.spatial.colony.emit(carry.colony)
+                emit["fields"] = carry.fields
+                return emit
 
-        run = jax.jit(
-            lambda s: scan_schedule(
-                step, emit_fn, s, total_time, timestep, emit_every
+            run = jax.jit(
+                lambda s: scan_schedule(
+                    step, emit_fn, s, total_time, timestep, emit_every
+                )
             )
-        )
+            self._run_cache[cache_key] = run
         return run(ss)

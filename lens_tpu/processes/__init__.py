@@ -27,6 +27,9 @@ from lens_tpu.processes.mm_transport import (  # noqa: E402
     BrownianMotility,
     MichaelisMentenTransport,
 )
+from lens_tpu.processes.stochastic_expression import (  # noqa: E402
+    StochasticExpression,
+)
 
 __all__ = [
     "process_registry",
@@ -37,4 +40,5 @@ __all__ = [
     "DivideTrigger",
     "MichaelisMentenTransport",
     "BrownianMotility",
+    "StochasticExpression",
 ]

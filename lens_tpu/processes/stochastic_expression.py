@@ -1,0 +1,104 @@
+"""Stochastic gene expression — tau-leap transcription/translation/decay.
+
+Fills the reference's stochastic expression slot (reconstructed:
+``lens/processes/`` minimal transcription/translation/degradation
+modules, SURVEY.md §2 "Gene expression processes") with the TPU-native
+tau-leap kernel (ops.gillespie). Benchmark config 4 (BASELINE.json):
+"100k mixed-species colony, hybrid tau-leap Gillespie + ODE per agent" —
+this is the Gillespie half of that hybrid.
+
+Reaction network (counts, one gene):
+
+    gene      --k_tx-->   gene + mRNA        (transcription)
+    mRNA      --k_tl-->   mRNA + protein     (translation)
+    mRNA      --d_m-->    0                  (mRNA decay)
+    protein   --d_p-->    0                  (protein decay)
+
+**Mixed-species colonies without branching:** the kinetic rates are
+declared as *state variables* (``_updater: null`` — constants the process
+reads but never writes), not static config. A colony overrides them
+per-agent at ``initial_state`` (a [capacity]-shaped array), so one SPMD
+program steps a population whose agents carry different parameters —
+the rebuild's answer to the reference running different process configs
+in different OS processes (SURVEY.md §7 "heterogeneity under SPMD").
+Stationary anchors for tests: mRNA ~ Poisson(k_tx/d_m);
+E[protein] = k_tx k_tl / (d_m d_p).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.ops.gillespie import tau_leap_window
+from lens_tpu.processes import register
+
+# stoichiometry [R=4, S=2]; species order: (mRNA, protein)
+_STOICH = jnp.asarray(
+    [
+        [1.0, 0.0],   # transcription
+        [0.0, 1.0],   # translation
+        [-1.0, 0.0],  # mRNA decay
+        [0.0, -1.0],  # protein decay
+    ]
+)
+
+
+@register
+class StochasticExpression(Process):
+    name = "stochastic_expression"
+    stochastic = True
+
+    defaults = {
+        "k_tx": 0.5,   # transcripts/s (default; per-agent override via state)
+        "k_tl": 2.0,   # proteins per mRNA per s
+        "d_m": 0.1,    # 1/s mRNA decay
+        "d_p": 0.02,   # 1/s protein decay
+        "substeps": 10,
+    }
+
+    def ports_schema(self):
+        c = self.config
+        count = lambda: {
+            "_default": 0.0,
+            "_updater": "nonnegative_accumulate",
+            "_divider": "binomial",
+        }
+        rate = lambda default: {
+            "_default": float(default),
+            "_updater": "null",     # read-only: the per-agent parameter trick
+            "_divider": "copy",
+            "_emit": False,
+        }
+        return {
+            "counts": {"mrna": count(), "protein": count()},
+            "rates": {
+                "k_tx": rate(c["k_tx"]),
+                "k_tl": rate(c["k_tl"]),
+                "d_m": rate(c["d_m"]),
+                "d_p": rate(c["d_p"]),
+            },
+        }
+
+    def next_update(self, timestep, states, key=None):
+        counts = jnp.stack(
+            [states["counts"]["mrna"], states["counts"]["protein"]]
+        )
+        r = states["rates"]
+
+        def propensities(x):
+            m, p = x[0], x[1]
+            return jnp.stack(
+                [r["k_tx"], r["k_tl"] * m, r["d_m"] * m, r["d_p"] * p]
+            )
+
+        new = tau_leap_window(
+            key, counts, _STOICH, propensities, timestep,
+            int(self.config["substeps"]),
+        )
+        return {
+            "counts": {
+                "mrna": new[0] - counts[0],
+                "protein": new[1] - counts[1],
+            },
+        }

@@ -1,0 +1,119 @@
+"""Stochastic kinetics: tau-leap vs exact SSA vs analytic moments (config 4).
+
+Correctness model (SURVEY.md §7 "Gillespie on TPU"): the device path is
+tau-leaping, validated against (a) closed-form stationary moments of the
+expression network and (b) the exact Gillespie direct-method oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.colony import Colony
+from lens_tpu.models import hybrid_cell
+from lens_tpu.ops.gillespie import ssa_exact, tau_leap_window
+
+
+# birth-death: 0 --k--> X --gamma--> 0; stationary X ~ Poisson(k/gamma)
+_BD_STOICH = jnp.asarray([[1.0], [-1.0]])
+
+
+def _bd_propensity(k, gamma):
+    return lambda x: jnp.stack([jnp.asarray(k), gamma * x[0]])
+
+
+def test_tau_leap_birth_death_stationary_moments():
+    """Ensemble mean AND variance match Poisson(k/gamma) stationary law."""
+    k, gamma = 8.0, 0.4  # stationary mean = var = 20
+    n_agents = 2048
+    keys = jax.random.split(jax.random.PRNGKey(0), n_agents)
+
+    @jax.jit
+    @jax.vmap
+    def run(key):
+        # 60 s, tau = 0.25 s: well past the 1/gamma = 2.5 s relaxation time
+        return tau_leap_window(
+            key, jnp.asarray([0.0]), _BD_STOICH,
+            _bd_propensity(k, gamma), 60.0, 240,
+        )[0]
+
+    x = np.asarray(run(keys))
+    mean, var = x.mean(), x.var()
+    assert abs(mean - 20.0) < 0.5, mean
+    assert abs(var - 20.0) < 2.5, var
+
+
+def test_tau_leap_matches_exact_ssa():
+    """Tau-leap ensemble mean vs the exact direct-method oracle."""
+    k, gamma = 3.0, 0.3
+    t_end = 12.0
+    rng = np.random.default_rng(7)
+    stoich_np = np.asarray([[1.0], [-1.0]])
+
+    def prop_np(x):
+        return np.asarray([k, gamma * x[0]])
+
+    exact = np.asarray(
+        [ssa_exact(rng, np.zeros(1), stoich_np, prop_np, t_end)[0]
+         for _ in range(400)]
+    )
+
+    keys = jax.random.split(jax.random.PRNGKey(1), 2048)
+
+    @jax.jit
+    @jax.vmap
+    def run(key):
+        return tau_leap_window(
+            key, jnp.asarray([0.0]), _BD_STOICH,
+            _bd_propensity(k, gamma), t_end, 120,
+        )[0]
+
+    leap = np.asarray(run(keys))
+    # transient at t=12: mean = (k/g)(1 - exp(-g t)) = 9.73
+    expected = (k / gamma) * (1 - np.exp(-gamma * t_end))
+    assert abs(exact.mean() - expected) < 0.6, exact.mean()
+    assert abs(leap.mean() - exact.mean()) < 0.6, (leap.mean(), exact.mean())
+
+
+def test_tau_leap_never_negative():
+    """Aggressive decay + big tau: the cap/clamp keeps counts >= 0."""
+    stoich = jnp.asarray([[-3.0]])
+    prop = lambda x: jnp.stack([10.0 * x[0]])
+    keys = jax.random.split(jax.random.PRNGKey(2), 512)
+    out = jax.vmap(
+        lambda k: tau_leap_window(k, jnp.asarray([5.0]), stoich, prop, 4.0, 4)
+    )(keys)
+    assert float(jnp.min(out)) >= 0.0
+
+
+def test_hybrid_colony_mixed_species():
+    """Config 4 shape: one SPMD colony, two species with different k_tx
+    (parameters-as-state), hybrid ODE+tau-leap, protein means separate."""
+    # growth fast enough that cells actually divide within the run
+    comp = hybrid_cell({"expression": {"d_p": 0.1}, "growth": {"rate": 0.01}})
+    capacity = 256
+    colony = Colony(comp, capacity, division_trigger=("global", "divide"))
+    # species A (rows < 128): k_tx = 0.2; species B: k_tx = 2.0
+    k_tx = jnp.where(jnp.arange(capacity) < 128, 0.2, 2.0)
+    n_alive = 200
+    cs = colony.initial_state(
+        n_alive,
+        overrides={"rates": {"k_tx": k_tx}},
+        key=jax.random.PRNGKey(3),
+    )
+    out, traj = jax.jit(
+        lambda s: colony.run(s, 120.0, 1.0, emit_every=120)
+    )(cs)
+
+    alive = np.asarray(out.alive)
+    assert alive.sum() > n_alive, "expected divisions (exercises dividers)"
+    protein = np.asarray(out.agents["counts"]["protein"])
+    glucose = np.asarray(out.agents["cell"]["glucose_internal"])
+    assert np.isfinite(protein).all() and np.isfinite(glucose).all()
+    mean_a = protein[:128][alive[:128]].mean()
+    mean_b = protein[128:][alive[128:]].mean()
+    # E[p] = k_tx*k_tl/(d_m*d_p): A -> 40, B -> 400
+    assert mean_a < 100 < mean_b, (mean_a, mean_b)
+    assert glucose[alive].min() > 0.0  # the ODE half ran too
+    # binomial divider kept counts integral through the divisions
+    assert np.allclose(protein, np.round(protein))
