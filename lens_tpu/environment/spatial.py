@@ -37,10 +37,15 @@ class SpatialState(NamedTuple):
 
 
 class FieldPort(NamedTuple):
-    """Wiring of one lattice molecule into the agent state tree."""
+    """Wiring of one lattice molecule into the agent state tree.
 
-    local: Path      # agent path overwritten with the bin concentration
-    exchange: Path   # agent path accumulating net secretion (consumed)
+    ``exchange`` may be ``None`` for sense-only coupling (e.g. a
+    chemoreceptor reading an attractant it does not consume): the gather
+    still runs, the scatter is skipped.
+    """
+
+    local: Path               # agent path overwritten with the bin concentration
+    exchange: Optional[Path]  # agent path accumulating net secretion (or None)
 
 
 class SpatialColony:
@@ -69,9 +74,13 @@ class SpatialColony:
         for mol, port in field_ports.items():
             if mol not in lattice.molecules:
                 raise ValueError(f"molecule {mol!r} not on the lattice")
-            port = FieldPort(normalize_path(port[0]), normalize_path(port[1]))
+            local, exchange = port[0], port[1]
+            port = FieldPort(
+                normalize_path(local),
+                normalize_path(exchange) if exchange is not None else None,
+            )
             for path in port:
-                if path not in known:
+                if path is not None and path not in known:
                     raise ValueError(f"field port path {path} not in schema")
             self.field_ports[mol] = port
 
@@ -126,13 +135,24 @@ class SpatialColony:
         cs, fields = ss
         locations = get_path(cs.agents, self.location_path)
 
-        # 1. gather: overwrite each agent's local-env variables (bin-shared:
-        # co-located agents split the bin, so uptake cannot overdraw it)
-        local = self.lattice.local_concentrations(
+        # 1. gather: overwrite each agent's local-env variables. Consuming
+        # ports see the bin-SHARED concentration (co-located agents split
+        # the bin, so uptake cannot overdraw it); sense-only ports
+        # (exchange=None) see the RAW bin value — they never debit the
+        # bin, so sharing would just distort sensing with occupancy.
+        local_shared = self.lattice.local_concentrations(
             fields, locations, cs.alive, share_bins=self.share_bins
         )  # [N, M]
+        local_raw = (
+            self.lattice.local_concentrations(
+                fields, locations, cs.alive, share_bins=False
+            )
+            if any(p.exchange is None for p in self.field_ports.values())
+            else local_shared
+        )
         agents = cs.agents
         for mol, port in self.field_ports.items():
+            local = local_raw if port.exchange is None else local_shared
             col = local[:, self.lattice.index(mol)]
             prev = get_path(agents, port.local)
             # dead rows keep their previous value (mask hygiene)
@@ -154,6 +174,7 @@ class SpatialColony:
             [
                 get_path(agents, self.field_ports[mol].exchange)
                 if mol in self.field_ports
+                and self.field_ports[mol].exchange is not None
                 else jnp.zeros(self.colony.capacity)
                 for mol in self.lattice.molecules
             ],
@@ -163,6 +184,8 @@ class SpatialColony:
             fields, locations, exchange, cs.alive
         )
         for mol, port in self.field_ports.items():
+            if port.exchange is None:
+                continue
             agents = set_path(
                 agents,
                 port.exchange,

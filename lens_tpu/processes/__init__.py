@@ -30,6 +30,25 @@ from lens_tpu.processes.mm_transport import (  # noqa: E402
 from lens_tpu.processes.stochastic_expression import (  # noqa: E402
     StochasticExpression,
 )
+from lens_tpu.processes.derivers import (  # noqa: E402
+    DeriveConcentrations,
+    DeriveVolume,
+    DivideCondition,
+    MassGrowth,
+)
+from lens_tpu.processes.chemotaxis import (  # noqa: E402
+    FlagellarMotor,
+    MWCChemoreceptor,
+    RunTumbleMotility,
+)
+from lens_tpu.processes.expression import (  # noqa: E402
+    Complexation,
+    Degradation,
+    Transcription,
+    Translation,
+)
+from lens_tpu.processes.metabolism import Metabolism  # noqa: E402
+from lens_tpu.processes.transport_lookup import TransportLookup  # noqa: E402
 
 __all__ = [
     "process_registry",
@@ -41,4 +60,17 @@ __all__ = [
     "MichaelisMentenTransport",
     "BrownianMotility",
     "StochasticExpression",
+    "DeriveConcentrations",
+    "DeriveVolume",
+    "DivideCondition",
+    "MassGrowth",
+    "FlagellarMotor",
+    "MWCChemoreceptor",
+    "RunTumbleMotility",
+    "Complexation",
+    "Degradation",
+    "Transcription",
+    "Translation",
+    "Metabolism",
+    "TransportLookup",
 ]

@@ -1,0 +1,233 @@
+"""Chemotaxis: MWC chemoreceptor cluster + flagellar motor + run/tumble.
+
+The reference models E. coli chemotaxis as two coupled Processes — a
+Monod–Wyman–Changeux receptor-cluster model producing cluster activity
+from ligand concentration (with slow methylation adaptation), and a
+flagellar-motor process converting activity (a CheY-P proxy) into
+stochastic run/tumble switching — with the actual cell displacement applied
+by the lattice's motility code (reconstructed:
+``lens/processes/…chemoreceptor/motor….py`` and
+``lens/environment/lattice.py`` ``update_locations``, SURVEY.md §2
+"Chemotaxis processes"). The rebuild keeps the same three-stage split but
+makes displacement a Process too (``RunTumbleMotility``) so the
+environment owns geometry only.
+
+TPU notes: the motor's two-state switching is a per-agent Bernoulli draw
+(fixed-shape, ``jax.random``), and adaptation is a single exponential
+relaxation — everything stays branch-free under ``vmap`` across 100k
+agents.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.processes import register
+
+
+@register
+class MWCChemoreceptor(Process):
+    """MWC receptor-cluster activity with methylation adaptation.
+
+    Free-energy model (standard Tar/Tsr MWC form):
+
+        F = N * [ m_eff(methyl) + log( (1 + L/K_off) / (1 + L/K_on) ) ]
+        activity = 1 / (1 + exp(F))
+
+    Methylation relaxes activity toward ``adapted_activity`` with rate
+    ``k_adapt`` — perfect adaptation on timescales >> 1/k_adapt, so the
+    cluster responds to concentration *changes* (temporal gradient
+    sensing), which is what makes run/tumble climb gradients.
+    """
+
+    name = "chemoreceptor"
+
+    defaults = {
+        "n_receptors": 6.0,      # cluster cooperativity
+        "k_off": 0.02,           # mM, dissociation constant (inactive state)
+        "k_on": 0.5,             # mM, dissociation constant (active state)
+        "m_eff_scale": 1.0,      # free-energy per methylation unit (kT)
+        "adapted_activity": 1.0 / 3.0,
+        "k_adapt": 0.1,          # 1/s methylation relaxation rate
+        "molecule": "glucose",   # attractant field name
+    }
+
+    def ports_schema(self):
+        mol = self.config["molecule"]
+        return {
+            "external": {
+                mol: {"_default": 0.1, "_updater": "null", "_divider": "copy"},
+            },
+            "internal": {
+                "methyl": {
+                    "_default": 2.0,
+                    "_updater": "accumulate",
+                    "_divider": "copy",
+                },
+                "chemoreceptor_activity": {
+                    "_default": 1.0 / 3.0,
+                    "_updater": "set",
+                    "_divider": "copy",
+                },
+            },
+        }
+
+    def _activity(self, ligand, methyl):
+        c = self.config
+        ligand = jnp.maximum(ligand, 0.0)
+        # methylation lowers the free energy of the active state
+        f_methyl = 1.0 - 0.5 * methyl * c["m_eff_scale"]
+        f_ligand = jnp.log1p(ligand / c["k_off"]) - jnp.log1p(ligand / c["k_on"])
+        free_energy = c["n_receptors"] * (f_methyl + f_ligand)
+        return 1.0 / (1.0 + jnp.exp(free_energy))
+
+    def next_update(self, timestep, states):
+        c = self.config
+        ligand = states["external"][c["molecule"]]
+        methyl = states["internal"]["methyl"]
+        activity = self._activity(ligand, methyl)
+        # Adaptation: methylation integrates the activity error. dF/dm =
+        # -N*m_eff_scale/2 < 0, so higher methyl -> higher activity; to pull
+        # activity back UP to the setpoint when it is low we must ADD methyl
+        # when activity < adapted_activity.
+        dmethyl = c["k_adapt"] * (c["adapted_activity"] - activity) * timestep
+        return {
+            "internal": {
+                "methyl": dmethyl,
+                "chemoreceptor_activity": activity,
+            },
+        }
+
+
+@register
+class FlagellarMotor(Process):
+    """Two-state motor switching: activity (CheY-P proxy) -> run/tumble.
+
+    ``motor_state`` is 0.0 (run / CCW) or 1.0 (tumble / CW). Switching
+    propensities follow the activity-dependent form: high receptor
+    activity -> high CheY-P -> more CW (tumble). Transitions are sampled
+    per timestep from the exponential waiting-time discretization
+    ``p = 1 - exp(-k dt)`` — a fixed-shape Bernoulli draw per agent.
+    """
+
+    name = "flagellar_motor"
+    stochastic = True
+
+    defaults = {
+        "k_run_to_tumble_max": 2.0,   # 1/s at activity = 1
+        "k_tumble_to_run": 2.0,       # 1/s (mean tumble ~0.5 s)
+        "activity_exponent": 4.0,     # ultrasensitivity of CheY-P -> CW bias
+        "adapted_activity": 1.0 / 3.0,
+    }
+
+    def ports_schema(self):
+        # chemoreceptor_activity is read-only here; its declaration must
+        # match the receptor's (shared-variable declarations must agree —
+        # the engine rejects conflicts, core.engine._build_schema).
+        return {
+            "internal": {
+                "chemoreceptor_activity": {
+                    "_default": 1.0 / 3.0,
+                    "_updater": "set",
+                    "_divider": "copy",
+                },
+                "motor_state": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states, key=None):
+        c = self.config
+        activity = states["internal"]["chemoreceptor_activity"]
+        motor = states["internal"]["motor_state"]
+        # normalized ultrasensitive CW bias: k_max/2 at adapted activity
+        rel = jnp.maximum(activity / c["adapted_activity"], 0.0)
+        k_rt = c["k_run_to_tumble_max"] * (rel**c["activity_exponent"]) / (
+            1.0 + rel ** c["activity_exponent"]
+        )
+        k_tr = c["k_tumble_to_run"]
+        p_switch = jnp.where(
+            motor > 0.5,
+            1.0 - jnp.exp(-k_tr * timestep),
+            1.0 - jnp.exp(-k_rt * timestep),
+        )
+        u = jax.random.uniform(key, jnp.shape(motor))
+        switched = (u < p_switch).astype(jnp.float32)
+        new_motor = jnp.where(switched > 0.5, 1.0 - motor, motor)
+        return {"internal": {"motor_state": new_motor}}
+
+
+@register
+class RunTumbleMotility(Process):
+    """Displacement from the motor state: run straight, tumble reorients.
+
+    Running moves the cell ``speed * dt`` along its heading; tumbling
+    freezes it and draws a fresh uniform heading (plus small rotational
+    diffusion while running). The spatial wrapper clips locations to the
+    lattice domain (geometry lives in the environment, as in the
+    reference).
+    """
+
+    name = "run_tumble_motility"
+    stochastic = True
+
+    defaults = {
+        "speed": 20.0,          # um/s run speed (E. coli-ish)
+        "rot_diffusion": 0.1,   # rad^2/s rotational diffusion while running
+    }
+
+    def ports_schema(self):
+        return {
+            "boundary": {
+                "location": {
+                    "_default": jnp.zeros(2, jnp.float32),
+                    "_updater": "set",
+                    "_divider": "copy",
+                },
+                "heading": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "copy",
+                    "_emit": False,
+                },
+            },
+            "internal": {
+                # read-only view of the motor's variable (declaration
+                # matches FlagellarMotor's — shared paths must agree)
+                "motor_state": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states, key=None):
+        c = self.config
+        loc = states["boundary"]["location"]
+        heading = states["boundary"]["heading"]
+        motor = states["internal"]["motor_state"]
+        k_tumble, k_rot = jax.random.split(key)
+        new_heading_tumble = jax.random.uniform(
+            k_tumble, jnp.shape(heading), minval=0.0, maxval=2.0 * jnp.pi
+        )
+        rot = jnp.sqrt(2.0 * c["rot_diffusion"] * timestep) * jax.random.normal(
+            k_rot, jnp.shape(heading)
+        )
+        running = motor < 0.5
+        heading = jnp.where(running, heading + rot, new_heading_tumble)
+        step = jnp.where(running, c["speed"] * timestep, 0.0)
+        delta = step * jnp.stack([jnp.cos(heading), jnp.sin(heading)])
+        return {
+            "boundary": {
+                "location": loc + delta,
+                "heading": jnp.mod(heading, 2.0 * jnp.pi),
+            },
+        }

@@ -1,0 +1,192 @@
+"""Deriver processes: non-mechanistic bookkeeping after each engine step.
+
+The reference runs small "derive" processes that keep dependent quantities
+consistent — volume from mass, concentrations from counts, the division
+condition (reconstructed: ``lens/processes/derive_*.py``, SURVEY.md §2
+"Derivers"). Derivers subclass :class:`lens_tpu.core.process.Deriver`, so
+the engine runs them after the mechanistic merge, in registration order
+(``Compartment.step``), each seeing the already-merged state.
+
+All leaves they own are ``_updater: set`` — derived state is overwritten,
+never accumulated.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Deriver, Process
+from lens_tpu.processes import register
+from lens_tpu.utils.units import (
+    CELL_DENSITY_FG_PER_FL,
+    counts_to_millimolar,
+    volume_from_mass,
+)
+
+
+@register
+class DeriveVolume(Deriver):
+    """volume (fL) = mass (fg) / density — constant-density geometry.
+
+    Pairs with a mass-accumulating growth process: mechanistic processes
+    add mass; this deriver keeps volume consistent so concentration-based
+    kinetics and the division trigger see up-to-date geometry.
+    """
+
+    name = "derive_volume"
+    defaults = {"density": CELL_DENSITY_FG_PER_FL}  # fg / fL
+
+    def ports_schema(self):
+        # mass is read-only here but its declaration must match the growth
+        # process's (shared-variable declarations must agree)
+        return {
+            "global": {
+                "mass": {
+                    "_default": 330.0,
+                    "_updater": "accumulate",
+                    "_divider": "split",
+                },
+                "volume": {
+                    "_default": 1.0,
+                    "_updater": "set",
+                    "_divider": "split",
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        mass = states["global"]["mass"]
+        return {
+            "global": {"volume": volume_from_mass(mass, self.config["density"])}
+        }
+
+
+@register
+class DeriveConcentrations(Deriver):
+    """concentrations (mM) = counts / (N_A * volume) for listed molecules.
+
+    The bridge between discrete-count processes (stochastic expression,
+    complexation) and concentration-based kinetics (transport, metabolism):
+    counts live in a ``counts`` store, this deriver maintains a parallel
+    ``concentrations`` store.
+    """
+
+    name = "derive_concentrations"
+    defaults = {"molecules": ("protein",)}
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.molecules: Sequence[str] = tuple(self.config["molecules"])
+
+    def ports_schema(self):
+        # counts/volume are read-only here; declarations mirror the
+        # expression processes' count convention and DeriveVolume's volume
+        # so shared-path declarations agree in composites.
+        schema = {
+            "counts": {
+                mol: {
+                    "_default": 0.0,
+                    "_updater": "nonnegative_accumulate",
+                    "_divider": "binomial",
+                }
+                for mol in self.molecules
+            },
+            "global": {
+                "volume": {"_default": 1.0, "_updater": "set", "_divider": "split"},
+            },
+            "concentrations": {
+                mol: {"_default": 0.0, "_updater": "set", "_divider": "copy"}
+                for mol in self.molecules
+            },
+        }
+        return schema
+
+    def next_update(self, timestep, states):
+        volume = states["global"]["volume"]
+        return {
+            "concentrations": {
+                mol: counts_to_millimolar(states["counts"][mol], volume)
+                for mol in self.molecules
+            }
+        }
+
+
+@register
+class DivideCondition(Deriver):
+    """Division condition on an arbitrary global variable (mass or volume).
+
+    Generalizes ``DivideTrigger`` (volume-doubling) to any watched
+    variable/threshold — the reference's division deriver pattern
+    (SURVEY.md §3.3: "division deriver sets trigger (e.g. volume >= 2x)").
+    The colony layer watches the ``divide`` flag for row activation.
+    """
+
+    name = "divide_condition"
+    #: ``updater``/``divider`` declare how the WATCHED variable merges —
+    #: they must mirror the declaration of whichever process owns it
+    #: (e.g. ``updater="set"`` when watching DeriveVolume's volume),
+    #: since shared-path declarations must agree across processes.
+    defaults = {
+        "variable": "mass",
+        "threshold": 660.0,
+        "default": 330.0,
+        "updater": "accumulate",
+        "divider": "split",
+    }
+
+    def ports_schema(self):
+        var = self.config["variable"]
+        return {
+            "global": {
+                var: {
+                    "_default": float(self.config["default"]),
+                    "_updater": self.config["updater"],
+                    "_divider": self.config["divider"],
+                },
+                "divide": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        value = states["global"][self.config["variable"]]
+        return {
+            "global": {
+                "divide": (value >= self.config["threshold"]).astype(jnp.float32)
+            }
+        }
+
+
+@register
+class MassGrowth(Process):
+    """Exponential dry-mass growth (mechanistic counterpart of DeriveVolume).
+
+    Composites that track mass grow it here, then DeriveVolume keeps the
+    geometry consistent: m += m * (exp(r dt) - 1).
+    """
+
+    name = "mass_growth"
+    defaults = {"rate": 0.0005}  # 1/s
+
+    def ports_schema(self):
+        return {
+            "global": {
+                "mass": {
+                    "_default": 330.0,
+                    "_updater": "accumulate",
+                    "_divider": "split",
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        m = states["global"]["mass"]
+        return {
+            "global": {"mass": m * (jnp.exp(self.config["rate"] * timestep) - 1.0)}
+        }

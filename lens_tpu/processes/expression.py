@@ -1,0 +1,216 @@
+"""Deterministic gene-expression processes: transcription, translation,
+degradation, complexation.
+
+The reference carries a family of minimal expression Processes operating on
+molecule counts — transcription (with optional regulation), translation,
+first-order RNA/protein degradation, and stoichiometric complexation, plus
+a polymerization helper (reconstructed: ``lens/processes/`` expression
+modules, SURVEY.md §2 "Gene expression processes"). These are the
+deterministic (mean-field) counterparts of
+:class:`lens_tpu.processes.stochastic_expression.StochasticExpression`;
+composites mix the two freely and the engine's per-step merge couples them.
+
+All four processes share a ``counts`` store convention: every species is a
+real-valued count with ``_updater: nonnegative_accumulate`` and
+``_divider: binomial`` (counts partition stochastically at division).
+
+Gene regulation uses :mod:`lens_tpu.utils.regulation_logic` rules keyed by
+transcript, evaluated against the merged counts view — the rebuild of the
+reference's boolean regulation parser (``lens/utils/regulation_logic.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax.numpy as jnp
+
+from lens_tpu.core.process import Process
+from lens_tpu.processes import register
+from lens_tpu.utils.rate_laws import first_order, hill_repression
+from lens_tpu.utils.regulation_logic import compile_rule
+
+
+def _count_leaf(default=0.0, emit=True):
+    return {
+        "_default": float(default),
+        "_updater": "nonnegative_accumulate",
+        "_divider": "binomial",
+        "_emit": emit,
+    }
+
+
+@register
+class Transcription(Process):
+    """Constitutive/regulated mRNA synthesis (counts/s per gene copy).
+
+    ``rates``: transcript -> synthesis rate (counts/s).
+    ``regulation``: transcript -> boolean rule string over species counts
+    (e.g. ``"not repressor"``); when the rule evaluates False the gene is
+    off. Smooth repression via ``repressors`` (Hill) is also supported for
+    ODE-friendly dynamics.
+    """
+
+    name = "transcription"
+
+    defaults = {
+        "rates": {"mrna": 0.1},            # counts/s
+        "regulation": {},                   # transcript -> rule string
+        "repressors": {},                   # transcript -> (species, K, n)
+    }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        self.transcripts = tuple(self.config["rates"])
+        self._rules = {
+            t: compile_rule(rule) for t, rule in self.config["regulation"].items()
+        }
+        # species referenced by any rule must appear in the ports schema
+        self._rule_inputs = sorted(
+            {dep for rule in self._rules.values() for dep in rule.names}
+        )
+
+    def ports_schema(self):
+        counts = {t: _count_leaf() for t in self.transcripts}
+        for species in self._rule_inputs:
+            counts.setdefault(species, _count_leaf())
+        for t, (species, _, _) in self.config["repressors"].items():
+            counts.setdefault(species, _count_leaf())
+        return {"counts": counts}
+
+    def next_update(self, timestep, states):
+        counts = states["counts"]
+        update = {}
+        for t in self.transcripts:
+            rate = self.config["rates"][t]
+            synthesis = rate * timestep
+            if t in self._rules:
+                on = self._rules[t](counts)
+                synthesis = synthesis * on
+            if t in self.config["repressors"]:
+                species, k, n = self.config["repressors"][t]
+                synthesis = synthesis * hill_repression(
+                    counts[species], 1.0, k, n
+                )
+            update[t] = synthesis
+        return {"counts": update}
+
+
+@register
+class Translation(Process):
+    """Protein synthesis proportional to transcript counts.
+
+    ``pairs``: protein -> (mrna, rate) — each mRNA molecule produces
+    ``rate`` proteins/s.
+    """
+
+    name = "translation"
+
+    defaults = {"pairs": {"protein": ("mrna", 0.05)}}
+
+    def ports_schema(self):
+        counts = {}
+        for protein, (mrna, _) in self.config["pairs"].items():
+            counts[protein] = _count_leaf()
+            counts.setdefault(mrna, _count_leaf())
+        return {"counts": counts}
+
+    def next_update(self, timestep, states):
+        counts = states["counts"]
+        return {
+            "counts": {
+                protein: first_order(rate, counts[mrna]) * timestep
+                for protein, (mrna, rate) in self.config["pairs"].items()
+            }
+        }
+
+
+@register
+class Degradation(Process):
+    """First-order decay of listed species: dN = -k * N * dt."""
+
+    name = "degradation"
+
+    defaults = {"rates": {"mrna": 0.01, "protein": 0.0005}}  # 1/s
+
+    def ports_schema(self):
+        return {"counts": {s: _count_leaf() for s in self.config["rates"]}}
+
+    def next_update(self, timestep, states):
+        counts = states["counts"]
+        return {
+            "counts": {
+                s: -first_order(k, counts[s]) * timestep
+                for s, k in self.config["rates"].items()
+            }
+        }
+
+
+@register
+class Complexation(Process):
+    """Stoichiometric complex formation/dissociation (mass action).
+
+    ``reactions``: complex -> {"subunits": {species: stoich}, "k_on": rate,
+    "k_off": rate}. Forward flux is mass-action in the subunit counts;
+    reverse is first-order in the complex. Fluxes are clamped so no subunit
+    pool goes negative within a step (the counts updater also guards, but
+    clamping here keeps stoichiometric consistency between species).
+    """
+
+    name = "complexation"
+
+    defaults = {
+        "reactions": {
+            "complex": {
+                "subunits": {"monomer_a": 1, "monomer_b": 1},
+                "k_on": 1e-3,
+                "k_off": 1e-4,
+            },
+        },
+    }
+
+    def ports_schema(self):
+        counts = {}
+        for cplx, rxn in self.config["reactions"].items():
+            counts[cplx] = _count_leaf()
+            for species in rxn["subunits"]:
+                counts.setdefault(species, _count_leaf())
+        return {"counts": counts}
+
+    def next_update(self, timestep, states):
+        counts = states["counts"]
+        reactions = self.config["reactions"]
+        # 1. unclamped mass-action forward fluxes
+        forwards = {}
+        for cplx, rxn in reactions.items():
+            forward = rxn["k_on"]
+            for species, stoich in rxn["subunits"].items():
+                forward = forward * jnp.maximum(counts[species], 0.0) ** stoich
+            forwards[cplx] = forward * timestep
+        # 2. joint clamp: reactions SHARING a subunit must not collectively
+        # overdraw it (per-reaction clamping alone lets two reactions each
+        # take the whole pool, and the nonnegative updater would then
+        # fabricate complex mass). Scale every reaction by the tightest of
+        # its subunits' availability ratios; total draw on species s is
+        # then <= demand_s * (pool_s / demand_s) = pool_s.
+        scales = {cplx: 1.0 for cplx in reactions}
+        demand = {}
+        for cplx, rxn in reactions.items():
+            for species, stoich in rxn["subunits"].items():
+                demand[species] = demand.get(species, 0.0) + stoich * forwards[cplx]
+        for species, total in demand.items():
+            pool = jnp.maximum(counts[species], 0.0)
+            ratio = jnp.minimum(pool / jnp.maximum(total, 1e-30), 1.0)
+            for cplx, rxn in reactions.items():
+                if species in rxn["subunits"]:
+                    scales[cplx] = jnp.minimum(scales[cplx], ratio)
+        # 3. net fluxes and stoichiometric bookkeeping
+        update = {s: 0.0 for s in self.ports_schema()["counts"]}
+        for cplx, rxn in reactions.items():
+            forward = forwards[cplx] * scales[cplx]
+            reverse = first_order(rxn["k_off"], counts[cplx]) * timestep
+            net = forward - reverse
+            update[cplx] = update[cplx] + net
+            for species, stoich in rxn["subunits"].items():
+                update[species] = update[species] - stoich * net
+        return {"counts": update}

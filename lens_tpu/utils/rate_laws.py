@@ -1,0 +1,55 @@
+"""Rate-law helpers shared by kinetic processes.
+
+The reference centralizes Michaelis–Menten / Hill / mass-action rate
+construction in its utils so each kinetic Process declares parameters, not
+formulas (reconstructed: ``lens/utils/`` rate-law helpers, SURVEY.md §2
+"Utils"). All helpers here are pure ``jnp`` expressions — safe under
+``jit``/``vmap``/``grad`` — and guard denominators so XLA never sees a
+0/0 (which would poison a whole vmapped batch with NaNs).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def michaelis_menten(s, vmax, km):
+    """v = vmax * s / (km + s), clamped for s <= 0."""
+    s = jnp.maximum(s, 0.0)
+    return vmax * s / (km + s + _EPS)
+
+
+def competitive_inhibition(s, i, vmax, km, ki):
+    """MM rate with competitive inhibitor i: km' = km * (1 + i/ki)."""
+    s = jnp.maximum(s, 0.0)
+    i = jnp.maximum(i, 0.0)
+    return vmax * s / (km * (1.0 + i / (ki + _EPS)) + s + _EPS)
+
+
+def hill(s, vmax, k, n):
+    """Hill activation: v = vmax * s^n / (k^n + s^n)."""
+    s = jnp.maximum(s, 0.0)
+    sn = s**n
+    return vmax * sn / (k**n + sn + _EPS)
+
+
+def hill_repression(s, vmax, k, n):
+    """Hill repression: v = vmax * k^n / (k^n + s^n)."""
+    s = jnp.maximum(s, 0.0)
+    kn = k**n
+    return vmax * kn / (kn + s**n + _EPS)
+
+
+def mass_action(rate, *concentrations):
+    """v = rate * prod(concentrations) (each clamped at 0)."""
+    v = rate
+    for c in concentrations:
+        v = v * jnp.maximum(c, 0.0)
+    return v
+
+
+def first_order(rate, s):
+    """v = rate * s, clamped at 0."""
+    return rate * jnp.maximum(s, 0.0)

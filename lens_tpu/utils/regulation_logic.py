@@ -1,0 +1,198 @@
+"""Boolean regulation-rule parser compiling to jnp-traceable closures.
+
+The reference parses boolean gene/flux regulation rules from its flat-file
+knowledge base — strings like ``"not (glucose external)"`` deciding
+whether a reaction or gene is active (reconstructed:
+``lens/utils/regulation_logic.py``, SURVEY.md §2 "Utils"; the
+Covert-Palsson 2002 regulated-metabolism lineage works exactly this way).
+
+The rebuild compiles each rule ONCE at construction into a pure closure
+``rule(env: Mapping[str, Array]) -> Array`` of soft-boolean floats
+(0.0/1.0), built only from ``jnp`` ops — so rules evaluate inside
+``jit``/``vmap`` with no Python branching on data. Presence thresholds
+turn analog values into booleans: ``x`` is "on" when ``x > threshold``.
+
+Grammar (case-insensitive keywords)::
+
+    rule     := or_expr
+    or_expr  := and_expr ("or" and_expr)*
+    and_expr := not_expr ("and" not_expr)*
+    not_expr := "not" not_expr | atom
+    atom     := "(" or_expr ")" | name | comparison
+    comparison := name (">" | "<" | ">=" | "<=") number
+
+Names may contain letters, digits, ``_``, ``-`` and ``[]`` (compartment
+tags like ``glc[e]``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Mapping, Sequence
+
+import jax.numpy as jnp
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<lparen>\()|(?P<rparen>\))|(?P<op>>=|<=|>|<)"
+    r"|(?P<number>-?\d+(?:\.\d+)?(?:[eE]-?\d+)?)"
+    r"|(?P<name>[A-Za-z_][A-Za-z0-9_\-\[\]]*))"
+)
+
+_KEYWORDS = {"and", "or", "not"}
+
+#: Default presence threshold: a species is "present" when value > this.
+DEFAULT_THRESHOLD = 0.5
+
+
+class Rule:
+    """A compiled regulation rule: callable on a dict of named arrays."""
+
+    def __init__(self, source: str, names: Sequence[str], fn: Callable):
+        self.source = source
+        self.names = tuple(names)
+        self._fn = fn
+
+    def __call__(self, env: Mapping) -> jnp.ndarray:
+        missing = [n for n in self.names if n not in env]
+        if missing:
+            raise KeyError(
+                f"rule {self.source!r} needs species {missing} "
+                f"not present in the evaluation environment"
+            )
+        return self._fn(env)
+
+    def __repr__(self):
+        return f"Rule({self.source!r}, names={self.names})"
+
+
+def _tokenize(text: str) -> List[str]:
+    tokens: List[str] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip():
+                raise ValueError(
+                    f"cannot tokenize rule at {text[pos:]!r} (full rule: {text!r})"
+                )
+            break
+        pos = m.end()
+        for kind in ("lparen", "rparen", "op", "number", "name"):
+            val = m.group(kind)
+            if val is not None:
+                # keywords are case-insensitive; species names keep their case
+                if kind == "name" and val.lower() in _KEYWORDS:
+                    val = val.lower()
+                tokens.append(val)
+                break
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[str], threshold: float):
+        self.tokens = tokens
+        self.pos = 0
+        self.threshold = threshold
+        self.names: List[str] = []
+
+    def peek(self):
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def take(self):
+        tok = self.peek()
+        self.pos += 1
+        return tok
+
+    def parse(self):
+        fn = self.or_expr()
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens in rule: {self.tokens[self.pos:]}")
+        return fn
+
+    def or_expr(self):
+        terms = [self.and_expr()]
+        while self.peek() == "or":
+            self.take()
+            terms.append(self.and_expr())
+        if len(terms) == 1:
+            return terms[0]
+        return lambda env, terms=terms: jnp.clip(
+            sum(t(env) for t in terms), 0.0, 1.0
+        )
+
+    def and_expr(self):
+        terms = [self.not_expr()]
+        while self.peek() == "and":
+            self.take()
+            terms.append(self.not_expr())
+        if len(terms) == 1:
+            return terms[0]
+
+        def all_of(env, terms=terms):
+            out = terms[0](env)
+            for t in terms[1:]:
+                out = out * t(env)
+            return out
+
+        return all_of
+
+    def not_expr(self):
+        if self.peek() == "not":
+            self.take()
+            inner = self.not_expr()
+            return lambda env, inner=inner: 1.0 - inner(env)
+        return self.atom()
+
+    def atom(self):
+        tok = self.peek()
+        if tok == "(":
+            self.take()
+            inner = self.or_expr()
+            if self.take() != ")":
+                raise ValueError("unbalanced parenthesis in rule")
+            return inner
+        if tok is None:
+            raise ValueError("unexpected end of rule")
+        if tok in _KEYWORDS:
+            raise ValueError(f"unexpected keyword {tok!r}")
+        name = self.take()
+        if name not in self.names:
+            self.names.append(name)
+        nxt = self.peek()
+        if nxt in (">", "<", ">=", "<="):
+            op = self.take()
+            num_tok = self.take()
+            try:
+                num = float(num_tok)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"comparison {name} {op} expects a number, got {num_tok!r}"
+                )
+            cmp = {
+                ">": lambda x: x > num,
+                "<": lambda x: x < num,
+                ">=": lambda x: x >= num,
+                "<=": lambda x: x <= num,
+            }[op]
+            return lambda env, name=name, cmp=cmp: jnp.asarray(
+                cmp(env[name]), jnp.float32
+            )
+        thr = self.threshold
+        return lambda env, name=name, thr=thr: jnp.asarray(
+            env[name] > thr, jnp.float32
+        )
+
+
+def compile_rule(source: str, threshold: float = DEFAULT_THRESHOLD) -> Rule:
+    """Compile a boolean rule string into a jnp-traceable :class:`Rule`.
+
+    >>> rule = compile_rule("not repressor")
+    >>> float(rule({"repressor": jnp.asarray(0.0)}))
+    1.0
+    """
+    if not source or not source.strip():
+        # empty rule == constitutively on
+        return Rule(source, (), lambda env: jnp.asarray(1.0, jnp.float32))
+    parser = _Parser(_tokenize(source), threshold)
+    fn = parser.parse()
+    return Rule(source, parser.names, fn)

@@ -1,0 +1,71 @@
+"""Minimal unit handling for biochemical state.
+
+The reference carries a units library through its parameter plumbing
+(reconstructed: ``lens/utils/units.py``, SURVEY.md §2 "Utils" — mount
+empty, see SURVEY header). A full dimensional-analysis object system would
+fight ``jit`` (units-on-arrays means wrapper pytrees everywhere), so the
+rebuild adopts the standard JAX stance: **state arrays are plain floats in
+canonical units; unit handling happens at the parameter/config boundary.**
+
+Canonical units used throughout the framework:
+
+========== ======================= =========================
+quantity   canonical unit          note
+========== ======================= =========================
+time       second (s)              engine timesteps
+length     micrometer (um)         lattice geometry
+volume     femtoliter (fL)         1 um^3 == 1 fL
+amount     molecule counts         discrete species
+conc.      millimolar (mM)         field + ODE species
+mass       femtogram (fg)          cell dry mass
+rate       1/s                     first-order constants
+========== ======================= =========================
+
+This module provides the conversion constants and the count<->concentration
+helpers every deriver/process needs, so magic numbers never appear inline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: Avogadro's number (1/mol).
+AVOGADRO = 6.02214076e23
+
+#: Molecule counts per femtoliter at 1 mM.
+#: 1 mM = 1e-3 mol/L; 1 fL = 1e-15 L -> 1e-18 mol/fL -> x N_A counts/fL.
+COUNTS_PER_FL_PER_MM = AVOGADRO * 1e-18  # ~6.022e5
+
+#: Seconds per minute / hour (timeline configs are often written in min).
+MINUTE = 60.0
+HOUR = 3600.0
+
+#: E. coli-ish cytoplasmic density, fg dry mass per fL of cell volume.
+#: (~1.1 g/mL wet with ~30% dry fraction -> ~330 fg/fL; the reference's
+#: deriver uses a single density constant the same way.)
+CELL_DENSITY_FG_PER_FL = 330.0
+
+
+def counts_to_millimolar(counts, volume_fl):
+    """Convert molecule counts to mM given cell volume in fL."""
+    return counts / (COUNTS_PER_FL_PER_MM * volume_fl)
+
+
+def millimolar_to_counts(conc_mm, volume_fl):
+    """Convert a mM concentration to (real-valued) molecule counts."""
+    return conc_mm * COUNTS_PER_FL_PER_MM * volume_fl
+
+
+def volume_from_mass(mass_fg, density_fg_per_fl=CELL_DENSITY_FG_PER_FL):
+    """Cell volume (fL) from dry mass (fg) at constant density."""
+    return mass_fg / density_fg_per_fl
+
+
+def mass_from_volume(volume_fl, density_fg_per_fl=CELL_DENSITY_FG_PER_FL):
+    """Cell dry mass (fg) from volume (fL) at constant density."""
+    return volume_fl * density_fg_per_fl
+
+
+def doubling_time_to_rate(doubling_time_s):
+    """Exponential growth rate (1/s) from a doubling time (s)."""
+    return jnp.log(2.0) / doubling_time_s

@@ -1,0 +1,188 @@
+"""Gene-expression suite: transcription/translation/degradation/complexation.
+
+The deterministic expression processes (SURVEY.md §2 "Gene expression
+processes") against closed-form/scipy expectations, plus the regulated
+transcription path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy.integrate import odeint as scipy_odeint
+
+from lens_tpu.core.engine import Compartment
+from lens_tpu.processes.expression import (
+    Complexation,
+    Degradation,
+    Transcription,
+    Translation,
+)
+
+
+def expression_compartment(regulation=None, repressors=None):
+    return Compartment(
+        processes={
+            "transcription": Transcription(
+                {
+                    "rates": {"mrna": 0.5},
+                    "regulation": regulation or {},
+                    "repressors": repressors or {},
+                }
+            ),
+            "translation": Translation({"pairs": {"protein": ("mrna", 0.1)}}),
+            "degradation": Degradation(
+                {"rates": {"mrna": 0.05, "protein": 0.01}}
+            ),
+        },
+        topology={
+            "transcription": {"counts": ("counts",)},
+            "translation": {"counts": ("counts",)},
+            "degradation": {"counts": ("counts",)},
+        },
+    )
+
+
+def test_central_dogma_vs_scipy():
+    """mRNA -> protein with decay matches the 2-species linear ODE."""
+    comp = expression_compartment()
+    final, traj = comp.run(comp.initial_state(), 200.0, 0.5)
+
+    def rhs(y, t):
+        m, p = y
+        return [0.5 - 0.05 * m, 0.1 * m - 0.01 * p]
+
+    ref = scipy_odeint(rhs, [0.0, 0.0], np.linspace(0, 200.0, 401))[-1]
+    np.testing.assert_allclose(
+        float(final["counts"]["mrna"]), ref[0], rtol=0.05
+    )
+    np.testing.assert_allclose(
+        float(final["counts"]["protein"]), ref[1], rtol=0.05
+    )
+
+
+def test_steady_state_mrna():
+    """mRNA steady state = synthesis/decay = 0.5/0.05 = 10."""
+    comp = expression_compartment()
+    final, _ = comp.run(comp.initial_state(), 2000.0, 1.0)
+    np.testing.assert_allclose(float(final["counts"]["mrna"]), 10.0, rtol=0.02)
+
+
+def test_boolean_regulation_shuts_off_gene():
+    comp = expression_compartment(regulation={"mrna": "not repressor"})
+    state = comp.initial_state({"counts": {"repressor": 5.0}})
+    final, _ = comp.run(state, 100.0, 1.0)
+    assert float(final["counts"]["mrna"]) == 0.0
+
+    state_on = comp.initial_state({"counts": {"repressor": 0.0}})
+    final_on, _ = comp.run(state_on, 100.0, 1.0)
+    assert float(final_on["counts"]["mrna"]) > 5.0
+
+
+def test_hill_repression_reduces_synthesis():
+    free = expression_compartment()
+    repressed = expression_compartment(
+        repressors={"mrna": ("repressor", 10.0, 2.0)}
+    )
+    f_final, _ = free.run(free.initial_state(), 100.0, 1.0)
+    r_state = repressed.initial_state({"counts": {"repressor": 100.0}})
+    r_final, _ = repressed.run(r_state, 100.0, 1.0)
+    assert (
+        float(r_final["counts"]["mrna"]) < 0.1 * float(f_final["counts"]["mrna"])
+    )
+
+
+def test_complexation_conserves_subunits():
+    comp = Compartment(
+        processes={
+            "complexation": Complexation(
+                {
+                    "reactions": {
+                        "dimer": {
+                            "subunits": {"a": 1, "b": 2},
+                            "k_on": 1e-3,
+                            "k_off": 1e-4,
+                        }
+                    }
+                }
+            )
+        },
+        topology={"complexation": {"counts": ("counts",)}},
+    )
+    state = comp.initial_state({"counts": {"a": 100.0, "b": 200.0}})
+    final, _ = comp.run(state, 500.0, 1.0)
+    a = float(final["counts"]["a"])
+    b = float(final["counts"]["b"])
+    d = float(final["counts"]["dimer"])
+    assert d > 1.0  # reaction actually ran
+    np.testing.assert_allclose(a + d, 100.0, rtol=1e-4)
+    np.testing.assert_allclose(b + 2 * d, 200.0, rtol=1e-4)
+
+
+def test_complexation_never_negative():
+    comp = Compartment(
+        processes={
+            "complexation": Complexation(
+                {
+                    "reactions": {
+                        "cplx": {
+                            "subunits": {"a": 1, "b": 1},
+                            "k_on": 10.0,  # aggressive: would overshoot
+                            "k_off": 0.0,
+                        }
+                    }
+                }
+            )
+        },
+        topology={"complexation": {"counts": ("counts",)}},
+    )
+    state = comp.initial_state({"counts": {"a": 3.0, "b": 1000.0}})
+    final, _ = comp.run(state, 10.0, 1.0)
+    assert float(final["counts"]["a"]) >= 0.0
+    assert float(final["counts"]["b"]) >= 0.0
+
+
+def test_expression_vmaps_over_agents():
+    comp = expression_compartment()
+    single = comp.initial_state()
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (16,) + x.shape), single
+    )
+    stepped = jax.vmap(lambda s: comp.step(s, 1.0))(stacked)
+    assert stepped["counts"]["mrna"].shape == (16,)
+    assert float(stepped["counts"]["mrna"][0]) > 0.0
+
+
+def test_complexation_shared_subunit_joint_clamp():
+    """Two reactions draining the same subunit must not jointly overdraw
+    it (regression: per-reaction clamping alone fabricates complex mass)."""
+    comp = Compartment(
+        processes={
+            "complexation": Complexation(
+                {
+                    "reactions": {
+                        "c1": {
+                            "subunits": {"a": 1, "b": 1},
+                            "k_on": 10.0,
+                            "k_off": 0.0,
+                        },
+                        "c2": {
+                            "subunits": {"a": 1, "d": 1},
+                            "k_on": 10.0,
+                            "k_off": 0.0,
+                        },
+                    }
+                }
+            )
+        },
+        topology={"complexation": {"counts": ("counts",)}},
+    )
+    state = comp.initial_state(
+        {"counts": {"a": 3.0, "b": 1000.0, "d": 1000.0}}
+    )
+    final, _ = comp.run(state, 20.0, 1.0)
+    a = float(final["counts"]["a"])
+    c1 = float(final["counts"]["c1"])
+    c2 = float(final["counts"]["c2"])
+    assert a >= 0.0
+    # total 'a' is conserved: free + bound-in-c1 + bound-in-c2 == 3
+    np.testing.assert_allclose(a + c1 + c2, 3.0, rtol=1e-4)

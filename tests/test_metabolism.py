@@ -1,0 +1,211 @@
+"""Regulated kinetic metabolism + transport lookup + derivers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.core.engine import Compartment
+from lens_tpu.processes.derivers import (
+    DeriveConcentrations,
+    DeriveVolume,
+    DivideCondition,
+    MassGrowth,
+)
+from lens_tpu.processes.metabolism import Metabolism
+from lens_tpu.processes.transport_lookup import TransportLookup, bilinear_lookup
+from lens_tpu.utils.units import millimolar_to_counts
+
+
+def metabolism_compartment(config=None):
+    return Compartment(
+        processes={"metabolism": Metabolism(config)},
+        topology={
+            "metabolism": {
+                "metabolites": ("metabolites",),
+                "global": ("global",),
+                "fluxes": ("fluxes",),
+            }
+        },
+    )
+
+
+class TestMetabolism:
+    def test_glucose_consumed_mass_produced(self):
+        comp = metabolism_compartment()
+        state = comp.initial_state({"metabolites": {"glc": 10.0}})
+        final, _ = comp.run(state, 100.0, 1.0)
+        assert float(final["metabolites"]["glc"]) < 10.0
+        assert float(final["global"]["mass"]) > 330.0
+
+    def test_catabolite_repression_diauxie(self):
+        """Acetate uptake must stay off while glucose is present, then
+        turn on once glucose is exhausted (Covert-Palsson regulation)."""
+        comp = metabolism_compartment()
+        state = comp.initial_state(
+            {"metabolites": {"glc": 2.0, "ace": 5.0}}
+        )
+        # phase 1: short run, glucose still present -> acetate only grows
+        # (overflow) or stays; uptake gate is closed
+        mid, _ = comp.run(state, 20.0, 1.0)
+        assert float(mid["metabolites"]["ace"]) >= 5.0
+        # phase 2: long run, glucose exhausted -> acetate is consumed
+        final, _ = comp.run(mid, 2000.0, 1.0)
+        assert float(final["metabolites"]["glc"]) < 0.06
+        assert float(final["metabolites"]["ace"]) < float(
+            mid["metabolites"]["ace"]
+        )
+
+    def test_fluxes_emitted(self):
+        comp = metabolism_compartment()
+        final, _ = comp.run(comp.initial_state(), 5.0, 1.0)
+        fluxes = final["fluxes"]["reaction_fluxes"]
+        assert fluxes.shape == (3,)
+        assert float(fluxes[0]) > 0.0  # glycolysis running
+
+    def test_vmaps(self):
+        comp = metabolism_compartment()
+        single = comp.initial_state()
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (8,) + x.shape), single
+        )
+        stepped = jax.vmap(lambda s: comp.step(s, 1.0))(stacked)
+        assert stepped["global"]["mass"].shape == (8,)
+
+
+class TestTransportLookup:
+    def test_bilinear_matches_grid_points(self):
+        table = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        xg = jnp.asarray([0.0, 1.0])
+        yg = jnp.asarray([0.0, 1.0])
+        np.testing.assert_allclose(
+            float(bilinear_lookup(table, xg, yg, 0.0, 1.0)), 1.0, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            float(bilinear_lookup(table, xg, yg, 0.5, 0.5)), 1.5, atol=1e-6
+        )
+
+    def test_edge_clamping(self):
+        table = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        xg = jnp.asarray([0.0, 1.0])
+        yg = jnp.asarray([0.0, 1.0])
+        np.testing.assert_allclose(
+            float(bilinear_lookup(table, xg, yg, 99.0, 99.0)), 3.0, atol=1e-6
+        )
+
+    def test_lookup_matches_mm_source(self):
+        """The default table tabulates MM-with-inhibition; lookup at a grid
+        point must reproduce the closed form."""
+        proc = TransportLookup()
+        comp = Compartment(
+            processes={"transport": proc},
+            topology={
+                "transport": {
+                    "external": ("boundary",),
+                    "internal": ("cell",),
+                    "exchange": ("exchange",),
+                }
+            },
+        )
+        state = comp.initial_state({"boundary": {"glucose": 10.0}})
+        stepped = comp.step(state, 1.0)
+        internal = float(stepped["cell"]["glucose_internal"])
+        # closed form at internal=0: 0.1 * 10/(0.5+10)
+        expected = 0.1 * 10.0 / 10.5
+        np.testing.assert_allclose(internal, expected, rtol=1e-3)
+        np.testing.assert_allclose(
+            float(stepped["exchange"]["glucose_exchange"]),
+            -expected,
+            rtol=1e-3,
+        )
+
+
+class TestDerivers:
+    def grow_derive_compartment(self):
+        return Compartment(
+            processes={
+                "growth": MassGrowth({"rate": 0.001}),
+                "derive_volume": DeriveVolume(),
+                "divide": DivideCondition(
+                    {"variable": "mass", "threshold": 660.0}
+                ),
+            },
+            topology={
+                "growth": {"global": ("global",)},
+                "derive_volume": {"global": ("global",)},
+                "divide": {"global": ("global",)},
+            },
+        )
+
+    def test_volume_tracks_mass(self):
+        comp = self.grow_derive_compartment()
+        final, _ = comp.run(comp.initial_state(), 200.0, 1.0)
+        mass = float(final["global"]["mass"])
+        vol = float(final["global"]["volume"])
+        np.testing.assert_allclose(vol, mass / 330.0, rtol=1e-5)
+        assert mass > 330.0
+
+    def test_divide_condition_trips_at_double_mass(self):
+        comp = self.grow_derive_compartment()
+        # ln(2)/0.001 ~ 693s to double
+        state = comp.initial_state()
+        mid, _ = comp.run(state, 600.0, 1.0)
+        assert float(mid["global"]["divide"]) == 0.0
+        final, _ = comp.run(mid, 200.0, 1.0)
+        assert float(final["global"]["divide"]) == 1.0
+
+    def test_derive_concentrations(self):
+        comp = Compartment(
+            processes={
+                "concs": DeriveConcentrations({"molecules": ("protein",)}),
+            },
+            topology={
+                "concs": {
+                    "counts": ("counts",),
+                    "global": ("global",),
+                    "concentrations": ("concentrations",),
+                }
+            },
+        )
+        counts = float(millimolar_to_counts(2.0, 1.5))
+        state = comp.initial_state(
+            {"counts": {"protein": counts}, "global": {"volume": 1.5}}
+        )
+        stepped = comp.step(state, 1.0)
+        np.testing.assert_allclose(
+            float(stepped["concentrations"]["protein"]), 2.0, rtol=1e-5
+        )
+
+
+def test_divide_condition_on_derived_volume():
+    """DivideCondition watching DeriveVolume's volume must mirror its
+    'set' declaration (regression: hard-coded accumulate broke the
+    grow-mass/derive-volume/divide-on-volume composite)."""
+    comp = Compartment(
+        processes={
+            "growth": MassGrowth({"rate": 0.001}),
+            "derive_volume": DeriveVolume(),
+            "divide": DivideCondition(
+                {
+                    "variable": "volume",
+                    "threshold": 2.0,
+                    "default": 1.0,
+                    "updater": "set",
+                }
+            ),
+        },
+        topology={
+            "growth": {"global": ("global",)},
+            "derive_volume": {"global": ("global",)},
+            "divide": {"global": ("global",)},
+        },
+    )
+    final, _ = comp.run(comp.initial_state(), 800.0, 1.0)
+    assert float(final["global"]["volume"]) >= 2.0
+    assert float(final["global"]["divide"]) == 1.0
+
+
+def test_transport_lookup_partial_table_config_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="needs all of"):
+        TransportLookup({"ext_grid": [0.0, 1.0]})

@@ -1,0 +1,111 @@
+"""Units, rate laws, and the regulation-rule compiler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lens_tpu.utils import rate_laws, units
+from lens_tpu.utils.regulation_logic import compile_rule
+
+
+class TestUnits:
+    def test_count_concentration_roundtrip(self):
+        counts = units.millimolar_to_counts(1.0, 1.0)
+        np.testing.assert_allclose(counts, 6.02214076e5, rtol=1e-6)
+        np.testing.assert_allclose(
+            units.counts_to_millimolar(counts, 1.0), 1.0, rtol=1e-6
+        )
+
+    def test_volume_mass_roundtrip(self):
+        v = units.volume_from_mass(660.0)
+        np.testing.assert_allclose(units.mass_from_volume(v), 660.0, rtol=1e-6)
+
+    def test_doubling_time(self):
+        rate = units.doubling_time_to_rate(1200.0)
+        np.testing.assert_allclose(np.exp(rate * 1200.0), 2.0, rtol=1e-6)
+
+
+class TestRateLaws:
+    def test_michaelis_menten_half_saturation(self):
+        np.testing.assert_allclose(
+            rate_laws.michaelis_menten(0.5, 1.0, 0.5), 0.5, rtol=1e-5
+        )
+
+    def test_negative_substrate_clamped(self):
+        assert float(rate_laws.michaelis_menten(-1.0, 1.0, 0.5)) == 0.0
+        assert float(rate_laws.first_order(0.1, -5.0)) == 0.0
+
+    def test_hill_limits(self):
+        assert float(rate_laws.hill(100.0, 1.0, 1.0, 4.0)) > 0.99
+        assert float(rate_laws.hill_repression(100.0, 1.0, 1.0, 4.0)) < 0.01
+
+    def test_competitive_inhibition_reduces_rate(self):
+        base = float(rate_laws.michaelis_menten(1.0, 1.0, 0.5))
+        inhibited = float(
+            rate_laws.competitive_inhibition(1.0, 10.0, 1.0, 0.5, 1.0)
+        )
+        assert inhibited < base
+
+    def test_mass_action(self):
+        np.testing.assert_allclose(
+            rate_laws.mass_action(2.0, 3.0, 4.0), 24.0, rtol=1e-6
+        )
+
+
+class TestRegulationLogic:
+    def test_presence(self):
+        rule = compile_rule("glc")
+        assert float(rule({"glc": jnp.asarray(1.0)})) == 1.0
+        assert float(rule({"glc": jnp.asarray(0.0)})) == 0.0
+
+    def test_not(self):
+        rule = compile_rule("not glc")
+        assert float(rule({"glc": jnp.asarray(1.0)})) == 0.0
+        assert float(rule({"glc": jnp.asarray(0.0)})) == 1.0
+
+    def test_and_or_parens(self):
+        rule = compile_rule("a and (b or not c)")
+        env = lambda a, b, c: {  # noqa: E731
+            "a": jnp.asarray(a),
+            "b": jnp.asarray(b),
+            "c": jnp.asarray(c),
+        }
+        assert float(rule(env(1.0, 1.0, 1.0))) == 1.0
+        assert float(rule(env(1.0, 0.0, 1.0))) == 0.0
+        assert float(rule(env(1.0, 0.0, 0.0))) == 1.0
+        assert float(rule(env(0.0, 1.0, 0.0))) == 0.0
+
+    def test_comparison(self):
+        rule = compile_rule("glc > 2.5")
+        assert float(rule({"glc": jnp.asarray(3.0)})) == 1.0
+        assert float(rule({"glc": jnp.asarray(2.0)})) == 0.0
+
+    def test_case_insensitive_keywords_preserve_names(self):
+        rule = compile_rule("NOT GlcX")
+        assert rule.names == ("GlcX",)
+        assert float(rule({"GlcX": jnp.asarray(0.0)})) == 1.0
+
+    def test_vectorized_under_vmap(self):
+        rule = compile_rule("a and not b")
+        a = jnp.asarray([1.0, 1.0, 0.0])
+        b = jnp.asarray([0.0, 1.0, 0.0])
+        out = jax.vmap(lambda a, b: rule({"a": a, "b": b}))(a, b)
+        np.testing.assert_array_equal(np.asarray(out), [1.0, 0.0, 0.0])
+
+    def test_jit_compatible(self):
+        rule = compile_rule("x > 1 and not y")
+        f = jax.jit(lambda x, y: rule({"x": x, "y": y}))
+        assert float(f(jnp.asarray(2.0), jnp.asarray(0.0))) == 1.0
+
+    def test_empty_rule_is_on(self):
+        assert float(compile_rule("")({})) == 1.0
+
+    def test_missing_species_raises(self):
+        rule = compile_rule("missing_thing")
+        with pytest.raises(KeyError):
+            rule({})
+
+    def test_garbage_raises(self):
+        with pytest.raises(ValueError):
+            compile_rule("a and and b")
