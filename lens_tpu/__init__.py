@@ -24,7 +24,30 @@ declarative updater/divider semantics, composed by topology wiring.
 
 __version__ = "0.1.0"
 
-from lens_tpu.core.process import Process
+from lens_tpu.core.process import Deriver, Process
 from lens_tpu.core.engine import Compartment
 
-__all__ = ["Process", "Compartment", "__version__"]
+_LAZY = ("Experiment", "Colony", "Checkpointer")
+__all__ = ["Process", "Deriver", "Compartment", "__version__", *_LAZY]
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
+def __getattr__(name):
+    # Heavier layers load lazily so `import lens_tpu` stays light and the
+    # core API has no import-order entanglement with jax-touching modules.
+    if name == "Experiment":
+        from lens_tpu.experiment import Experiment
+
+        return Experiment
+    if name == "Colony":
+        from lens_tpu.colony.colony import Colony
+
+        return Colony
+    if name == "Checkpointer":
+        from lens_tpu.checkpoint import Checkpointer
+
+        return Checkpointer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

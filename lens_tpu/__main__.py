@@ -1,0 +1,129 @@
+"""CLI entry point: ``python -m lens_tpu <command> ...``.
+
+Replaces the reference's control/boot command surface
+(``python -m lens.actor.control experiment --number N ...``, boot scripts;
+reconstructed SURVEY.md §1 L5, §3.1) with three commands against the
+experiment layer:
+
+- ``run``     start an experiment from a composite name + JSON config
+- ``resume``  continue the latest checkpoint of an experiment
+- ``list``    show registered composites, processes, emitters
+
+Examples::
+
+    python -m lens_tpu list
+    python -m lens_tpu run --composite toggle_colony --n-agents 100 \\
+        --time 200 --emitter log --out-dir out/exp1
+    python -m lens_tpu run --composite ecoli_lattice --time 50 \\
+        --config '{"capacity": 1024, "shape": [64, 64]}'
+    python -m lens_tpu resume --composite toggle_colony --time 400 \\
+        --out-dir out/exp1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lens_tpu", description="TPU-native cell-colony simulations"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run an experiment")
+    resume = sub.add_parser(
+        "resume", help="continue the latest checkpoint of an experiment"
+    )
+    for sp in (run, resume):
+        sp.add_argument("--composite", default="grow_divide")
+        sp.add_argument(
+            "--config", default="{}", help="composite config as JSON"
+        )
+        sp.add_argument("--n-agents", type=int, default=1)
+        sp.add_argument("--capacity", type=int, default=None)
+        sp.add_argument("--time", type=float, default=100.0, help="sim seconds")
+        sp.add_argument("--timestep", type=float, default=1.0)
+        sp.add_argument("--emit-every", type=int, default=1)
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument(
+            "--emitter", choices=["ram", "log", "null"], default="ram"
+        )
+        sp.add_argument(
+            "--out-dir",
+            default=None,
+            help="directory for the emit log + checkpoints",
+        )
+        sp.add_argument(
+            "--checkpoint-every",
+            type=float,
+            default=None,
+            help="sim-seconds between checkpoints",
+        )
+        sp.add_argument(
+            "--timeline",
+            default=None,
+            help='media timeline, e.g. "0 minimal, 500 minimal_lactose"',
+        )
+        sp.add_argument("--quiet", action="store_true")
+
+    sub.add_parser("list", help="list composites, processes, emitters")
+    return p
+
+
+def _experiment_config(args: argparse.Namespace) -> dict:
+    emitter: dict = {"type": args.emitter}
+    checkpoint_dir = None
+    if args.out_dir:
+        if args.emitter == "log":
+            emitter["path"] = f"{args.out_dir}/emit.lens"
+        checkpoint_dir = f"{args.out_dir}/checkpoints"
+    return {
+        "composite": args.composite,
+        "config": json.loads(args.config),
+        "n_agents": args.n_agents,
+        "capacity": args.capacity,
+        "total_time": args.time,
+        "timestep": args.timestep,
+        "emit_every": args.emit_every,
+        "seed": args.seed,
+        "emitter": emitter,
+        "checkpoint_dir": checkpoint_dir,
+        "checkpoint_every": args.checkpoint_every,
+        "timeline": args.timeline,
+    }
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.command == "list":
+        # imports deferred so `list` stays fast and jax-free paths obvious
+        from lens_tpu.emit import EMITTERS
+        from lens_tpu.models.composites import composite_registry
+        from lens_tpu.processes import process_registry
+
+        print("composites:", ", ".join(sorted(composite_registry)))
+        print("processes: ", ", ".join(sorted(process_registry)))
+        print("emitters:  ", ", ".join(sorted(EMITTERS)))
+        return 0
+
+    from lens_tpu.experiment import Experiment
+
+    with Experiment(_experiment_config(args)) as exp:
+        if args.command == "run":
+            state = exp.run(verbose=not args.quiet)
+        else:
+            state = exp.resume(verbose=not args.quiet)
+        import jax
+        import numpy as np
+
+        alive = int(np.asarray(jax.device_get(exp.n_alive(state))))
+        print(f"done: {alive} live cells")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

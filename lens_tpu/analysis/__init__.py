@@ -1,0 +1,204 @@
+"""Offline analysis: load emitted experiments, render standard plots.
+
+The reference's ``lens/analysis/`` scripts query MongoDB by experiment id
+and render per-compartment timeseries, lattice field snapshots, and
+multi-generation traces to PNGs (reconstructed: SURVEY.md §2 "Analysis",
+§3.5). The rebuild reads the record-log emitter's files instead; the
+analysis split (offline, out of the hot path, matplotlib) is identical.
+
+All plotting is optional — every loader works headless; plot functions
+import matplotlib lazily with the Agg backend so they run in CI.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from lens_tpu.emit.log import read_experiment, stack_records
+
+
+def load(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load one experiment log -> (header, timeseries tree).
+
+    Timeseries leaves are ``[T, ...]`` numpy arrays; the emit records'
+    ``__time__`` key becomes ``timeseries["__time__"]`` of shape [T].
+    """
+    header, records = read_experiment(path)
+    return header, stack_records(records)
+
+
+def get_path(tree: Mapping, path: Sequence[str]) -> np.ndarray:
+    node: Any = tree
+    for key in path:
+        node = node[key]
+    return np.asarray(node)
+
+
+def flatten_leaves(tree: Mapping, prefix=()) -> List[Tuple[Tuple[str, ...], np.ndarray]]:
+    out = []
+    for key, node in tree.items():
+        if isinstance(node, Mapping):
+            out.extend(flatten_leaves(node, prefix + (key,)))
+        else:
+            out.append((prefix + (key,), np.asarray(node)))
+    return out
+
+
+def alive_counts(timeseries: Mapping) -> np.ndarray:
+    """Live-cell count over time from the colony ``alive`` mask [T, N]."""
+    return np.asarray(timeseries["alive"]).sum(axis=-1)
+
+
+def masked_agent_series(
+    timeseries: Mapping, path: Sequence[str]
+) -> np.ma.MaskedArray:
+    """A per-agent variable [T, N] with dead rows masked out."""
+    values = get_path(timeseries, path)
+    alive = np.asarray(timeseries["alive"]).astype(bool)
+    return np.ma.masked_array(values, mask=~alive)
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _times(timeseries: Mapping, length: int) -> np.ndarray:
+    if "__time__" in timeseries:
+        return np.asarray(timeseries["__time__"])
+    return np.arange(length)
+
+
+def plot_timeseries(
+    timeseries: Mapping,
+    paths: Sequence[Sequence[str]] | None = None,
+    out_path: str = "out/timeseries.png",
+    max_agents: int = 32,
+) -> str:
+    """Per-variable panels over time (the reference's standard compartment
+    timeseries plot). Per-agent variables show up to ``max_agents``
+    masked traces; scalars show one line."""
+    plt = _plt()
+    leaves = (
+        [(tuple(p), get_path(timeseries, p)) for p in paths]
+        if paths is not None
+        else [
+            (path, arr)
+            for path, arr in flatten_leaves(timeseries)
+            if path[0] not in ("alive", "fields", "__time__")
+        ]
+    )
+    if not leaves:
+        raise ValueError("nothing to plot")
+    alive = np.asarray(timeseries.get("alive", None))
+    n = len(leaves)
+    cols = min(3, n)
+    rows = (n + cols - 1) // cols
+    fig, axes = plt.subplots(
+        rows, cols, figsize=(5 * cols, 3 * rows), squeeze=False
+    )
+    for k, (path, arr) in enumerate(leaves):
+        ax = axes[k // cols][k % cols]
+        t = _times(timeseries, arr.shape[0])
+        if arr.ndim == 1:
+            ax.plot(t, arr)
+        else:
+            flat = arr.reshape(arr.shape[0], -1)
+            take = min(flat.shape[1], max_agents)
+            data = flat[:, :take]
+            if alive is not None and alive.shape == flat.shape:
+                data = np.ma.masked_array(data, mask=~alive[:, :take].astype(bool))
+            ax.plot(t, data, alpha=0.6, linewidth=0.8)
+        ax.set_title(SEP_TITLE.join(path), fontsize=9)
+        ax.set_xlabel("time (s)", fontsize=8)
+    for k in range(n, rows * cols):
+        axes[k // cols][k % cols].axis("off")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+SEP_TITLE = "."
+
+
+def plot_colony_growth(
+    timeseries: Mapping, out_path: str = "out/colony_growth.png"
+) -> str:
+    """Live-cell count over time (the multi-generation trace)."""
+    plt = _plt()
+    counts = alive_counts(timeseries)
+    t = _times(timeseries, counts.shape[0])
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(t, counts)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("live cells")
+    ax.set_title("colony growth")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+def plot_field_snapshots(
+    timeseries: Mapping,
+    molecule_index: int = 0,
+    n_snapshots: int = 4,
+    out_path: str = "out/field_snapshots.png",
+    locations: Optional[np.ndarray] = None,
+    dx: float = 1.0,
+) -> str:
+    """Lattice field heatmaps at evenly spaced times (+ optional cell
+    overlay) — the reference's lattice snapshot/animation plot."""
+    plt = _plt()
+    fields = np.asarray(timeseries["fields"])  # [T, M, H, W]
+    steps = np.linspace(0, fields.shape[0] - 1, n_snapshots).astype(int)
+    t = _times(timeseries, fields.shape[0])
+    vmin = fields[:, molecule_index].min()
+    vmax = fields[:, molecule_index].max()
+    fig, axes = plt.subplots(
+        1, n_snapshots, figsize=(4 * n_snapshots, 3.6), squeeze=False
+    )
+    for k, s in enumerate(steps):
+        ax = axes[0][k]
+        im = ax.imshow(
+            fields[s, molecule_index],
+            origin="lower",
+            vmin=vmin,
+            vmax=vmax,
+            cmap="viridis",
+        )
+        if locations is not None:
+            alive = np.asarray(timeseries["alive"])[s].astype(bool)
+            # locations [T, N, 2] are (row, col) in um; divide by dx for
+            # bin coordinates; imshow axes are (col=x, row=y)
+            pts = np.asarray(locations)[s][alive] / dx
+            ax.scatter(pts[:, 1], pts[:, 0], s=2, c="red", alpha=0.6)
+        ax.set_title(f"t={float(t[s]):g}s")
+        fig.colorbar(im, ax=ax, shrink=0.8)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+__all__ = [
+    "load",
+    "alive_counts",
+    "masked_agent_series",
+    "plot_timeseries",
+    "plot_colony_growth",
+    "plot_field_snapshots",
+    "flatten_leaves",
+    "get_path",
+]

@@ -1,0 +1,251 @@
+"""External-simulation bridge: the CellSimulation protocol + host loop.
+
+The reference exists to put EXTERNAL whole-cell models (wcEcoli) into
+colony context: its inner agent wraps anything implementing the
+CellSimulation interface — ``apply_outer_update``, ``run_incremental``,
+``generate_inner_update``, ``divide``, ``finalize`` (reconstructed:
+``lens/actor/inner.py``, SURVEY.md §1 L3a, §2 "wcEcoli bridge"). That
+capability must survive the rebuild even though arbitrary external Python
+sims cannot run inside a jitted SPMD program.
+
+So the bridge is the framework's **host path**: the same exchange-window
+semantics as ``environment.spatial.SpatialColony``, but driven step-by-
+step from Python against a list of per-cell simulation objects. The
+lattice math is still jax (fields on device); only the per-cell biology
+runs as opaque host code. Throughput is the reference's (one Python object
+per cell), which is the honest cost of opaque external models — put
+anything expressible as a Process in a Compartment instead and it rides
+the fast path. ``CompartmentSimulation`` adapts a Compartment to the
+protocol so the two paths stay behaviorally aligned (tested against each
+other), and is the template for writing a wcEcoli adapter.
+
+Division in the host loop follows the reference handshake: a divider
+returns two CellSimulation daughters; the parent is finalized and the
+daughters take adjacent locations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Protocol, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.environment.lattice import Lattice
+
+
+class CellSimulation(Protocol):
+    """The reference's inner-agent plug interface (SURVEY.md §1 L3a)."""
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        """Receive the local environment (molecule -> concentration)."""
+        ...
+
+    def run_incremental(self, run_until: float) -> None:
+        """Advance internal simulation time to ``run_until`` (sim-sec)."""
+        ...
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        """Report state for the environment: at least ``exchange``
+        (molecule -> net secreted amount since last report), and
+        optionally ``volume``, ``motile_force``, ``divide`` (bool)."""
+        ...
+
+    def divide(self) -> Tuple["CellSimulation", "CellSimulation"]:
+        """Split into two daughters (called when divide flag is set)."""
+        ...
+
+    def finalize(self) -> None:
+        """Tear down (parent after division, or experiment end)."""
+        ...
+
+
+class CompartmentSimulation:
+    """Adapt a Compartment + wiring to the CellSimulation protocol.
+
+    The reference's inner agent wraps its engine exactly like this; the
+    adapter doubles as the template for external-model adapters (wcEcoli:
+    implement the same five methods around its snapshot API).
+
+    ``field_ports``: molecule -> (local_path, exchange_path) into the
+    compartment state tree, same convention as SpatialColony.
+    """
+
+    def __init__(
+        self,
+        compartment,
+        field_ports: Mapping[str, Tuple],
+        state: Optional[dict] = None,
+        time: float = 0.0,
+        timestep: float = 1.0,
+        divide_path: Tuple[str, ...] = ("global", "divide"),
+        key: Optional[jax.Array] = None,
+    ):
+        from lens_tpu.core.topology import normalize_path
+        from lens_tpu.utils.dicts import get_path
+
+        self.compartment = compartment
+        self.field_ports = {
+            mol: (normalize_path(p[0]), normalize_path(p[1]))
+            for mol, p in field_ports.items()
+        }
+        self.state = state if state is not None else compartment.initial_state()
+        self.time = float(time)
+        self.timestep = float(timestep)
+        self.divide_path = normalize_path(divide_path)
+        self.key = key if key is not None else jax.random.PRNGKey(0)
+        self._get_path = get_path
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        from lens_tpu.utils.dicts import set_path
+
+        for mol, conc in update.items():
+            if mol in self.field_ports:
+                local, _ = self.field_ports[mol]
+                self.state = set_path(
+                    self.state, local, jnp.asarray(conc, jnp.float32)
+                )
+
+    def run_incremental(self, run_until: float) -> None:
+        while self.time < run_until - 1e-9:
+            if self.compartment.has_stochastic:
+                self.key, sub = jax.random.split(self.key)
+                self.state = self.compartment.step(
+                    self.state, self.timestep, sub
+                )
+            else:
+                self.state = self.compartment.step(self.state, self.timestep)
+            self.time += self.timestep
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        from lens_tpu.utils.dicts import set_path
+
+        exchange: Dict[str, float] = {}
+        for mol, (_, exch) in self.field_ports.items():
+            exchange[mol] = float(self._get_path(self.state, exch))
+            self.state = set_path(self.state, exch, jnp.asarray(0.0))
+        update: Dict[str, Any] = {"exchange": exchange}
+        try:
+            update["divide"] = bool(
+                float(self._get_path(self.state, self.divide_path)) > 0
+            )
+        except KeyError:
+            update["divide"] = False
+        try:
+            update["volume"] = float(
+                self._get_path(self.state, ("global", "volume"))
+            )
+        except KeyError:
+            pass
+        return update
+
+    def divide(self):
+        self.key, sub = jax.random.split(self.key)
+        a, b = self.compartment.divide(self.state, sub)
+        return (
+            CompartmentSimulation(
+                self.compartment, self.field_ports, a, self.time,
+                self.timestep, self.divide_path, jax.random.fold_in(sub, 0),
+            ),
+            CompartmentSimulation(
+                self.compartment, self.field_ports, b, self.time,
+                self.timestep, self.divide_path, jax.random.fold_in(sub, 1),
+            ),
+        )
+
+    def finalize(self) -> None:
+        pass
+
+
+class HostAgent:
+    """Bookkeeping for one cell in the host loop (id, sim, location)."""
+
+    _next_id = 0
+
+    def __init__(self, sim: CellSimulation, location: Sequence[float]):
+        self.sim = sim
+        self.location = np.asarray(location, np.float64)
+        self.agent_id = f"agent_{HostAgent._next_id}"
+        HostAgent._next_id += 1
+
+
+class HostExchangeLoop:
+    """The reference's outer/inner exchange loop, host-driven.
+
+    Runs external CellSimulations against a (device-resident) lattice in
+    discrete exchange windows: gather local concentrations -> each sim
+    runs incrementally -> apply exchanges -> diffuse -> handle divisions.
+    This is behaviorally the loop in SURVEY.md §3.2 minus Kafka.
+    """
+
+    def __init__(self, lattice: Lattice, exchange_window: float = 1.0):
+        self.lattice = lattice
+        self.window = float(exchange_window)
+        self.fields = lattice.initial_fields()
+        self.agents: List[HostAgent] = []
+        self.time = 0.0
+
+    def add_agent(self, sim: CellSimulation, location: Sequence[float]) -> str:
+        agent = HostAgent(sim, location)
+        self.agents.append(agent)
+        return agent.agent_id
+
+    def _locations(self) -> jnp.ndarray:
+        if not self.agents:
+            return jnp.zeros((0, 2), jnp.float32)
+        return jnp.asarray(
+            np.stack([a.location for a in self.agents]), jnp.float32
+        )
+
+    def step(self) -> None:
+        """One exchange window for every agent + the environment."""
+        target = self.time + self.window
+        locations = self._locations()
+        alive = jnp.ones((len(self.agents),), bool)
+        if self.agents:
+            local = self.lattice.local_concentrations(
+                self.fields, locations, alive
+            )  # [N, M]
+            # outer -> inner
+            for k, agent in enumerate(self.agents):
+                agent.sim.apply_outer_update(
+                    {
+                        mol: float(local[k, m])
+                        for m, mol in enumerate(self.lattice.molecules)
+                    }
+                )
+                agent.sim.run_incremental(target)
+            # inner -> outer (the barrier is the loop structure itself)
+            updates = [a.sim.generate_inner_update() for a in self.agents]
+            exchange = jnp.asarray(
+                [
+                    [u["exchange"].get(mol, 0.0) for mol in self.lattice.molecules]
+                    for u in updates
+                ],
+                jnp.float32,
+            )
+            self.fields = self.lattice.apply_exchanges(
+                self.fields, locations, exchange, alive
+            )
+            self._handle_divisions(updates)
+        self.fields = self.lattice.step_fields(self.fields)
+        self.time = target
+
+    def _handle_divisions(self, updates: List[Mapping]) -> None:
+        new_agents: List[HostAgent] = []
+        for agent, update in zip(list(self.agents), updates):
+            if not update.get("divide"):
+                new_agents.append(agent)
+                continue
+            sim_a, sim_b = agent.sim.divide()
+            agent.sim.finalize()
+            offset = np.asarray([self.lattice.dx / 4, 0.0])
+            new_agents.append(HostAgent(sim_a, agent.location - offset))
+            new_agents.append(HostAgent(sim_b, agent.location + offset))
+        self.agents = new_agents
+
+    def run(self, total_time: float) -> None:
+        n = int(round(total_time / self.window))
+        for _ in range(n):
+            self.step()

@@ -1,0 +1,110 @@
+"""Checkpoint/resume: the whole colony as one orbax-saved pytree.
+
+The reference has no checkpointing of its own — the only state
+serialization is the division handshake's daughter-state dicts
+(reconstructed: SURVEY.md §5 "Checkpoint/resume") — but the rebuild makes
+it first-class, exactly because the whole-simulation-state-as-one-pytree
+design gives it away for free: save the ``ColonyState``/``SpatialState``
+every K steps with orbax, resume = restore + continue. Resumed runs are
+bitwise-identical to uninterrupted ones (the PRNG key and step counter
+are part of the state), which the tests pin.
+
+Layout: ``<dir>/step_<n>/`` orbax PyTree checkpoints; ``latest_step()``
+scans the directory. NamedTuple states are saved as plain nested
+containers and rebuilt by the typed ``restore_*`` helpers.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, List, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from lens_tpu.colony.colony import ColonyState
+from lens_tpu.environment.spatial import SpatialState
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _to_plain(state: Any) -> Any:
+    """NamedTuples -> dicts so orbax sees vanilla containers.
+
+    The kind is encoded in the key set (no string leaves — orbax stores
+    array leaves): ``{spatial_colony, fields}`` / ``{agents, alive, key,
+    step}`` / ``{pytree_value}``.
+    """
+    if isinstance(state, SpatialState):
+        return {
+            "spatial_colony": _to_plain(state.colony),
+            "fields": state.fields,
+        }
+    if isinstance(state, ColonyState):
+        return {
+            "agents": state.agents,
+            "alive": state.alive,
+            "key": state.key,
+            "step": state.step,
+        }
+    return {"pytree_value": state}
+
+
+def _from_plain(plain: Any) -> Any:
+    keys = set(plain)
+    if keys == {"spatial_colony", "fields"}:
+        return SpatialState(
+            colony=_from_plain(plain["spatial_colony"]),
+            fields=plain["fields"],
+        )
+    if keys == {"agents", "alive", "key", "step"}:
+        return ColonyState(
+            agents=plain["agents"],
+            alive=plain["alive"],
+            key=plain["key"],
+            step=plain["step"],
+        )
+    if keys == {"pytree_value"}:
+        return plain["pytree_value"]
+    raise ValueError(f"unrecognized checkpoint key set {sorted(keys)}")
+
+
+class Checkpointer:
+    """Save/restore simulation states under one directory."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckpt = ocp.PyTreeCheckpointer()
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step}")
+
+    def save(self, state: Any, step: int, force: bool = True) -> str:
+        path = self._path(step)
+        self._ckpt.save(path, _to_plain(state), force=force)
+        return path
+
+    def steps(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: int | None = None) -> Any:
+        """Restore the given (default: latest) step's state."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        plain = self._ckpt.restore(self._path(step))
+        return _from_plain(jax.tree.map(jax.numpy.asarray, plain))

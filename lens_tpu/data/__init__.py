@@ -1,0 +1,69 @@
+"""The knowledge-base/data layer: flat files + loaders.
+
+The reference keeps its parameters in TSV/JSON flat files under
+``lens/data/`` with small loader utilities ("JsonReader"-style), feeding
+media recipes and kinetic parameters into processes and the environment
+(reconstructed: SURVEY.md §1 L1, §2 "Data layer" — mount empty, see
+SURVEY header). The rebuild keeps that split: data is plain files next to
+this module, loaders return plain dicts/lists, and processes receive them
+through ordinary config — nothing here touches jax.
+
+TSV convention: first row is the header; ``#`` lines are comments; cells
+parse as float when possible, else stay strings; a ``null`` cell parses
+as None. JSON is loaded verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+_DATA_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def data_path(name: str) -> str:
+    """Absolute path of a packaged data file."""
+    return os.path.join(_DATA_DIR, name)
+
+
+def load_json(name: str) -> Any:
+    """Load a packaged JSON file (or an absolute path)."""
+    path = name if os.path.isabs(name) else data_path(name)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _parse_cell(cell: str) -> Any:
+    cell = cell.strip()
+    if cell == "null" or cell == "":
+        return None
+    try:
+        return float(cell)
+    except ValueError:
+        return cell
+
+
+def load_tsv(name: str) -> List[Dict[str, Any]]:
+    """Load a packaged TSV file as a list of row dicts keyed by header."""
+    path = name if os.path.isabs(name) else data_path(name)
+    rows: List[Dict[str, Any]] = []
+    header: List[str] | None = None
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            cells = line.split("\t")
+            if header is None:
+                header = [c.strip() for c in cells]
+                continue
+            rows.append({h: _parse_cell(c) for h, c in zip(header, cells)})
+    if header is None:
+        raise ValueError(f"TSV file {path} has no header row")
+    return rows
+
+
+def load_table(name: str, key: str, value: str) -> Dict[Any, Any]:
+    """Collapse a TSV into a {row[key]: row[value]} mapping."""
+    return {row[key]: row[value] for row in load_tsv(name)}

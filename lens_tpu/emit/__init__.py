@@ -1,0 +1,242 @@
+"""Emitters: device -> host -> sink timeseries streaming.
+
+The reference's agents emit timeseries rows to MongoDB keyed by
+experiment/agent/time, consumed offline by ``lens/analysis`` scripts
+(reconstructed: SURVEY.md §2 "Emitter", §3.5, §5 "Metrics/logging"). The
+rebuild keeps the concepts — experiment id, per-step records, offline
+analysis — and re-plumbs the transport for TPU:
+
+- the jitted run produces an emit SLICE (schema ``_emit`` paths) already
+  stacked on device; the emitter moves it device->host ONCE per segment
+  (``jax.device_get`` of the trajectory), not per step per agent;
+- the disk sink is an append-only record log (``lens_tpu.emit.log``)
+  written by a native C++ background thread (``lens_tpu.native``) so
+  serialization/disk never block the step loop; a pure-Python fallback
+  writer produces byte-identical files when the toolchain is missing.
+
+Pick an emitter by name via ``get_emitter({"type": "log", ...})`` — the
+boot/experiment layer treats emitters as config, like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Mapping, Optional
+
+import jax
+import numpy as np
+
+from lens_tpu.emit.log import (
+    encode_record,
+    frame,
+    make_header,
+    read_experiment,
+    stack_records,
+)
+
+
+class Emitter:
+    """Base emitter: receives host-side record dicts, one per emit step."""
+
+    def __init__(self, experiment_id: str | None = None, config: Mapping | None = None):
+        self.experiment_id = experiment_id or uuid.uuid4().hex[:12]
+        self.config = dict(config or {})
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        raise NotImplementedError
+
+    def emit_trajectory(self, trajectory: Any, times: Any = None) -> None:
+        """Emit a device trajectory (leaves [T, ...]) as T records.
+
+        One ``device_get`` for the whole segment; per-step splitting is
+        host-side numpy slicing.
+        """
+        host = jax.device_get(trajectory)
+        leaves = jax.tree.leaves(host)
+        if not leaves:
+            return
+        steps = leaves[0].shape[0]
+        times = np.asarray(times) if times is not None else np.arange(steps)
+        for t in range(steps):
+            record = jax.tree.map(lambda x: x[t], host)
+            record["__time__"] = times[t]
+            self.emit(record)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NullEmitter(Emitter):
+    """Discard everything (benchmarks, throwaway runs)."""
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        pass
+
+
+class RamEmitter(Emitter):
+    """Keep records in memory; ``timeseries()`` stacks them for analysis."""
+
+    def __init__(self, experiment_id: str | None = None, config: Mapping | None = None):
+        super().__init__(experiment_id, config)
+        self.records: List[Dict[str, Any]] = []
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        self.records.append(jax.tree.map(np.asarray, dict(record)))
+
+    def timeseries(self) -> Dict[str, Any]:
+        return stack_records(self.records)
+
+
+class _PyWriter:
+    """Pure-Python fallback with the native writer's file format and a
+    background thread (so the calling thread still never blocks on disk)."""
+
+    def __init__(self, path: str):
+        self._file = open(path, "ab")
+        self._queue: List[bytes] = []
+        self._cond = threading.Condition()
+        self._pending = 0  # queued + currently being written
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._queue or self._stop)
+                if not self._queue and self._stop:
+                    return
+                batch, self._queue = self._queue, []
+            for chunk in batch:
+                self._file.write(chunk)
+            with self._cond:
+                self._pending -= len(batch)
+                self._cond.notify_all()
+
+    def write(self, payload: bytes) -> None:
+        with self._cond:
+            self._queue.append(frame(payload))
+            self._pending += 1
+            self._cond.notify_all()
+
+    def flush(self) -> None:
+        with self._cond:
+            self._cond.wait_for(lambda: self._pending == 0)
+        self._file.flush()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
+        self._file.flush()
+        self._file.close()
+
+
+class _NativeWriter:
+    """ctypes shim over lens_tpu/native/libemit_writer.so."""
+
+    def __init__(self, lib, path: str):
+        self._lib = lib
+        self._handle = lib.ew_open(path.encode())
+        if not self._handle:
+            raise OSError(f"native emit writer failed to open {path!r}")
+
+    def write(self, payload: bytes) -> None:
+        rc = self._lib.ew_write(self._handle, payload, len(payload))
+        if rc != 0:
+            raise OSError(
+                f"native emit write failed: "
+                f"{self._lib.ew_error(self._handle).decode()}"
+            )
+
+    def flush(self) -> None:
+        if self._lib.ew_flush(self._handle) != 0:
+            raise OSError("native emit flush failed")
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ew_close(self._handle)
+            self._handle = None
+
+
+class LogEmitter(Emitter):
+    """Append records to a framed record log on disk.
+
+    Uses the native C++ background writer when available; otherwise the
+    Python fallback (identical bytes). ``path`` defaults to
+    ``out/<experiment_id>.lens``.
+    """
+
+    def __init__(
+        self,
+        experiment_id: str | None = None,
+        config: Mapping | None = None,
+        path: str | None = None,
+        native: bool = True,
+    ):
+        super().__init__(experiment_id, config)
+        self.path = path or os.path.join("out", f"{self.experiment_id}.lens")
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._writer = None
+        if native:
+            from lens_tpu.native import emit_writer_lib
+
+            lib = emit_writer_lib()
+            if lib is not None:
+                self._writer = _NativeWriter(lib, self.path)
+        if self._writer is None:
+            self._writer = _PyWriter(self.path)
+        self.native = isinstance(self._writer, _NativeWriter)
+        self._writer.write(
+            encode_record(make_header(self.experiment_id, self.config))
+        )
+
+    def emit(self, record: Mapping[str, Any]) -> None:
+        self._writer.write(encode_record(record))
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+EMITTERS = {
+    "null": NullEmitter,
+    "ram": RamEmitter,
+    "log": LogEmitter,
+}
+
+
+def get_emitter(config: Mapping[str, Any] | None = None) -> Emitter:
+    """Emitter from config: ``{"type": "log", "path": ..., ...}``."""
+    config = dict(config or {"type": "ram"})
+    kind = config.pop("type", "ram")
+    if kind not in EMITTERS:
+        raise ValueError(f"unknown emitter type {kind!r}; known: {sorted(EMITTERS)}")
+    return EMITTERS[kind](**config)
+
+
+__all__ = [
+    "Emitter",
+    "NullEmitter",
+    "RamEmitter",
+    "LogEmitter",
+    "get_emitter",
+    "read_experiment",
+    "stack_records",
+]

@@ -1,0 +1,154 @@
+"""The record-log format: framed, CRC-checked, npz-payload records.
+
+One emit log file holds a sequence of records; each record is a pytree of
+numpy arrays (flattened to ``path.joined/keys -> array``) plus scalar
+metadata, encoded as an uncompressed ``.npz`` blob. Framing (written by
+the native writer or the Python fallback, byte-identical):
+
+    u32 magic "LENS" | u32 crc32(payload) | u64 payload_len | payload
+
+The first record of a file is the experiment header (``__header__`` key:
+experiment id, config JSON, schema). Readers verify magic + CRC per
+record and stop cleanly at truncation (a killed run loses at most the
+tail record — the reference's MongoDB emitter has the same at-most-one
+semantics per row).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Mapping, Tuple
+
+import numpy as np
+
+MAGIC = 0x4C454E53
+_FRAME = struct.Struct("<IIQ")  # magic, crc32, payload_len
+
+#: Path separator inside npz keys (state paths can't contain it).
+SEP = "/"
+
+
+def encode_record(record: Mapping[str, Any]) -> bytes:
+    """Flatten a nested dict of arrays/scalars into npz payload bytes."""
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, Mapping):
+            for key, sub in node.items():
+                key = str(key)
+                if SEP in key:
+                    raise ValueError(
+                        f"record key {key!r} contains reserved separator {SEP!r}"
+                    )
+                walk(f"{prefix}{SEP}{key}" if prefix else key, sub)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", record)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def decode_record(payload: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`encode_record` (nested dict of numpy arrays)."""
+    npz = np.load(io.BytesIO(payload), allow_pickle=False)
+    out: Dict[str, Any] = {}
+    for key in npz.files:
+        node = out
+        parts = key.split(SEP)
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = npz[key]
+    return out
+
+
+def frame(payload: bytes) -> bytes:
+    """Wrap payload bytes in the record frame (magic, crc, length)."""
+    return _FRAME.pack(MAGIC, zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+
+
+def read_records(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield decoded records; stop cleanly at EOF or a truncated tail.
+
+    Raises ``ValueError`` on corruption that is NOT simple truncation
+    (bad magic or CRC mismatch with a complete frame).
+    """
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                return  # clean EOF / truncated header = lost tail record
+            magic, crc, length = _FRAME.unpack(head)
+            if magic != MAGIC:
+                raise ValueError(
+                    f"{path}: bad record magic {magic:#x} at offset "
+                    f"{f.tell() - _FRAME.size}"
+                )
+            payload = f.read(length)
+            if len(payload) < length:
+                return  # truncated tail record
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise ValueError(f"{path}: CRC mismatch at offset {f.tell()}")
+            yield decode_record(payload)
+
+
+def make_header(experiment_id: str, config: Mapping | None = None) -> Dict:
+    """The experiment-header record (first record of every log)."""
+    return {
+        "__header__": {
+            "experiment_id": np.asarray(experiment_id),
+            "config_json": np.asarray(json.dumps(dict(config or {}))),
+            "format_version": np.asarray(1),
+        }
+    }
+
+
+def is_header(record: Mapping) -> bool:
+    return "__header__" in record
+
+
+def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Read a whole log: (header dict, list of data records)."""
+    header: Dict[str, Any] = {}
+    records: List[Dict[str, Any]] = []
+    for record in read_records(path):
+        if is_header(record):
+            h = record["__header__"]
+            header = {
+                "experiment_id": str(h["experiment_id"]),
+                "config": json.loads(str(h["config_json"])),
+                "format_version": int(h["format_version"]),
+            }
+        else:
+            records.append(record)
+    return header, records
+
+
+def stack_records(records: List[Mapping]) -> Dict[str, Any]:
+    """Stack per-step records into one timeseries tree ([T, ...] leaves).
+
+    Records must share a tree structure (the emitter guarantees this
+    within one run segment).
+    """
+    if not records:
+        return {}
+    out: Dict[str, Any] = {}
+
+    def walk(node_list: List[Any], target: Dict, key: str) -> None:
+        first = node_list[0]
+        if isinstance(first, Mapping):
+            sub: Dict[str, Any] = {}
+            for k in first:
+                walk([n[k] for n in node_list], sub, k)
+            target[key] = sub
+        else:
+            target[key] = np.stack([np.asarray(n) for n in node_list])
+
+    for k in records[0]:
+        walk([r[k] for r in records], out, k)
+    return out

@@ -231,6 +231,41 @@ class SpatialColony:
             total_time, timestep, emit_every,
         )
 
+    def run_timeline(
+        self,
+        ss: SpatialState,
+        timeline,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+    ) -> Tuple[SpatialState, dict]:
+        """Run with media changes: the timeline splits the run into
+        segments; each segment is one jitted scan; at each boundary the
+        fields are reset from the segment's media recipe (host-side — a
+        few device stores per media switch, off the hot path).
+
+        ``timeline`` accepts anything ``environment.media.parse_timeline``
+        does, e.g. ``"0 minimal, 500 minimal_lactose"``. Segment
+        boundaries snap to whole steps (each duration must be a multiple
+        of ``timestep * emit_every``, same contract as ``run``).
+        """
+        from lens_tpu.environment.media import (
+            fields_from_media,
+            parse_timeline,
+            timeline_segments,
+        )
+
+        events = parse_timeline(timeline)
+        trajectories = []
+        for start, duration, media in timeline_segments(events, total_time):
+            ss = ss._replace(fields=fields_from_media(self.lattice, media))
+            ss, traj = self.run(ss, duration, timestep, emit_every)
+            trajectories.append(traj)
+        trajectory = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *trajectories
+        )
+        return ss, trajectory
+
     # -- diagnostics ---------------------------------------------------------
 
     def total_field_mass(self, ss: SpatialState) -> jax.Array:

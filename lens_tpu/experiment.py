@@ -1,0 +1,201 @@
+"""The experiment layer: named composites -> running, emitting, resumable sims.
+
+This is the rebuild of the reference's whole L4/L5 orchestration surface —
+boot registry, control CLI, shepherd, experiment commands (reconstructed:
+``lens/actor/boot.py``, ``control.py``, ``shepherd.py``, SURVEY.md §1
+L4-L5, §3.1). The actor machinery itself (Kafka loops, OS processes) has
+no TPU analogue — the colony IS one program — so what remains is exactly
+what the user actually touched:
+
+- a **registry** of named agent types/composites (models.composites),
+- an **Experiment**: config dict -> built model -> segmented run loop
+  with emission and checkpointing,
+- a **CLI** (`python -m lens_tpu run|list|resume ...`) replacing
+  `python -m lens.actor.control experiment ...`.
+
+The run loop is segmented: ``checkpoint_every`` sim-seconds per jitted
+scan segment, then emit (one device->host transfer per segment) and
+orbax-save. Interrupting between segments loses at most one segment;
+``Experiment.resume`` continues bitwise-identically (PRNG key and step
+counter live in the state).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from lens_tpu.checkpoint import Checkpointer
+from lens_tpu.colony.colony import Colony, ColonyState
+from lens_tpu.core.engine import Compartment
+from lens_tpu.emit import Emitter, get_emitter
+from lens_tpu.environment.spatial import SpatialColony, SpatialState
+from lens_tpu.models.composites import composite_registry
+from lens_tpu.utils.dicts import deep_merge
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "composite": "grow_divide",     # name in models.composites registry
+    "config": {},                    # composite factory config
+    "n_agents": 1,                   # initially-alive rows
+    "capacity": None,                # colony rows (None: composite default
+                                     # for spatial models; n_agents*64 else)
+    "division": True,                # watch ('global','divide') trigger
+    "total_time": 100.0,             # sim seconds
+    "timestep": 1.0,
+    "emit_every": 1,                 # engine steps between emits
+    "seed": 0,
+    "emitter": {"type": "ram"},
+    "checkpoint_dir": None,          # None: no checkpointing
+    "checkpoint_every": None,        # sim-seconds per segment (None: one segment)
+    "timeline": None,                # media timeline (spatial models only)
+    "overrides": {},                 # initial-state overrides
+}
+
+
+class Experiment:
+    """One configured, runnable simulation (the reference's "experiment").
+
+    Build from a config dict (deep-merged over ``DEFAULT_CONFIG``), then
+    ``run()``. The composite name selects the model; everything else is
+    scale/IO policy.
+    """
+
+    def __init__(self, config: Mapping[str, Any] | None = None):
+        self.config = deep_merge(DEFAULT_CONFIG, config)
+        name = self.config["composite"]
+        if name not in composite_registry:
+            raise ValueError(
+                f"unknown composite {name!r}; known: {sorted(composite_registry)}"
+            )
+        built = composite_registry[name](self.config["config"])
+        self.spatial: Optional[SpatialColony] = None
+        if isinstance(built, tuple):  # (SpatialColony, Compartment)
+            self.spatial, self.compartment = built
+            self.colony = self.spatial.colony
+        elif isinstance(built, Compartment):
+            self.compartment = built
+            capacity = self.config["capacity"] or max(
+                int(self.config["n_agents"]) * 64, 64
+            )
+            trigger = (
+                ("global", "divide")
+                if self.config["division"]
+                and ("global", "divide") in built.updaters
+                else None
+            )
+            self.colony = Colony(built, capacity=capacity, division_trigger=trigger)
+        else:
+            raise TypeError(
+                f"composite factory {name!r} returned {type(built)!r}"
+            )
+        self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
+        self.checkpointer = (
+            Checkpointer(self.config["checkpoint_dir"])
+            if self.config["checkpoint_dir"]
+            else None
+        )
+
+    # -- state construction --------------------------------------------------
+
+    def initial_state(self):
+        key = jax.random.PRNGKey(int(self.config["seed"]))
+        n = int(self.config["n_agents"])
+        overrides = self.config["overrides"] or None
+        if self.spatial is not None:
+            return self.spatial.initial_state(n, key, overrides=overrides)
+        return self.colony.initial_state(n, overrides=overrides, key=key)
+
+    # -- running -------------------------------------------------------------
+
+    def _segment_plan(self) -> Tuple[float, int]:
+        total = float(self.config["total_time"])
+        seg = self.config["checkpoint_every"]
+        seg = float(seg) if seg else total
+        n_segments = max(int(round(total / seg)), 1)
+        return seg, n_segments
+
+    def _run_segment(self, state, duration: float):
+        dt = float(self.config["timestep"])
+        emit_every = int(self.config["emit_every"])
+        if self.spatial is not None:
+            if self.config["timeline"] is not None:
+                return self.spatial.run_timeline(
+                    state, self.config["timeline"], duration, dt, emit_every
+                )
+            return self.spatial.run(state, duration, dt, emit_every)
+        return self.colony.run(state, duration, dt, emit_every)
+
+    def _state_step(self, state) -> int:
+        cs = state.colony if isinstance(state, SpatialState) else state
+        return int(cs.step)
+
+    def run(self, state=None, verbose: bool = False):
+        """Run ``total_time``, emitting and checkpointing per segment.
+
+        Returns the final state. Timeseries access depends on the emitter
+        (``RamEmitter.timeseries()``, or the log file on disk).
+        """
+        if state is None:
+            state = self.initial_state()
+        seg, n_segments = self._segment_plan()
+        dt = float(self.config["timestep"])
+        emit_every = int(self.config["emit_every"])
+        for k in range(n_segments):
+            t0 = time.perf_counter()
+            state, trajectory = self._run_segment(state, seg)
+            start_step = self._state_step(state) - int(round(seg / dt))
+            times = (
+                np.arange(1, int(round(seg / dt)) // emit_every + 1)
+                * emit_every
+                * dt
+                + start_step * dt
+            )
+            self.emitter.emit_trajectory(trajectory, times=times)
+            if self.checkpointer is not None:
+                self.checkpointer.save(state, self._state_step(state))
+            if verbose:
+                wall = time.perf_counter() - t0
+                print(
+                    f"segment {k + 1}/{n_segments}: sim t="
+                    f"{self._state_step(state) * dt:g}s  wall={wall:.2f}s  "
+                    f"alive={int(np.asarray(jax.device_get(self.n_alive(state))))}"
+                )
+        self.emitter.flush()
+        return state
+
+    def n_alive(self, state):
+        cs = state.colony if isinstance(state, SpatialState) else state
+        return self.colony.n_alive(cs)
+
+    def resume(self, verbose: bool = False):
+        """Continue from the latest checkpoint through ``total_time``.
+
+        The checkpointed step counter determines the remaining time; the
+        continuation is bitwise-identical to an uninterrupted run.
+        """
+        if self.checkpointer is None:
+            raise ValueError("resume() needs checkpoint_dir in the config")
+        state = self.checkpointer.restore()
+        done = self._state_step(state) * float(self.config["timestep"])
+        remaining = float(self.config["total_time"]) - done
+        if remaining <= 0:
+            return state
+        original = self.config["total_time"]
+        self.config["total_time"] = remaining
+        try:
+            return self.run(state, verbose=verbose)
+        finally:
+            self.config["total_time"] = original
+
+    def close(self) -> None:
+        self.emitter.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

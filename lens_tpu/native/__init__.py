@@ -1,0 +1,81 @@
+"""Native (C++) runtime components and their ctypes bindings.
+
+The reference's runtime leans on third-party native code — librdkafka for
+transport, MongoDB for the emit sink (SURVEY.md §2 "native components").
+The transport disappears in the rebuild (stacked state + collectives);
+the emit sink's native piece lives here: ``emit_writer.cpp``, a
+background-thread record writer the Python emitter drives through ctypes.
+
+The shared library is built on first use with the repo's Makefile (g++ is
+part of the baked toolchain); if the build fails for any reason the
+caller falls back to a pure-Python writer with identical file format —
+functionality is never blocked on the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_NATIVE_DIR, "libemit_writer.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    """Build the shared library if missing; True on success."""
+    if os.path.exists(_SO_PATH):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def emit_writer_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call.
+
+    Returns None (and remembers the failure) when the toolchain is
+    unavailable — callers must fall back to the Python writer.
+    """
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.ew_open.argtypes = [ctypes.c_char_p]
+        lib.ew_open.restype = ctypes.c_void_p
+        lib.ew_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.ew_write.restype = ctypes.c_int
+        lib.ew_flush.argtypes = [ctypes.c_void_p]
+        lib.ew_flush.restype = ctypes.c_int
+        lib.ew_close.argtypes = [ctypes.c_void_p]
+        lib.ew_close.restype = ctypes.c_int
+        lib.ew_error.argtypes = [ctypes.c_void_p]
+        lib.ew_error.restype = ctypes.c_char_p
+        _lib = lib
+        return _lib

@@ -1,0 +1,175 @@
+// Native emit sink: a background-thread record writer.
+//
+// The reference's emit path hands every agent's timeseries row to MongoDB
+// through a C++ client (reconstructed: SURVEY.md §2 "native components" —
+// MongoDB is the emit sink; §5 "Metrics/logging"). The rebuild replaces
+// the database with an append-only record log on local disk, and this
+// file is the native piece: a lock-guarded ring of pending buffers
+// drained by a writer thread, so the simulation's host thread never
+// blocks on disk I/O (SURVEY.md §7 hard parts: "Emitter without killing
+// throughput").
+//
+// Record framing (little-endian, written atomically per record):
+//   u32 magic 0x4C454E53 ("LENS"), u32 crc32 of payload, u64 payload len,
+//   payload bytes.
+// The Python side (lens_tpu/emit/log.py) owns payload encoding; this
+// layer moves bytes.
+//
+// C ABI (ctypes): ew_open / ew_write / ew_flush / ew_close / ew_error.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4C454E53;  // "LENS"
+constexpr size_t kMaxQueueBytes = 256u << 20;  // 256 MiB backpressure cap
+
+uint32_t crc32_table[256];
+bool crc32_init_done = false;
+
+void crc32_init() {
+  if (crc32_init_done) return;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+  crc32_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* data, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i)
+    c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* file = nullptr;
+  std::thread thread;
+  std::mutex mu;
+  std::condition_variable cv;        // signals the writer thread
+  std::condition_variable drained;   // signals flush/backpressure waiters
+  std::deque<std::vector<uint8_t>> queue;
+  size_t queued_bytes = 0;
+  bool stop = false;
+  bool io_error = false;
+  std::string error;
+
+  void run() {
+    for (;;) {
+      std::vector<uint8_t> rec;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv.wait(lock, [&] { return stop || !queue.empty(); });
+        if (queue.empty()) {
+          if (stop) return;
+          continue;
+        }
+        rec = std::move(queue.front());
+        queue.pop_front();
+        queued_bytes -= rec.size();
+      }
+      if (!io_error) {
+        size_t n = fwrite(rec.data(), 1, rec.size(), file);
+        if (n != rec.size()) {
+          std::lock_guard<std::mutex> lock(mu);
+          io_error = true;
+          error = "short write to emit log";
+        }
+      }
+      drained.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle (heap pointer) or 0 on failure.
+void* ew_open(const char* path) {
+  crc32_init();
+  FILE* f = fopen(path, "ab");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->file = f;
+  w->thread = std::thread([w] { w->run(); });
+  return w;
+}
+
+// Enqueue one framed record. Returns 0 on success, -1 on error.
+// Blocks only if the queue exceeds the backpressure cap (disk is the
+// bottleneck at that point anyway).
+int ew_write(void* handle, const uint8_t* payload, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  std::vector<uint8_t> rec(16 + len);
+  uint32_t magic = kMagic;
+  uint32_t crc = crc32(payload, len);
+  std::memcpy(rec.data(), &magic, 4);
+  std::memcpy(rec.data() + 4, &crc, 4);
+  std::memcpy(rec.data() + 8, &len, 8);
+  std::memcpy(rec.data() + 16, payload, len);
+  {
+    std::unique_lock<std::mutex> lock(w->mu);
+    if (w->io_error) return -1;
+    w->drained.wait(lock, [&] {
+      return w->queued_bytes + rec.size() <= kMaxQueueBytes || w->io_error;
+    });
+    if (w->io_error) return -1;
+    w->queued_bytes += rec.size();
+    w->queue.push_back(std::move(rec));
+  }
+  w->cv.notify_one();
+  return 0;
+}
+
+// Block until the queue is drained and the OS buffer flushed.
+int ew_flush(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  {
+    std::unique_lock<std::mutex> lock(w->mu);
+    w->drained.wait(lock, [&] { return w->queue.empty() || w->io_error; });
+    if (w->io_error) return -1;
+  }
+  return fflush(w->file) == 0 ? 0 : -1;
+}
+
+// Flush, stop the thread, close the file, free the handle.
+int ew_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  if (!w) return -1;
+  {
+    std::lock_guard<std::mutex> lock(w->mu);
+    w->stop = true;
+  }
+  w->cv.notify_all();
+  w->thread.join();
+  int rc = 0;
+  if (w->io_error) rc = -1;
+  if (fclose(w->file) != 0) rc = -1;
+  delete w;
+  return rc;
+}
+
+// Last error message (empty if none). Valid until the next call.
+const char* ew_error(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  static thread_local std::string out;
+  if (!w) return "null handle";
+  std::lock_guard<std::mutex> lock(w->mu);
+  out = w->error;
+  return out.c_str();
+}
+
+}  // extern "C"

@@ -21,7 +21,6 @@ reference's boolean regulation parser (``lens/utils/regulation_logic.py``).
 
 from __future__ import annotations
 
-from typing import Mapping, Sequence
 
 import jax.numpy as jnp
 
@@ -208,7 +207,14 @@ class Complexation(Process):
         update = {s: 0.0 for s in self.ports_schema()["counts"]}
         for cplx, rxn in reactions.items():
             forward = forwards[cplx] * scales[cplx]
-            reverse = first_order(rxn["k_off"], counts[cplx]) * timestep
+            # reverse is clamped to the complex pool for the same reason
+            # the forwards are jointly clamped: an overshooting
+            # dissociation would be floored at 0 by the updater while the
+            # subunits were credited the full amount — fabricating mass
+            pool = jnp.maximum(counts[cplx], 0.0)
+            reverse = jnp.minimum(
+                first_order(rxn["k_off"], counts[cplx]) * timestep, pool
+            )
             net = forward - reverse
             update[cplx] = update[cplx] + net
             for species, stoich in rxn["subunits"].items():

@@ -29,7 +29,7 @@ store the fluxes write, closing the Covert–Palsson regulatory loop.
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Sequence, Tuple
+from typing import Dict, Tuple
 
 import jax.numpy as jnp
 import numpy as np

@@ -30,7 +30,7 @@ def _default_table():
     ext = np.linspace(0.0, 20.0, 32, dtype=np.float32)     # mM external
     internal = np.linspace(0.0, 10.0, 16, dtype=np.float32)  # mM internal
     e, i = np.meshgrid(ext, internal, indexing="ij")
-    flux = 0.1 * e / (0.5 + e) * 1.0 / (1.0 + i / 5.0)
+    flux = np.asarray(michaelis_menten(e, 0.1, 0.5)) / (1.0 + i / 5.0)
     return ext, internal, flux.astype(np.float32)
 
 

@@ -1,0 +1,92 @@
+"""Surrogate cells: trivial CellSimulations for plumbing tests.
+
+The reference ships "surrogate" cell sims — near-trivial implementations
+of the CellSimulation interface — so the actor/lattice machinery can be
+exercised without real biology (reconstructed: ``lens/surrogates/``,
+SURVEY.md §2, §4). The rebuild's equivalents plug into
+``lens_tpu.bridge.HostExchangeLoop`` and serve the same role for the host
+path (the device path is exercised by real Processes, which are cheap
+there).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+class ConstantUptakeSurrogate:
+    """Consumes a fixed amount of one molecule per window. No dynamics."""
+
+    def __init__(self, molecule: str = "glucose", uptake_per_s: float = 0.1):
+        self.molecule = molecule
+        self.uptake_per_s = float(uptake_per_s)
+        self.local = 0.0
+        self.time = 0.0
+        self._consumed = 0.0
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        self.local = float(update.get(self.molecule, 0.0))
+
+    def run_incremental(self, run_until: float) -> None:
+        dt = run_until - self.time
+        # cannot take more than is locally available
+        self._consumed += min(self.uptake_per_s * dt, self.local)
+        self.time = run_until
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        update = {"exchange": {self.molecule: -self._consumed}, "divide": False}
+        self._consumed = 0.0
+        return update
+
+    def divide(self) -> Tuple["ConstantUptakeSurrogate", "ConstantUptakeSurrogate"]:
+        raise NotImplementedError("this surrogate never divides")
+
+    def finalize(self) -> None:
+        pass
+
+
+class GrowDivideSurrogate:
+    """Doubles a volume counter at a fixed rate; divides at threshold.
+
+    Exercises the host loop's division handshake (SURVEY.md §3.3) with
+    zero biochemical content.
+    """
+
+    def __init__(self, volume: float = 1.0, rate: float = 0.02, threshold: float = 2.0):
+        self.volume = float(volume)
+        self.rate = float(rate)
+        self.threshold = float(threshold)
+        self.time = 0.0
+        self.finalized = False
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        pass
+
+    def run_incremental(self, run_until: float) -> None:
+        dt = run_until - self.time
+        self.volume *= float(np.exp(self.rate * dt))
+        self.time = run_until
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        return {
+            "exchange": {},
+            "volume": self.volume,
+            "divide": self.volume >= self.threshold,
+        }
+
+    def divide(self) -> Tuple["GrowDivideSurrogate", "GrowDivideSurrogate"]:
+        half = self.volume / 2.0
+        mk = lambda: GrowDivideSurrogate(  # noqa: E731
+            half, self.rate, self.threshold
+        )
+        a, b = mk(), mk()
+        a.time = b.time = self.time
+        return a, b
+
+    def finalize(self) -> None:
+        self.finalized = True
+
+
+__all__ = ["ConstantUptakeSurrogate", "GrowDivideSurrogate"]

@@ -1,0 +1,94 @@
+"""Tracing/profiling: phase timers + jax profiler hooks.
+
+The reference has no dedicated tracing — wall-clock logging at agent level
+at best (SURVEY.md §5 "Tracing/profiling"); the rebuild ships the TPU
+equivalents: phase timers that fence on ``block_until_ready`` (an async
+dispatch means un-fenced timings measure nothing) and a context manager
+around ``jax.profiler`` for on-demand XLA traces viewable in
+TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+
+class PhaseTimer:
+    """Accumulate wall-clock per named phase, fencing device work.
+
+    >>> timer = PhaseTimer()
+    >>> with timer.phase("step", fence=state):
+    ...     state = step(state)
+    >>> timer.summary()
+    {'step': {'total_s': ..., 'calls': 1, 'mean_s': ...}}
+
+    ``fence`` (any pytree of arrays) is blocked on AFTER the body, so the
+    recorded time includes the device execution the body dispatched —
+    pass the phase's OUTPUT. Without a fence the timing is dispatch-only.
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.calls: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str, fence: Any = None) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if fence is not None:
+                jax.block_until_ready(fence)
+            elapsed = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + elapsed
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        """Run ``fn`` under the timer, fencing on its result; return it."""
+        start = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - start
+        self.totals[name] = self.totals.get(name, 0.0) + elapsed
+        self.calls[name] = self.calls.get(name, 0) + 1
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": total,
+                "calls": self.calls[name],
+                "mean_s": total / self.calls[name],
+            }
+            for name, total in self.totals.items()
+        }
+
+    def report(self) -> str:
+        lines = []
+        for name, s in sorted(
+            self.summary().items(), key=lambda kv: -kv[1]["total_s"]
+        ):
+            lines.append(
+                f"{name:30s} {s['total_s']:9.3f}s total  "
+                f"{s['calls']:6d} calls  {s['mean_s'] * 1e3:9.3f} ms/call"
+            )
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str = "/tmp/lens_tpu_trace") -> Iterator[str]:
+    """Capture an XLA profiler trace for the enclosed block.
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev. Device ops
+    inside the block must complete inside it (fence before exit) to land
+    in the trace.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,185 @@
+"""The host bridge (CellSimulation protocol), surrogates, and timers."""
+
+import jax
+import numpy as np
+
+from lens_tpu.bridge import CompartmentSimulation, HostExchangeLoop
+from lens_tpu.core.engine import Compartment
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.processes import MichaelisMentenTransport
+from lens_tpu.surrogates import ConstantUptakeSurrogate, GrowDivideSurrogate
+from lens_tpu.utils.timers import PhaseTimer
+
+
+def small_lattice(**kw):
+    defaults = dict(
+        molecules=["glucose"],
+        shape=(8, 8),
+        size=(8.0, 8.0),
+        diffusion=1.0,
+        initial=10.0,
+        timestep=1.0,
+    )
+    defaults.update(kw)
+    return Lattice(**defaults)
+
+
+class TestHostLoop:
+    def test_uptake_surrogate_depletes_field(self):
+        loop = HostExchangeLoop(small_lattice())
+        loop.add_agent(ConstantUptakeSurrogate(uptake_per_s=0.5), (4.0, 4.0))
+        m0 = float(loop.fields.sum())
+        loop.run(10.0)
+        m1 = float(loop.fields.sum())
+        np.testing.assert_allclose(m0 - m1, 5.0, rtol=1e-4)
+
+    def test_division_handshake(self):
+        loop = HostExchangeLoop(small_lattice())
+        loop.add_agent(GrowDivideSurrogate(volume=1.9, rate=0.05), (4.0, 4.0))
+        parent = loop.agents[0].sim
+        loop.run(3.0)  # 1.9 * e^{0.15} > 2 -> divides
+        assert len(loop.agents) == 2
+        assert parent.finalized
+        va = loop.agents[0].sim.volume
+        vb = loop.agents[1].sim.volume
+        np.testing.assert_allclose(va, vb)
+        assert va < 1.9
+        # daughters placed apart
+        assert not np.allclose(loop.agents[0].location, loop.agents[1].location)
+
+    def test_population_growth_over_generations(self):
+        loop = HostExchangeLoop(small_lattice())
+        loop.add_agent(GrowDivideSurrogate(volume=1.0, rate=0.05), (2.0, 2.0))
+        loop.run(50.0)  # ~3.6 doublings
+        assert len(loop.agents) >= 4
+
+
+class TestCompartmentSimulation:
+    """The adapter must reproduce the device path's behavior (the two
+    paths implement the same exchange-window semantics)."""
+
+    def make_sim(self):
+        comp = Compartment(
+            processes={"transport": MichaelisMentenTransport()},
+            topology={
+                "transport": {
+                    "external": ("boundary", "external"),
+                    "internal": ("cell",),
+                    "exchange": ("boundary", "exchange"),
+                }
+            },
+        )
+        return CompartmentSimulation(
+            comp,
+            field_ports={
+                "glucose": (
+                    ("boundary", "external", "glucose"),
+                    ("boundary", "exchange", "glucose_exchange"),
+                )
+            },
+        )
+
+    def test_protocol_cycle(self):
+        sim = self.make_sim()
+        sim.apply_outer_update({"glucose": 10.0})
+        sim.run_incremental(5.0)
+        update = sim.generate_inner_update()
+        assert update["exchange"]["glucose"] < 0  # net uptake
+        assert update["divide"] is False
+        # exchange accumulator was drained
+        assert sim.generate_inner_update()["exchange"]["glucose"] == 0.0
+
+    def test_host_loop_matches_device_path(self):
+        """One agent, same model: HostExchangeLoop vs SpatialColony."""
+        from lens_tpu.colony.colony import Colony
+        from lens_tpu.environment.spatial import SpatialColony
+        from lens_tpu.processes import Growth
+
+        def make_comp():
+            return Compartment(
+                processes={"transport": MichaelisMentenTransport()},
+                topology={
+                    "transport": {
+                        "external": ("boundary", "external"),
+                        "internal": ("cell",),
+                        "exchange": ("boundary", "exchange"),
+                    }
+                },
+            )
+
+        # host path
+        loop = HostExchangeLoop(small_lattice(diffusion=0.0))
+        loop.add_agent(
+            CompartmentSimulation(
+                make_comp(),
+                field_ports={
+                    "glucose": (
+                        ("boundary", "external", "glucose"),
+                        ("boundary", "exchange", "glucose_exchange"),
+                    )
+                },
+            ),
+            (4.5, 4.5),
+        )
+        loop.run(10.0)
+        host_mass = float(loop.fields.sum())
+
+        # device path: same model, but location is a schema leaf there —
+        # reuse the compartment plus a location-owning dummy via overrides
+        comp = make_comp()
+        # add location through a motility process-free override: SpatialColony
+        # requires the path in the schema, so wire BrownianMotility with
+        # sigma=0 (exactly zero displacement)
+        from lens_tpu.processes import BrownianMotility
+
+        comp2 = Compartment(
+            processes={
+                "transport": MichaelisMentenTransport(),
+                "motility": BrownianMotility({"sigma": 0.0}),
+            },
+            topology={
+                "transport": {
+                    "external": ("boundary", "external"),
+                    "internal": ("cell",),
+                    "exchange": ("boundary", "exchange"),
+                },
+                "motility": {"boundary": ("boundary",)},
+            },
+        )
+        colony = Colony(comp2, capacity=1)
+        spatial = SpatialColony(
+            colony,
+            small_lattice(diffusion=0.0),
+            field_ports={
+                "glucose": (
+                    ("boundary", "external", "glucose"),
+                    ("boundary", "exchange", "glucose_exchange"),
+                )
+            },
+        )
+        ss = spatial.initial_state(
+            1, jax.random.PRNGKey(0),
+            locations=np.asarray([[4.5, 4.5]], np.float32),
+        )
+        ss, _ = spatial.run(ss, 10.0, 1.0)
+        device_mass = float(ss.fields.sum())
+        np.testing.assert_allclose(host_mass, device_mass, rtol=1e-5)
+
+
+class TestTimers:
+    def test_phase_timer_accumulates(self):
+        timer = PhaseTimer()
+        x = jax.numpy.ones((64, 64))
+        for _ in range(3):
+            with timer.phase("matmul", fence=x):
+                x = x @ x
+        s = timer.summary()
+        assert s["matmul"]["calls"] == 3
+        assert s["matmul"]["total_s"] > 0
+        assert "matmul" in timer.report()
+
+    def test_timed_returns_result(self):
+        timer = PhaseTimer()
+        out = timer.timed("add", lambda a, b: a + b, 1.0, 2.0)
+        assert out == 3.0
+        assert timer.summary()["add"]["calls"] == 1

@@ -1,0 +1,127 @@
+"""Data layer loaders + media maker + timelines (SURVEY.md §2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lens_tpu.data import load_json, load_table, load_tsv
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.environment.media import (
+    fields_from_media,
+    make_media,
+    media_recipes,
+    parse_timeline,
+    timeline_segments,
+)
+
+
+class TestDataLayer:
+    def test_load_json_recipes(self):
+        recipes = load_json("media_recipes.json")
+        assert "minimal" in recipes
+        assert recipes["minimal"]["glucose"] == 10.0
+
+    def test_load_tsv_parses_types(self):
+        rows = load_tsv("kinetic_parameters.tsv")
+        assert len(rows) > 5
+        row = rows[0]
+        assert row["process"] == "glucose_pts"
+        assert isinstance(row["value"], float)
+
+    def test_load_table_collapse(self):
+        rows = load_tsv("kinetic_parameters.tsv")
+        glucose_rows = [r for r in rows if r["process"] == "glucose_pts"]
+        assert {r["parameter"]: r["value"] for r in glucose_rows}["km"] == 0.5
+
+
+class TestMakeMedia:
+    def test_named_recipe(self):
+        media = make_media("minimal")
+        assert media == {"glucose": 10.0}
+
+    def test_overrides(self):
+        media = make_media("minimal", {"glucose": 2.0, "lactose": 1.0})
+        assert media == {"glucose": 2.0, "lactose": 1.0}
+
+    def test_literal_dict(self):
+        assert make_media({"x": 1}) == {"x": 1.0}
+
+    def test_unknown_recipe_raises(self):
+        with pytest.raises(KeyError, match="unknown media recipe"):
+            make_media("nope")
+
+    def test_recipes_are_copies(self):
+        a = make_media("minimal")
+        a["glucose"] = 0.0
+        assert media_recipes()["minimal"]["glucose"] == 10.0
+
+
+class TestTimeline:
+    def test_parse_string(self):
+        events = parse_timeline("0 minimal, 500 minimal_lactose")
+        assert len(events) == 2
+        assert events[0][0] == 0.0
+        assert events[1][1]["lactose"] == 10.0
+
+    def test_parse_sequence_with_dicts(self):
+        events = parse_timeline([(0, {"glucose": 1.0}), (100, "blank")])
+        assert events[1][1] == {}
+
+    def test_must_start_at_zero(self):
+        with pytest.raises(ValueError, match="must start at t=0"):
+            parse_timeline("100 minimal")
+
+    def test_duplicate_times_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_timeline([(0, "minimal"), (0, "blank")])
+
+    def test_segments(self):
+        events = parse_timeline("0 minimal, 500 minimal_lactose")
+        segs = timeline_segments(events, 800.0)
+        assert [(s, d) for s, d, _ in segs] == [(0.0, 500.0), (500.0, 300.0)]
+        # events beyond total_time are dropped
+        segs = timeline_segments(events, 400.0)
+        assert len(segs) == 1 and segs[0][1] == 400.0
+
+    def test_fields_from_media(self):
+        lattice = Lattice(
+            molecules=["glucose", "lactose"], shape=(8, 8), timestep=1.0
+        )
+        fields = fields_from_media(lattice, {"lactose": 3.0})
+        assert fields.shape == (2, 8, 8)
+        assert float(fields[0].max()) == 0.0  # glucose absent -> 0
+        assert float(fields[1].min()) == 3.0
+
+
+class TestTimelineRun:
+    def test_media_switch_resets_fields(self):
+        """run_timeline resets fields at segment boundaries: glucose is
+        drawn down in segment 1, replenished by the t=8 media event."""
+        from lens_tpu.models.composites import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {
+                "capacity": 16,
+                "shape": (4, 4),
+                "size": (4.0, 4.0),
+                "diffusion": 0.0,
+                "initial_glucose": 10.0,
+                "division": False,
+                "transport": {"vmax": 1.0},
+            }
+        )
+        ss = spatial.initial_state(8, jax.random.PRNGKey(0))
+        final, traj = spatial.run_timeline(
+            ss,
+            [(0, {"glucose": 10.0}), (8, {"glucose": 10.0})],
+            16.0,
+            1.0,
+        )
+        fields = np.asarray(traj["fields"])  # [16, 1, 4, 4]
+        assert fields.shape[0] == 16
+        mass = fields.sum(axis=(1, 2, 3))
+        # drawdown within segment 1...
+        assert mass[7] < mass[0]
+        # ...then the media reset restores the full field at t=8
+        assert mass[8] > mass[7]

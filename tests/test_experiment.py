@@ -1,0 +1,186 @@
+"""Experiment layer: registry boot, segmented runs, checkpoint/resume, CLI."""
+
+import numpy as np
+import pytest
+
+from lens_tpu.emit import RamEmitter
+from lens_tpu.experiment import Experiment
+
+
+class TestExperiment:
+    def test_colony_experiment_runs_and_emits(self):
+        with Experiment(
+            {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 64,
+                "total_time": 30.0,
+                "emit_every": 10,
+            }
+        ) as exp:
+            exp.run()
+            ts = exp.emitter.timeseries()
+        assert ts["cell"]["protein_u"].shape == (3, 64)
+        np.testing.assert_allclose(ts["__time__"], [10.0, 20.0, 30.0])
+
+    def test_spatial_experiment_runs(self):
+        with Experiment(
+            {
+                "composite": "ecoli_lattice",
+                "config": {
+                    "capacity": 16,
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                    "division": False,
+                },
+                "n_agents": 8,
+                "total_time": 5.0,
+            }
+        ) as exp:
+            state = exp.run()
+            assert int(np.asarray(exp.n_alive(state))) == 8
+            ts = exp.emitter.timeseries()
+        assert ts["fields"].shape == (5, 1, 8, 8)
+
+    def test_unknown_composite_raises(self):
+        with pytest.raises(ValueError, match="unknown composite"):
+            Experiment({"composite": "nope"})
+
+    def test_division_grows_population(self):
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.01}},
+                "n_agents": 2,
+                "capacity": 64,
+                "total_time": 120.0,
+            }
+        ) as exp:
+            state = exp.run()
+            assert int(np.asarray(exp.n_alive(state))) > 2
+
+
+class TestCheckpointResume:
+    def config(self, tmp_path, total_time):
+        return {
+            "composite": "toggle_colony",
+            "n_agents": 4,
+            "capacity": 32,
+            "total_time": total_time,
+            "checkpoint_dir": str(tmp_path / "ckpt"),
+            "checkpoint_every": 10.0,
+            "emitter": {"type": "null"},
+        }
+
+    def test_resume_is_bitwise_identical(self, tmp_path):
+        # uninterrupted 40s run
+        with Experiment(self.config(tmp_path / "a", 40.0)) as exp:
+            full = exp.run()
+        # interrupted: 20s now...
+        with Experiment(self.config(tmp_path / "b", 20.0)) as exp:
+            exp.run()
+        # ...then a FRESH Experiment resumes to 40s total
+        cfg = self.config(tmp_path / "b", 40.0)
+        with Experiment(cfg) as exp:
+            resumed = exp.resume()
+        np.testing.assert_array_equal(
+            np.asarray(full.agents["cell"]["protein_u"]),
+            np.asarray(resumed.agents["cell"]["protein_u"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(full.key), np.asarray(resumed.key)
+        )
+        assert int(full.step) == int(resumed.step)
+
+    def test_resume_no_checkpoint_raises(self, tmp_path):
+        cfg = self.config(tmp_path, 10.0)
+        cfg["checkpoint_dir"] = None
+        with Experiment(cfg) as exp:
+            with pytest.raises(ValueError, match="needs checkpoint_dir"):
+                exp.resume()
+
+    def test_resume_past_total_time_is_noop(self, tmp_path):
+        with Experiment(self.config(tmp_path, 20.0)) as exp:
+            exp.run()
+        with Experiment(self.config(tmp_path, 20.0)) as exp:
+            state = exp.resume()
+        assert int(state.step) == 20
+
+
+class TestCheckpointer:
+    def test_colony_state_roundtrip(self, tmp_path):
+        from lens_tpu.checkpoint import Checkpointer
+        from lens_tpu.colony.colony import Colony
+        from lens_tpu.models.composites import grow_divide
+
+        colony = Colony(grow_divide(), capacity=16)
+        cs = colony.initial_state(4)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(cs, 0)
+        restored = ck.restore()
+        assert type(restored).__name__ == "ColonyState"
+        np.testing.assert_array_equal(
+            np.asarray(cs.alive), np.asarray(restored.alive)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cs.agents["global"]["volume"]),
+            np.asarray(restored.agents["global"]["volume"]),
+        )
+
+    def test_latest_step_selection(self, tmp_path):
+        from lens_tpu.checkpoint import Checkpointer
+        from lens_tpu.colony.colony import Colony
+        from lens_tpu.models.composites import grow_divide
+
+        colony = Colony(grow_divide(), capacity=8)
+        cs = colony.initial_state(2)
+        ck = Checkpointer(str(tmp_path))
+        ck.save(cs, 5)
+        ck.save(cs._replace(step=cs.step + 7), 12)
+        assert ck.steps() == [5, 12]
+        assert int(ck.restore().step) == 7
+
+
+class TestCLI:
+    def test_list_command(self, capsys):
+        from lens_tpu.__main__ import main
+
+        assert main(["list"]) == 0
+        out = capsys.readouterr().out
+        assert "toggle_colony" in out
+        assert "ecoli_lattice" in out
+        assert "log" in out
+
+    def test_run_command_with_log_emitter(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        out_dir = str(tmp_path / "exp")
+        rc = main(
+            [
+                "run",
+                "--composite",
+                "grow_divide",
+                "--n-agents",
+                "2",
+                "--capacity",
+                "16",
+                "--time",
+                "20",
+                "--emitter",
+                "log",
+                "--out-dir",
+                out_dir,
+                "--checkpoint-every",
+                "10",
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+        assert "done:" in capsys.readouterr().out
+        from lens_tpu.analysis import load
+
+        header, ts = load(f"{out_dir}/emit.lens")
+        assert ts["global"]["volume"].shape[0] == 20
+        from lens_tpu.checkpoint import Checkpointer
+
+        assert Checkpointer(f"{out_dir}/checkpoints").steps() == [10, 20]
