@@ -18,7 +18,9 @@ from lens_tpu.environment.lattice import Lattice
 from lens_tpu.environment.spatial import SpatialColony
 from lens_tpu.processes import (
     BrownianMotility,
+    DeriveVolume,
     DivideTrigger,
+    FBAMetabolism,
     GlucosePTS,
     Growth,
     MichaelisMentenTransport,
@@ -37,6 +39,47 @@ def register_composite(fn: Callable[..., Any]) -> Callable[..., Any]:
 
 def _cfg(defaults: dict, config: Mapping | None) -> dict:
     return deep_merge(defaults, config)
+
+
+def _spatial_colony(
+    compartment: Compartment,
+    molecules: list,
+    c: Mapping,
+    diffusion,
+    initial,
+) -> Tuple[SpatialColony, Compartment]:
+    """Shared assembly tail for lattice composites: Colony + Lattice +
+    SpatialColony with the standard boundary port wiring (one
+    ``boundary.external.<mol>`` / ``boundary.exchange.<mol>_exchange``
+    pair per field molecule, location at ``boundary.location``)."""
+    colony = Colony(
+        compartment,
+        capacity=int(c["capacity"]),
+        division_trigger=("global", "divide") if c["division"] else None,
+    )
+    shape = tuple(c["shape"])
+    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
+    lattice = Lattice(
+        molecules=molecules,
+        shape=shape,
+        size=size,
+        diffusion=diffusion,
+        initial=initial,
+        timestep=c["timestep"],
+    )
+    spatial = SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            mol: (
+                ("boundary", "external", mol),
+                ("boundary", "exchange", f"{mol}_exchange"),
+            )
+            for mol in molecules
+        },
+        location_path=("boundary", "location"),
+    )
+    return spatial, compartment
 
 
 @register_composite
@@ -127,6 +170,62 @@ def hybrid_cell(config: Mapping | None = None) -> Compartment:
 
 
 @register_composite
+def rfba_lattice(
+    config: Mapping | None = None,
+) -> Tuple[SpatialColony, Compartment]:
+    """Regulated-FBA E. coli colony on a glucose/acetate/oxygen lattice.
+
+    The exact-LP metabolism model (Covert–Palsson 2002 lineage — see
+    :mod:`lens_tpu.processes.fba_metabolism`): each cell maximizes biomass
+    flux over the core-carbon network with boolean regulation, secreting
+    acetate under overflow and re-consuming it after glucose exhaustion —
+    colony-scale diauxie with spatial nutrient gradients. Mass from
+    biomass flux drives volume (DeriveVolume) and division.
+    """
+    c = _cfg(
+        {
+            "capacity": 1024,
+            "shape": (64, 64),
+            "size": None,             # defaults to 10 um bins
+            "diffusion": {"glc": 600.0, "ace": 900.0, "o2": 2000.0},
+            "initial": {"glc": 10.0, "ace": 0.0, "o2": 5.0},
+            "timestep": 1.0,
+            "metabolism": {},
+            "divide": {},
+            "motility": {"sigma": 0.5},
+            "division": True,
+        },
+        config,
+    )
+    metabolism = FBAMetabolism(c["metabolism"])
+    processes = {
+        "metabolism": metabolism,
+        "derive_volume": DeriveVolume(),
+        "divide_trigger": DivideTrigger(c["divide"]),
+        "motility": BrownianMotility(c["motility"]),
+    }
+    topology = {
+        "metabolism": {
+            "external": ("boundary", "external"),
+            "exchange": ("boundary", "exchange"),
+            "global": ("global",),
+            "fluxes": ("fluxes",),
+        },
+        "derive_volume": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+        "motility": {"boundary": ("boundary",)},
+    }
+    compartment = Compartment(processes=processes, topology=topology)
+    return _spatial_colony(
+        compartment,
+        list(metabolism.external),
+        c,
+        diffusion=c["diffusion"],
+        initial=c["initial"],
+    )
+
+
+@register_composite
 def ecoli_lattice(
     config: Mapping | None = None,
 ) -> Tuple[SpatialColony, Compartment]:
@@ -173,30 +272,10 @@ def ecoli_lattice(
         "motility": {"boundary": ("boundary",)},
     }
     compartment = Compartment(processes=processes, topology=topology)
-    colony = Colony(
+    return _spatial_colony(
         compartment,
-        capacity=int(c["capacity"]),
-        division_trigger=("global", "divide") if c["division"] else None,
-    )
-    shape = tuple(c["shape"])
-    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
-    lattice = Lattice(
-        molecules=["glucose"],
-        shape=shape,
-        size=size,
+        ["glucose"],
+        c,
         diffusion=c["diffusion"],
         initial=c["initial_glucose"],
-        timestep=c["timestep"],
     )
-    spatial = SpatialColony(
-        colony,
-        lattice,
-        field_ports={
-            "glucose": (
-                ("boundary", "external", "glucose"),
-                ("boundary", "exchange", "glucose_exchange"),
-            ),
-        },
-        location_path=("boundary", "location"),
-    )
-    return spatial, compartment

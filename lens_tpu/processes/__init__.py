@@ -48,6 +48,7 @@ from lens_tpu.processes.expression import (  # noqa: E402
     Translation,
 )
 from lens_tpu.processes.metabolism import Metabolism  # noqa: E402
+from lens_tpu.processes.fba_metabolism import FBAMetabolism  # noqa: E402
 from lens_tpu.processes.transport_lookup import TransportLookup  # noqa: E402
 
 __all__ = [
@@ -72,5 +73,6 @@ __all__ = [
     "Transcription",
     "Translation",
     "Metabolism",
+    "FBAMetabolism",
     "TransportLookup",
 ]
