@@ -27,6 +27,17 @@ import json
 import sys
 
 
+def _parse_mesh(value: str) -> dict:
+    """'4x2' -> {"agents": 4, "space": 2}; '8' -> {"agents": 8, "space": 1}."""
+    agents, _, space = value.lower().partition("x")
+    try:
+        return {"agents": int(agents), "space": int(space or 1)}
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"{value!r} is not AGENTSxSPACE (e.g. 4x2)"
+        )
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="lens_tpu", description="TPU-native cell-colony simulations"
@@ -67,6 +78,13 @@ def _build_parser() -> argparse.ArgumentParser:
             default=None,
             help='media timeline, e.g. "0 minimal, 500 minimal_lactose"',
         )
+        sp.add_argument(
+            "--mesh",
+            default=None,
+            type=_parse_mesh,
+            metavar="AGENTSxSPACE",
+            help="shard over a device mesh, e.g. 4x2 (spatial models)",
+        )
         sp.add_argument("--quiet", action="store_true")
 
     sub.add_parser("list", help="list composites, processes, emitters")
@@ -81,6 +99,7 @@ def _experiment_config(args: argparse.Namespace) -> dict:
             emitter["path"] = f"{args.out_dir}/emit.lens"
         checkpoint_dir = f"{args.out_dir}/checkpoints"
     return {
+        "mesh": args.mesh,
         "composite": args.composite,
         "config": json.loads(args.config),
         "n_agents": args.n_agents,

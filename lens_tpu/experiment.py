@@ -53,6 +53,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "checkpoint_every": None,        # sim-seconds per segment (None: one segment)
     "timeline": None,                # media timeline (spatial models only)
     "overrides": {},                 # initial-state overrides
+    # Device mesh for sharded execution (spatial models only):
+    # {"agents": N, "space": M} -> shard_map over a global (N x M) mesh
+    # via parallel.ShardedSpatialColony; None -> single-program jit.
+    # Multi-host bring-up (parallel.initialize) happens automatically.
+    "mesh": None,
 }
 
 
@@ -92,6 +97,30 @@ class Experiment:
             raise TypeError(
                 f"composite factory {name!r} returned {type(built)!r}"
             )
+        self.runner = None
+        if self.config["mesh"]:
+            if self.spatial is None:
+                raise ValueError(
+                    "config 'mesh' needs a spatial composite (lattice model)"
+                )
+            if self.config["timeline"] is not None:
+                raise ValueError(
+                    "config 'mesh' and 'timeline' cannot be combined yet"
+                )
+            from lens_tpu.parallel import (
+                ShardedSpatialColony,
+                global_mesh,
+                initialize,
+            )
+
+            initialize()  # multi-host no-op on one host
+            m = self.config["mesh"]
+            self.runner = ShardedSpatialColony(
+                self.spatial,
+                global_mesh(
+                    n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
+                ),
+            )
         self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
         self.checkpointer = (
             Checkpointer(self.config["checkpoint_dir"])
@@ -105,6 +134,8 @@ class Experiment:
         key = jax.random.PRNGKey(int(self.config["seed"]))
         n = int(self.config["n_agents"])
         overrides = self.config["overrides"] or None
+        if self.runner is not None:
+            return self.runner.initial_state(n, key, overrides=overrides)
         if self.spatial is not None:
             return self.spatial.initial_state(n, key, overrides=overrides)
         return self.colony.initial_state(n, overrides=overrides, key=key)
@@ -121,6 +152,8 @@ class Experiment:
     def _run_segment(self, state, duration: float):
         dt = float(self.config["timestep"])
         emit_every = int(self.config["emit_every"])
+        if self.runner is not None:
+            return self.runner.run(state, duration, dt, emit_every)
         if self.spatial is not None:
             if self.config["timeline"] is not None:
                 return self.spatial.run_timeline(
@@ -139,6 +172,8 @@ class Experiment:
         Returns the final state. Timeseries access depends on the emitter
         (``RamEmitter.timeseries()``, or the log file on disk).
         """
+        from lens_tpu.parallel.distributed import is_coordinator
+
         if state is None:
             state = self.initial_state()
         seg, n_segments = self._segment_plan()
@@ -154,16 +189,31 @@ class Experiment:
                 * dt
                 + start_step * dt
             )
-            self.emitter.emit_trajectory(trajectory, times=times)
+            # Multi-host: gather shards to every host (a collective — all
+            # processes must participate), THEN only the coordinator
+            # writes. Single-host this is the identity.
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                trajectory = multihost_utils.process_allgather(trajectory)
+            if is_coordinator():
+                self.emitter.emit_trajectory(trajectory, times=times)
             if self.checkpointer is not None:
+                # Unguarded on purpose: orbax multi-host saves need every
+                # process to participate (each writes its own shards).
                 self.checkpointer.save(state, self._state_step(state))
             if verbose:
+                # The alive count is a computation over globally sharded
+                # state — every process must dispatch it; only the print
+                # is coordinator-local.
+                alive_now = int(np.asarray(jax.device_get(self.n_alive(state))))
                 wall = time.perf_counter() - t0
-                print(
-                    f"segment {k + 1}/{n_segments}: sim t="
-                    f"{self._state_step(state) * dt:g}s  wall={wall:.2f}s  "
-                    f"alive={int(np.asarray(jax.device_get(self.n_alive(state))))}"
-                )
+                if is_coordinator():
+                    print(
+                        f"segment {k + 1}/{n_segments}: sim t="
+                        f"{self._state_step(state) * dt:g}s  wall={wall:.2f}s  "
+                        f"alive={alive_now}"
+                    )
         self.emitter.flush()
         return state
 

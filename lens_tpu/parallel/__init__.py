@@ -24,6 +24,13 @@ from lens_tpu.parallel.mesh import (
 )
 from lens_tpu.parallel.halo import diffuse_halo
 from lens_tpu.parallel.runner import ShardedSpatialColony
+from lens_tpu.parallel.distributed import (
+    coordinator_only,
+    distribute,
+    global_mesh,
+    initialize,
+    is_coordinator,
+)
 
 __all__ = [
     "make_mesh",
@@ -32,4 +39,9 @@ __all__ = [
     "spatial_pspecs",
     "diffuse_halo",
     "ShardedSpatialColony",
+    "initialize",
+    "global_mesh",
+    "distribute",
+    "is_coordinator",
+    "coordinator_only",
 ]

@@ -1,0 +1,159 @@
+"""Multi-host runtime: jax.distributed bring-up + cross-host mesh + IO guards.
+
+The reference runs multi-host colonies by pointing every host's shepherd
+at the same Kafka broker — coordination is the broker's problem
+(reconstructed: ``lens/actor/shepherd.py`` + boot args, SURVEY.md §2
+"distributed communication backend"). The rebuild has no broker: hosts
+join one JAX distributed runtime (a coordinator handshakes PJRT over
+DCN), every host runs the SAME SPMD program, and cross-host movement is
+the XLA collectives the program already contains — ``psum``/``ppermute``
+over mesh axes that now span slices. This module is the small explicit
+control plane SURVEY.md §2 requires:
+
+- :func:`initialize` — idempotent ``jax.distributed.initialize`` wrapper
+  (env-driven defaults, no-op single-host, safe under repeat calls);
+- :func:`global_mesh` — the 2D (agents x space) colony mesh over ALL
+  hosts' devices, ICI-contiguous via ``mesh_utils`` so the agent axis
+  (heavy psum traffic) stays on-slice and only halo/occupancy traffic
+  crosses DCN;
+- :func:`distribute` — host-local state -> global sharded arrays
+  (every host constructs the same full-size pytree; each keeps only its
+  addressable shards);
+- :func:`is_coordinator` / :func:`coordinator_only` — IO discipline:
+  emit logs, checkpoints directory creation, and progress prints happen
+  once, on process 0, not once per host.
+
+Single-process (tests, laptops, the bench chip) everything degrades to a
+no-op: ``initialize()`` returns False, ``global_mesh`` equals
+``make_mesh``, ``coordinator_only`` runs the function.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Callable, Optional, Sequence, TypeVar
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from lens_tpu.parallel.mesh import AGENTS_AXIS, SPACE_AXIS, mesh_shardings
+
+F = TypeVar("F", bound=Callable)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime. Returns True if distributed is active.
+
+    Multi-host is OPT-IN: the handshake runs only when a coordinator
+    address is given (argument or ``JAX_COORDINATOR_ADDRESS``) or
+    ``LENS_TPU_DISTRIBUTED=1`` asks for jax's cluster auto-detection
+    (TPU pods with a cluster manager need no explicit address). Anything
+    else — laptops, CI, the tunneled bench chip (which exports pod-like
+    env vars such as ``TPU_WORKER_HOSTNAMES``) — is a single-host no-op.
+    Idempotent: repeat calls (e.g. experiment retries) do not
+    re-handshake. Returns True iff more than one process is attached.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    auto = os.environ.get("LENS_TPU_DISTRIBUTED") == "1"
+    if coordinator_address is None and not auto:
+        return False
+    env_n = os.environ.get("JAX_NUM_PROCESSES")
+    env_id = os.environ.get("JAX_PROCESS_ID")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=(
+            int(num_processes) if num_processes is not None
+            else int(env_n) if env_n else None
+        ),
+        process_id=(
+            int(process_id) if process_id is not None
+            else int(env_id) if env_id else None
+        ),
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def is_coordinator() -> bool:
+    """True on the process that owns IO (process 0; single-host: always)."""
+    return jax.process_index() == 0
+
+
+def coordinator_only(fn: F) -> F:
+    """Run ``fn`` only on process 0; other hosts get None.
+
+    Host-side IO (emit drain, checkpoint-dir creation, progress prints)
+    must not happen once per host. Device-side collectives must NOT be
+    guarded this way — every host must trace identical programs.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if is_coordinator():
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+def global_mesh(
+    n_agents: Optional[int] = None,
+    n_space: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """The colony mesh over every host's devices, ICI-contiguous.
+
+    Like :func:`lens_tpu.parallel.mesh.make_mesh` but (a) defaults to the
+    GLOBAL device list and (b) lays the (agents, space) grid out with
+    ``mesh_utils.create_device_mesh``, which orders devices so the inner
+    axis rides ICI neighbors — keeping the agent-axis ``psum`` (the heavy
+    per-step reduction) inside a slice wherever the shape allows, with
+    only the thin halo/occupancy traffic crossing DCN.
+    """
+    from jax.experimental import mesh_utils
+
+    from lens_tpu.parallel.mesh import resolve_mesh_devices
+
+    devices, n_agents = resolve_mesh_devices(n_agents, n_space, devices)
+    try:
+        grid = mesh_utils.create_device_mesh(
+            (n_agents, n_space), devices=devices
+        )
+    except (ValueError, AssertionError):
+        # Topologies mesh_utils cannot factor (odd CPU counts, forced
+        # host platforms): plain row-major order is still correct.
+        grid = np.asarray(devices).reshape(n_agents, n_space)
+    return Mesh(grid, axis_names=(AGENTS_AXIS, SPACE_AXIS))
+
+
+def distribute(state, mesh: Mesh, pspecs):
+    """Host-local full-size state -> globally sharded device arrays.
+
+    Every host calls this with an IDENTICALLY constructed ``state`` (same
+    seed, same config — cheap: colony init is a few array fills). Each
+    host then keeps only its addressable shards, so no host ever needs
+    another's memory and no cross-host scatter happens at startup.
+    """
+    shardings = mesh_shardings(mesh, pspecs)
+    if jax.process_count() == 1:
+        return jax.device_put(state, shardings)
+    return jax.tree.map(
+        lambda leaf, sharding: jax.make_array_from_callback(
+            np.shape(leaf), sharding, lambda idx, _leaf=leaf: np.asarray(_leaf)[idx]
+        ),
+        state,
+        shardings,
+    )

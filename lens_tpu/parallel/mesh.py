@@ -19,6 +19,26 @@ AGENTS_AXIS = "agents"
 SPACE_AXIS = "space"
 
 
+def resolve_mesh_devices(
+    n_agents: Optional[int],
+    n_space: int,
+    devices: Optional[Sequence],
+) -> Tuple[list, int]:
+    """Shared defaulting/validation for mesh construction: returns the
+    (truncated) device list and the resolved agent-axis size."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_agents is None:
+        if len(devices) % n_space:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by n_space={n_space}"
+            )
+        n_agents = len(devices) // n_space
+    n = n_agents * n_space
+    if n > len(devices):
+        raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    return devices[:n], n_agents
+
+
 def make_mesh(
     n_agents: Optional[int] = None,
     n_space: int = 1,
@@ -29,16 +49,9 @@ def make_mesh(
     ``n_agents`` defaults to ``len(devices) // n_space``. Either axis may
     be 1 (pure agent-DP or pure spatial decomposition).
     """
-    devices = list(devices if devices is not None else jax.devices())
-    if n_agents is None:
-        if len(devices) % n_space:
-            raise ValueError(f"{len(devices)} devices not divisible by n_space={n_space}")
-        n_agents = len(devices) // n_space
-    n = n_agents * n_space
-    if n > len(devices):
-        raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    devices, n_agents = resolve_mesh_devices(n_agents, n_space, devices)
     return Mesh(
-        np.asarray(devices[:n]).reshape(n_agents, n_space),
+        np.asarray(devices).reshape(n_agents, n_space),
         axis_names=(AGENTS_AXIS, SPACE_AXIS),
     )
 

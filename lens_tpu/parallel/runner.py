@@ -66,11 +66,16 @@ class ShardedSpatialColony:
     # -- construction --------------------------------------------------------
 
     def initial_state(self, n_alive: int, key, **kwargs) -> SpatialState:
-        """Build on host, then place per the mesh sharding layout."""
+        """Build on host, then place per the mesh sharding layout.
+
+        Placement goes through :func:`parallel.distributed.distribute`, so
+        the same call works on a multi-host mesh (each host constructs the
+        full state and keeps only its addressable shards).
+        """
+        from lens_tpu.parallel.distributed import distribute
+
         ss = self.spatial.initial_state(n_alive, key, **kwargs)
-        return jax.device_put(
-            ss, mesh_shardings(self.mesh, spatial_pspecs(ss))
-        )
+        return distribute(ss, self.mesh, spatial_pspecs(ss))
 
     # -- the SPMD step -------------------------------------------------------
 

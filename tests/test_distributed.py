@@ -1,0 +1,140 @@
+"""Multi-host control plane, exercised the single-process way.
+
+True multi-host needs real hosts; what can be pinned down here
+(SURVEY.md §4's "multi-node without a real cluster" tier) is everything
+that does not require a second process: single-host no-op bring-up,
+coordinator IO guards, global mesh construction over the 8 virtual
+devices, and state distribution producing correctly sharded arrays that
+feed the sharded runner unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.parallel import (
+    ShardedSpatialColony,
+    coordinator_only,
+    distribute,
+    global_mesh,
+    initialize,
+    is_coordinator,
+)
+from lens_tpu.parallel.mesh import AGENTS_AXIS, SPACE_AXIS, spatial_pspecs
+
+
+class TestBringup:
+    def test_single_host_initialize_is_noop(self, monkeypatch):
+        # Opt-in discipline: even pod-like env vars (the tunneled bench
+        # chip exports TPU_WORKER_HOSTNAMES) must not trigger a handshake
+        # without an explicit coordinator address or LENS_TPU_DISTRIBUTED.
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("LENS_TPU_DISTRIBUTED", raising=False)
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+        assert initialize() is False
+        assert jax.process_count() == 1
+
+    def test_coordinator_identity_single_host(self):
+        assert is_coordinator()
+
+    def test_coordinator_only_runs_on_process0(self):
+        calls = []
+
+        @coordinator_only
+        def emit(x):
+            calls.append(x)
+            return x
+
+        assert emit(7) == 7
+        assert calls == [7]
+
+
+class TestGlobalMesh:
+    def test_shape_and_axis_names(self):
+        mesh = global_mesh(n_agents=4, n_space=2)
+        assert mesh.shape[AGENTS_AXIS] == 4
+        assert mesh.shape[SPACE_AXIS] == 2
+
+    def test_defaults_to_all_devices(self):
+        mesh = global_mesh(n_space=2)
+        assert mesh.shape[AGENTS_AXIS] * mesh.shape[SPACE_AXIS] == len(
+            jax.devices()
+        )
+
+    def test_too_many_devices_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            global_mesh(n_agents=64, n_space=64)
+
+
+class TestDistribute:
+    def test_state_shards_feed_sharded_runner(self):
+        from lens_tpu.models.composites import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 64, "shape": (32, 32), "motility": {"sigma": 0.0}}
+        )
+        mesh = global_mesh(n_agents=4, n_space=2)
+        runner = ShardedSpatialColony(spatial, mesh)
+
+        # Host-side full-size construction, then explicit distribution —
+        # the multi-host startup path (single-host it's a device_put).
+        host_state = spatial.initial_state(16, jax.random.PRNGKey(0))
+        ss = distribute(host_state, mesh, spatial_pspecs(host_state))
+
+        alive = ss.colony.alive
+        assert alive.sharding.spec == spatial_pspecs(host_state).colony.alive
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(alive)),
+            np.asarray(jax.device_get(host_state.colony.alive)),
+        )
+
+        stepped = runner.step(ss, 1.0)
+        assert bool(jnp.all(jnp.isfinite(stepped.fields)))
+
+
+class TestExperimentMesh:
+    def test_experiment_runs_sharded(self):
+        """mesh config routes the experiment through the sharded runner and
+        produces the same trajectory as the unsharded path (deterministic
+        composite: motility off)."""
+        from lens_tpu.experiment import Experiment
+
+        base = {
+            "composite": "ecoli_lattice",
+            "config": {
+                "capacity": 64,
+                "shape": (32, 32),
+                "motility": {"sigma": 0.0},
+            },
+            "n_agents": 16,
+            "total_time": 5.0,
+            "emitter": {"type": "ram"},
+        }
+        with Experiment(base) as exp:
+            exp.run()
+            plain = exp.emitter.timeseries()
+        with Experiment({**base, "mesh": {"agents": 4, "space": 2}}) as exp:
+            assert exp.runner is not None
+            exp.run()
+            sharded = exp.emitter.timeseries()
+        np.testing.assert_allclose(
+            np.asarray(plain["alive"]),
+            np.asarray(sharded["alive"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(plain["fields"]),
+            np.asarray(sharded["fields"]),
+            atol=1e-5,
+        )
+
+    def test_mesh_requires_spatial(self):
+        import pytest
+
+        from lens_tpu.experiment import Experiment
+
+        with pytest.raises(ValueError, match="spatial"):
+            Experiment(
+                {"composite": "grow_divide", "mesh": {"agents": 8}}
+            )
