@@ -34,6 +34,7 @@ from typing import Any, Dict, Mapping, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from lens_tpu.core.engine import Compartment
 from lens_tpu.core.schedule import scan_schedule
@@ -220,38 +221,64 @@ class Colony:
            (daughter_a, daughter_b) for all rows; daughter A overwrites the
            parent row, daughter B is scattered to the claimed row.
 
+        The whole body sits under ``lax.cond`` on "any row triggered": in
+        typical dynamics divisions are rare per step, so most steps pay one
+        reduction instead of the nonzero/cumsum/scatter pipeline.
+
         Shape-polymorphic: ``cap`` is the row count of the arrays passed
         in, so a shard_map block divides within its own rows (per-shard
-        free-row pools — see lens_tpu.parallel.runner).
+        free-row pools — see lens_tpu.parallel.runner). ``lax.cond`` under
+        shard_map branches per device block, which is exactly the wanted
+        semantics (a shard with no divisions skips the work).
         """
         cap = alive.shape[0]
         trig_val = get_path(agents, self.division_trigger)
         triggers = alive & (trig_val > 0)
 
-        free_rows = jnp.nonzero(~alive, size=cap, fill_value=cap)[0]  # [cap]
-        n_free = jnp.sum(~alive)
-        # rank of each triggering parent among triggers (0-based)
-        rank = jnp.cumsum(triggers) - 1
-        can_divide = triggers & (rank < n_free)
-        # daughter slot per row (cap = "no slot"; scatter drops OOB)
-        slot = jnp.where(can_divide, free_rows[jnp.clip(rank, 0, cap - 1)], cap)
+        def body(operand):
+            agents, alive, key = operand
+            free_rows = jnp.nonzero(~alive, size=cap, fill_value=cap)[0]
+            n_free = jnp.sum(~alive)
+            # rank of each triggering parent among triggers (0-based)
+            rank = jnp.cumsum(triggers) - 1
+            can_divide = triggers & (rank < n_free)
+            # daughter slot per row (cap = "no slot"; scatter drops OOB)
+            slot = jnp.where(
+                can_divide, free_rows[jnp.clip(rank, 0, cap - 1)], cap
+            )
 
-        leaves = list(flatten_paths(agents))
-        keys = jax.random.split(key, max(len(leaves), 1))
-        out = agents
-        for (path, value), leaf_key in zip(leaves, keys):
-            divider = DIVIDERS[self.compartment.dividers.get(path, "split")]
-            row_keys = jax.random.split(leaf_key, cap)
-            # vmap the scalar divider across the agent axis
-            a, b = jax.vmap(divider)(value, row_keys)
-            new_val = jnp.where(_bcast(can_divide, value), a, value)
-            # scatter daughter B into claimed slots; 'drop' ignores slot==cap
-            # (only can_divide rows have slot < cap, so nothing else lands)
-            new_val = new_val.at[slot].set(b, mode="drop")
-            out = set_path(out, path, new_val)
+            leaves = list(flatten_paths(agents))
+            dummy = jnp.zeros((cap, *key.shape), key.dtype)
+            out = agents
+            for i, (path, value) in enumerate(leaves):
+                name = self.compartment.dividers.get(path, "split")
+                divider = DIVIDERS[name]
+                # Key policy is declared on the divider itself (see
+                # core.state: `_div_binomial.stochastic = True`); only
+                # randomness-consuming dividers cost a threefry batch.
+                if getattr(divider, "stochastic", False):
+                    row_keys = jax.random.split(
+                        jax.random.fold_in(key, i), cap
+                    )
+                else:
+                    row_keys = dummy  # deterministic divider: key unused
+                # vmap the scalar divider across the agent axis
+                a, b = jax.vmap(divider)(value, row_keys)
+                new_val = jnp.where(_bcast(can_divide, value), a, value)
+                # scatter daughter B into claimed slots; 'drop' ignores
+                # slot==cap (only can_divide rows have slot < cap, so
+                # nothing else lands)
+                new_val = new_val.at[slot].set(b, mode="drop")
+                out = set_path(out, path, new_val)
 
-        alive = alive.at[slot].set(True, mode="drop")
-        return out, alive
+            return out, alive.at[slot].set(True, mode="drop")
+
+        return lax.cond(
+            jnp.any(triggers),
+            body,
+            lambda operand: (operand[0], operand[1]),
+            (agents, alive, key),
+        )
 
     # -- emission ------------------------------------------------------------
 

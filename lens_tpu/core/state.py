@@ -103,6 +103,14 @@ def _div_binomial(value: Array, key: Array) -> Tuple[Array, Array]:
     return a.astype(value.dtype), (n - a).astype(value.dtype)
 
 
+# Randomness policy lives WITH the divider definition: the colony layer
+# only generates per-row key material for dividers marked stochastic
+# (threefry batches are among the most expensive per-step TPU ops), so a
+# new randomness-consuming divider must carry this attribute or it will
+# receive dummy keys.
+_div_binomial.stochastic = True
+
+
 DIVIDERS: Dict[str, Callable[[Array, Array], Tuple[Array, Array]]] = {
     "split": _div_split,
     "copy": _div_copy,

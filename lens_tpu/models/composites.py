@@ -21,9 +21,12 @@ from lens_tpu.processes import (
     DeriveVolume,
     DivideTrigger,
     FBAMetabolism,
+    FlagellarMotor,
     GlucosePTS,
     Growth,
     MichaelisMentenTransport,
+    MWCChemoreceptor,
+    RunTumbleMotility,
     StochasticExpression,
     ToggleSwitch,
 )
@@ -166,6 +169,82 @@ def hybrid_cell(config: Mapping | None = None) -> Compartment:
             "growth": {"global": ("global",)},
             "divide_trigger": {"global": ("global",)},
         },
+    )
+
+
+@register_composite
+def chemotaxis_lattice(
+    config: Mapping | None = None,
+) -> Tuple[SpatialColony, Compartment]:
+    """The reference's signature demo: chemotactic cells on an attractant
+    lattice.
+
+    MWC chemoreceptor (temporal gradient sensing via methylation
+    adaptation) -> flagellar motor (stochastic run/tumble switching) ->
+    run/tumble motility, plus Michaelis–Menten consumption of the
+    attractant and growth/division — the "minimal chemotaxis cell" the
+    reference boots onto its lattice (reconstructed: SURVEY.md §2
+    "Composites", "Chemotaxis processes"). Cells climb gradients they
+    simultaneously eat, so the colony both chases and reshapes the field.
+
+    The default field is uniform; set a gradient by overwriting
+    ``state.fields`` (tests) or via a media timeline.
+    """
+    c = _cfg(
+        {
+            "capacity": 1024,
+            "shape": (64, 64),
+            "size": None,            # defaults to 10 um bins
+            "diffusion": 100.0,
+            "initial_attractant": 0.1,  # mM, mid receptor range
+            "timestep": 1.0,
+            "molecule": "glucose",
+            "receptor": {},
+            "motor": {},
+            "motility": {},
+            "transport": {},
+            "growth": {},
+            "divide": {},
+            "division": True,
+        },
+        config,
+    )
+    mol = c["molecule"]
+    ext = float(c["initial_attractant"])
+    processes = {
+        "receptor": MWCChemoreceptor(
+            {**c["receptor"], "molecule": mol, "external_default": ext}
+        ),
+        "motor": FlagellarMotor(c["motor"]),
+        "motility": RunTumbleMotility(c["motility"]),
+        "transport": MichaelisMentenTransport(
+            {**c["transport"], "molecule": mol, "external_default": ext}
+        ),
+        "growth": Growth(c["growth"]),
+        "divide_trigger": DivideTrigger(c["divide"]),
+    }
+    topology = {
+        "receptor": {
+            "external": ("boundary", "external"),
+            "internal": ("cell",),
+        },
+        "motor": {"internal": ("cell",)},
+        "motility": {"boundary": ("boundary",), "internal": ("cell",)},
+        "transport": {
+            "external": ("boundary", "external"),
+            "internal": ("cell",),
+            "exchange": ("boundary", "exchange"),
+        },
+        "growth": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+    }
+    compartment = Compartment(processes=processes, topology=topology)
+    return _spatial_colony(
+        compartment,
+        [mol],
+        c,
+        diffusion=c["diffusion"],
+        initial=c["initial_attractant"],
     )
 
 

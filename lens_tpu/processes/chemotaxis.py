@@ -52,13 +52,21 @@ class MWCChemoreceptor(Process):
         "adapted_activity": 1.0 / 3.0,
         "k_adapt": 0.1,          # 1/s methylation relaxation rate
         "molecule": "glucose",   # attractant field name
+        # Shared-path declarations must agree across processes; composites
+        # that also wire transport onto the same boundary variable set
+        # this to the same value (see mm_transport.external_default).
+        "external_default": 0.1,
     }
 
     def ports_schema(self):
         mol = self.config["molecule"]
         return {
             "external": {
-                mol: {"_default": 0.1, "_updater": "null", "_divider": "copy"},
+                mol: {
+                    "_default": float(self.config["external_default"]),
+                    "_updater": "null",
+                    "_divider": "copy",
+                },
             },
             "internal": {
                 "methyl": {
@@ -74,14 +82,46 @@ class MWCChemoreceptor(Process):
             },
         }
 
+    # The MWC free energy is F = N * (f_methyl(m) + f_ligand(L)). Both
+    # _activity and adapted_methyl (its inverse in m) are written in terms
+    # of the two helpers below — change the functional form THERE and the
+    # forward/inverse pair cannot drift apart.
+
+    def _f_ligand(self, ligand):
+        c = self.config
+        ligand = jnp.maximum(jnp.asarray(ligand, jnp.float32), 0.0)
+        return jnp.log1p(ligand / c["k_off"]) - jnp.log1p(ligand / c["k_on"])
+
+    def _f_methyl(self, methyl):
+        # methylation lowers the free energy of the active state
+        return 1.0 - 0.5 * methyl * self.config["m_eff_scale"]
+
+    def _methyl_for_f(self, f_methyl):
+        """Inverse of ``_f_methyl``."""
+        return 2.0 * (1.0 - f_methyl) / self.config["m_eff_scale"]
+
     def _activity(self, ligand, methyl):
         c = self.config
-        ligand = jnp.maximum(ligand, 0.0)
-        # methylation lowers the free energy of the active state
-        f_methyl = 1.0 - 0.5 * methyl * c["m_eff_scale"]
-        f_ligand = jnp.log1p(ligand / c["k_off"]) - jnp.log1p(ligand / c["k_on"])
-        free_energy = c["n_receptors"] * (f_methyl + f_ligand)
+        free_energy = c["n_receptors"] * (
+            self._f_methyl(methyl) + self._f_ligand(ligand)
+        )
         return 1.0 / (1.0 + jnp.exp(free_energy))
+
+    def adapted_methyl(self, ligand):
+        """Methylation level at which activity == adapted_activity for a
+        given ambient ligand concentration.
+
+        Cells dropped into a field far from their adapted state spend
+        O(1/k_adapt · ΔF) seconds deaf to gradients while methylation
+        catches up; initialize ``methyl`` with this to start at the
+        working point (the reference's cells start pre-adapted the same
+        way).
+        """
+        c = self.config
+        f_star = jnp.log(1.0 / c["adapted_activity"] - 1.0)
+        # N * (f_methyl + f_ligand) = F*  ->  f_methyl, then invert in m
+        f_methyl = f_star / c["n_receptors"] - self._f_ligand(ligand)
+        return self._methyl_for_f(f_methyl)
 
     def next_update(self, timestep, states):
         c = self.config

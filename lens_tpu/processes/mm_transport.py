@@ -36,13 +36,22 @@ class MichaelisMentenTransport(Process):
         "yield_": 0.1,    # internal pool produced per unit taken up
         "k_consume": 0.05,  # 1/s first-order drain of the internal pool
         "molecule": "glucose",
+        # Schema default for the external concentration. Shared-path
+        # declarations must agree across processes (core.engine), so
+        # composites wiring several env-reading processes onto one
+        # boundary variable set this consistently.
+        "external_default": 10.0,
     }
 
     def ports_schema(self):
         mol = self.config["molecule"]
         return {
             "external": {
-                mol: {"_default": 10.0, "_updater": "null", "_divider": "copy"},
+                mol: {
+                    "_default": float(self.config["external_default"]),
+                    "_updater": "null",
+                    "_divider": "copy",
+                },
             },
             "internal": {
                 f"{mol}_internal": {
