@@ -1,0 +1,186 @@
+"""Benchmark all five BASELINE.json configs; write a JSON report.
+
+Supplementary to ``bench.py`` (the driver's one-line headline metric —
+config 2). Each config runs as its BASELINE scenario on whatever backend
+jax provides, measuring steady-state throughput after one warm-up window
+(compile + cache). Output: one JSON object per line to stdout, plus
+``BENCH_ALL.json`` with the full report.
+
+    python bench_all.py            # all configs
+    python bench_all.py 0 4        # a subset
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+
+WINDOW_S = 32.0  # sim-seconds measured per config (dt = 1s)
+
+
+def _measure(build_window, n_agents):
+    """build_window() -> (state, window_fn); returns agent-steps/sec."""
+    import jax
+
+    state, window = build_window()
+    state = jax.block_until_ready(window(state))  # warm-up: compile + run
+    t0 = time.perf_counter()
+    jax.block_until_ready(window(state))
+    elapsed = time.perf_counter() - t0
+    return n_agents * WINDOW_S / elapsed, elapsed
+
+
+def config_0():
+    """Single agent, 2-species glucose ODE, 100 sim-sec (the CPU anchor)."""
+    import jax
+
+    from lens_tpu.models.composites import minimal_ode
+
+    comp = minimal_ode({})
+    state = comp.initial_state()
+    window = jax.jit(lambda s: comp.run(s, 100.0, 1.0, emit_every=100)[0])
+    state = jax.block_until_ready(window(state))  # warm-up
+    t0 = time.perf_counter()
+    jax.block_until_ready(window(state))
+    elapsed = time.perf_counter() - t0
+    return {
+        "config": 0,
+        "scenario": "1 agent, glucose ODE, 100 sim-sec",
+        "metric": "wall seconds / 100 sim-sec",
+        "value": round(elapsed, 4),
+    }
+
+
+def config_1():
+    import jax
+
+    from lens_tpu.colony.colony import Colony
+    from lens_tpu.models.composites import toggle_colony
+
+    n = 1024
+    colony = Colony(toggle_colony({}), capacity=n)
+
+    def build():
+        state = colony.initial_state(n, key=jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: colony.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": 1,
+        "scenario": "1k-agent toggle-switch colony, no lattice",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
+def config_2():
+    import jax
+
+    from lens_tpu.models.composites import ecoli_lattice
+
+    n = 10240
+    spatial, _ = ecoli_lattice({"capacity": n})
+
+    def build():
+        state = spatial.initial_state(n, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": 2,
+        "scenario": "10k agents, 256x256 lattice, MM transport (headline)",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
+def config_3():
+    import jax
+
+    from lens_tpu.colony.colony import Colony
+    from lens_tpu.models.composites import minimal_wcecoli
+
+    n = 256
+    colony = Colony(
+        minimal_wcecoli({}), capacity=1024,
+        division_trigger=("global", "divide"),
+    )
+
+    def build():
+        state = colony.initial_state(
+            n, key=jax.random.PRNGKey(0),
+            overrides={"metabolites": {"glc": 50.0}},
+        )
+        window = jax.jit(
+            lambda s: colony.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": 3,
+        "scenario": "wcEcoli-minimal composite, 256 agents, division",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
+def config_4():
+    import jax
+
+    from lens_tpu.colony.colony import Colony
+    from lens_tpu.models.composites import hybrid_cell
+
+    n = 102400
+    colony = Colony(
+        hybrid_cell({}), capacity=n, division_trigger=("global", "divide")
+    )
+
+    def build():
+        state = colony.initial_state(100000, key=jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: colony.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": 4,
+        "scenario": "100k mixed hybrid Gillespie+ODE colony (north star)",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
+CONFIGS = {0: config_0, 1: config_1, 2: config_2, 3: config_3, 4: config_4}
+
+
+def main() -> None:
+    import jax
+
+    wanted = [int(a) for a in sys.argv[1:]] or sorted(CONFIGS)
+    report = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "results": [],
+    }
+    for k in wanted:
+        row = CONFIGS[k]()
+        report["results"].append(row)
+        print(json.dumps(row), flush=True)
+    with open("BENCH_ALL.json", "w") as f:
+        json.dump(report, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,17 +18,21 @@ from lens_tpu.environment.lattice import Lattice
 from lens_tpu.environment.spatial import SpatialColony
 from lens_tpu.processes import (
     BrownianMotility,
+    Degradation,
     DeriveVolume,
     DivideTrigger,
     FBAMetabolism,
     FlagellarMotor,
     GlucosePTS,
     Growth,
+    Metabolism,
     MichaelisMentenTransport,
     MWCChemoreceptor,
     RunTumbleMotility,
     StochasticExpression,
     ToggleSwitch,
+    Transcription,
+    Translation,
 )
 from lens_tpu.utils.dicts import deep_merge
 
@@ -167,6 +171,53 @@ def hybrid_cell(config: Mapping | None = None) -> Compartment:
                 "exchange": ("boundary", "exchange"),
             },
             "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+
+
+@register_composite
+def minimal_wcecoli(config: Mapping | None = None) -> Compartment:
+    """Config 3: the wcEcoli-minimal composite — metabolism + expression +
+    division.
+
+    Regulated kinetic metabolism (Covert–Palsson core network) grows mass;
+    constitutive transcription/translation/degradation maintain an
+    expression machinery proxy; DeriveVolume keeps geometry consistent and
+    the cell divides on volume doubling. This is the shape of the
+    reference's minimal whole-cell composite (metabolism + transcription,
+    256 agents with division — BASELINE.json configs[3]); the full wcEcoli
+    model rides the bridge (lens_tpu.bridge) instead.
+    """
+    c = _cfg(
+        {
+            "metabolism": {},
+            "transcription": {"rates": {"rnap_mrna": 0.08}},
+            "translation": {"pairs": {"rnap": ("rnap_mrna", 0.02)}},
+            "degradation": {"rates": {"rnap_mrna": 0.01, "rnap": 0.0002}},
+            "divide": {},
+        },
+        config,
+    )
+    return Compartment(
+        processes={
+            "metabolism": Metabolism(c["metabolism"]),
+            "transcription": Transcription(c["transcription"]),
+            "translation": Translation(c["translation"]),
+            "degradation": Degradation(c["degradation"]),
+            "derive_volume": DeriveVolume(),
+            "divide_trigger": DivideTrigger(c["divide"]),
+        },
+        topology={
+            "metabolism": {
+                "metabolites": ("metabolites",),
+                "global": ("global",),
+                "fluxes": ("fluxes",),
+            },
+            "transcription": {"counts": ("counts",)},
+            "translation": {"counts": ("counts",)},
+            "degradation": {"counts": ("counts",)},
+            "derive_volume": {"global": ("global",)},
             "divide_trigger": {"global": ("global",)},
         },
     )
