@@ -7,23 +7,53 @@ config-2 flagship: Michaelis–Menten transport + growth + division +
 Brownian motility on a 256x256 glucose diffusion lattice, 10,240 agents.
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "backend": ...}
 
 The reference publishes no numbers (BASELINE.json ``published: {}``), so
 ``vs_baseline`` is measured against the north-star target of 10,000
 agent-steps/sec/chip.
+
+Robustness (round-1 lesson): this box's ``axon`` TPU relay is flaky; a
+dead relay makes backend init raise Unavailable or hang forever, and its
+PJRT hook ignores ``JAX_PLATFORMS``. The *measurement* therefore runs in
+a child subprocess with a bounded timeout — a hung relay can only burn
+that timeout, never wedge the reporting process. If the accelerator
+child fails or times out, a second child re-measures on the pinned CPU
+backend (reported honestly via ``"backend"``, read from
+``jax.default_backend()`` inside the measuring process). The parent
+always prints one parseable JSON line and exits 0.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 NORTH_STAR = 10_000.0  # agent-steps/sec/chip (BASELINE.json north_star)
+METRIC = "agent-steps/sec/chip (10k-agent E. coli colony, dt=1s)"
 
 
-def main() -> None:
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _measure() -> None:
+    """Child-process mode: init a backend, measure, print one JSON line."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        from lens_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform(1)
+
     import jax
 
     from lens_tpu.models import ecoli_lattice
@@ -48,17 +78,81 @@ def main() -> None:
 
     agent_steps = capacity * sim_seconds  # dt=1s -> one agent-step per sim-sec
     value = agent_steps / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "agent-steps/sec/chip (10k-agent E. coli colony, dt=1s)",
-                "value": round(value, 1),
-                "unit": "agent-steps/sec/chip",
-                "vs_baseline": round(value / NORTH_STAR, 3),
-            }
-        )
+    _emit(
+        {
+            "metric": METRIC,
+            "value": round(value, 1),
+            "unit": "agent-steps/sec/chip",
+            "vs_baseline": round(value / NORTH_STAR, 3),
+            "backend": jax.default_backend(),
+        }
     )
 
 
+def _run_child(force_cpu: bool, timeout: float) -> dict:
+    """Run ``bench.py --measure`` in a subprocess; parse its JSON line."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"measurement timed out after {timeout:.0f}s"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and "value" in row:
+            return row
+    tail = (r.stderr or r.stdout or "").strip().splitlines()[-3:]
+    return {"error": f"rc={r.returncode}: " + " | ".join(tail)[:400]}
+
+
+def main() -> None:
+    row = _run_child(
+        force_cpu=False, timeout=_env_float("BENCH_ACCEL_TIMEOUT", 900.0)
+    )
+    if "error" in row:
+        accel_error = row["error"]
+        row = _run_child(
+            force_cpu=True, timeout=_env_float("BENCH_CPU_TIMEOUT", 900.0)
+        )
+        if "error" not in row:
+            row["accel_error"] = accel_error[:300]
+        else:
+            row = {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "agent-steps/sec/chip",
+                "vs_baseline": 0.0,
+                "backend": "none",
+                "error": f"accel: {accel_error[:200]}; cpu: {row['error'][:200]}",
+            }
+    _emit(row)
+
+
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        _measure()
+        raise SystemExit(0)
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 — contract: one JSON line, always
+        _emit(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "agent-steps/sec/chip",
+                "vs_baseline": 0.0,
+                "backend": "none",
+                "error": f"{type(e).__name__}: {e}"[:500],
+            }
+        )
+        raise SystemExit(0)

@@ -102,17 +102,22 @@ class ShardedSpatialColony:
         i, j = lattice.bin_of(locations)
 
         # 1. gather local concentrations, with GLOBAL occupancy (psum over
-        # the agent axis) so shared-bin accounting spans shards
-        local = full_fields[:, i, j].T  # [rows, M]
+        # the agent axis) so shared-bin accounting spans shards. Same
+        # raw-vs-shared split as the unsharded path (environment.spatial
+        # step 1): consuming ports see the bin-SHARED concentration,
+        # sense-only ports (exchange=None) see the RAW bin value.
+        local_raw = full_fields[:, i, j].T  # [rows, M]
+        local_shared = local_raw
         if spatial.share_bins:
             occ = lax.psum(
                 lattice.occupancy(locations, cs.alive), AGENTS_AXIS
             )
-            local = local / (
+            local_shared = local_raw / (
                 jnp.maximum(occ[i, j], 1.0)[:, None] * lattice.exchange_scale
             )
         agents = cs.agents
         for mol, port in spatial.field_ports.items():
+            local = local_raw if port.exchange is None else local_shared
             col = local[:, lattice.index(mol)]
             prev = get_path(agents, port.local)
             agents = set_path(agents, port.local, jnp.where(cs.alive, col, prev))
@@ -130,6 +135,7 @@ class ShardedSpatialColony:
             [
                 get_path(agents, spatial.field_ports[mol].exchange)
                 if mol in spatial.field_ports
+                and spatial.field_ports[mol].exchange is not None
                 else jnp.zeros(rows)
                 for mol in lattice.molecules
             ],
@@ -145,6 +151,8 @@ class ShardedSpatialColony:
             0.0,
         )
         for mol, port in spatial.field_ports.items():
+            if port.exchange is None:
+                continue
             agents = set_path(
                 agents, port.exchange,
                 jnp.zeros_like(get_path(agents, port.exchange)),

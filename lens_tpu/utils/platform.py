@@ -1,0 +1,40 @@
+"""Backend platform guards for this box's flaky ``axon`` TPU relay.
+
+The environment injects an ``axon`` PJRT hook (sitecustomize via
+PYTHONPATH) that forces ``jax_platforms="axon,cpu"`` and ignores the
+``JAX_PLATFORMS`` environment variable; when the tunnel relay is down,
+backend init blocks in a retry loop. Setting the jax *config* after
+import but before backend init does win over the hook — the plugin stays
+registered but is never initialized, so nothing dials the relay.
+
+One canonical copy of that guard lives here; ``tests/conftest.py`` keeps
+its own pre-import copy because it must also set ``XLA_FLAGS`` before
+pytest imports anything else.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_devices: int = 1) -> bool:
+    """Pin jax to the CPU platform with ``n_devices`` virtual host devices.
+
+    Must run before jax backend init (import order does not matter; first
+    device use does). Returns True if the platform was pinned, False if a
+    backend was already initialized (in which case we leave it alone
+    rather than raise — callers degrade to whatever devices exist).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except RuntimeError:
+        return False

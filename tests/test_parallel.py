@@ -131,6 +131,101 @@ def test_sharded_division_and_conservation():
     np.testing.assert_allclose(total1, total0, rtol=1e-4)
 
 
+def test_sharded_chemotaxis_matches_unsharded():
+    """Sense-only FieldPort (exchange=None) on the sharded runner.
+
+    Regression for two round-1 bugs: (a) the sharded scatter crashed on
+    ``exchange=None`` ports; (b) the sharded gather skipped the
+    raw-vs-shared split, so sense-only ports saw occupancy-divided
+    concentrations sharded but raw unsharded. Deterministic biology
+    (receptor adaptation + MM consumption, zero-sigma motility, no
+    division) with deliberately co-located agents — trajectories must be
+    equal across paths.
+    """
+    from lens_tpu.colony.colony import Colony
+    from lens_tpu.core.engine import Compartment
+    from lens_tpu.environment.spatial import SpatialColony
+    from lens_tpu.processes.chemotaxis import MWCChemoreceptor
+    from lens_tpu.processes.mm_transport import (
+        BrownianMotility,
+        MichaelisMentenTransport,
+    )
+
+    comp = Compartment(
+        processes={
+            "receptor": MWCChemoreceptor(
+                {"molecule": "asp", "external_default": 0.1}
+            ),
+            "transport": MichaelisMentenTransport(
+                {"molecule": "glucose", "external_default": 1.0}
+            ),
+            "motility": BrownianMotility({"sigma": 0.0}),
+        },
+        topology={
+            "receptor": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+            },
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "motility": {"boundary": ("boundary",)},
+        },
+    )
+    colony = Colony(comp, capacity=64)
+    lattice = Lattice(
+        molecules=["glucose", "asp"],
+        shape=(16, 16),
+        size=(16.0, 16.0),
+        diffusion=1.0,
+        initial={"glucose": 1.0, "asp": 0.1},
+        timestep=1.0,
+    )
+    spatial = SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            "glucose": (
+                ("boundary", "external", "glucose"),
+                ("boundary", "exchange", "glucose_exchange"),
+            ),
+            # sense-only: read the attractant, never consume it
+            "asp": (("boundary", "external", "asp"), None),
+        },
+        location_path=("boundary", "location"),
+    )
+    # co-locate agents in pairs so shared-bin occupancy actually divides
+    pair_rows = np.repeat(np.linspace(0.5, 15.5, 32), 2)
+    locations = np.stack(
+        [pair_rows, np.full(64, 7.5, np.float32)], axis=1
+    ).astype(np.float32)
+    ss0 = spatial.initial_state(64, jax.random.PRNGKey(3), locations=locations)
+    # gradient on the sensed molecule so receptor dynamics are non-trivial
+    h, w = lattice.shape
+    asp = jnp.broadcast_to(jnp.linspace(0.0, 0.5, w)[None, :], (h, w))
+    fields = ss0.fields.at[lattice.index("asp")].set(asp)
+    ss0 = ss0._replace(fields=fields)
+
+    ref, _ = spatial.run(ss0, 8.0, 1.0, emit_every=8)
+
+    mesh = make_mesh(n_agents=4, n_space=2)
+    sharded = ShardedSpatialColony(spatial, mesh)
+    ss0_sharded = jax.device_put(ss0, mesh_shardings(mesh, spatial_pspecs(ss0)))
+    out, _ = sharded.run(ss0_sharded, 8.0, 1.0, emit_every=8)
+
+    np.testing.assert_allclose(
+        np.asarray(out.fields), np.asarray(ref.fields), rtol=1e-5, atol=1e-6
+    )
+    for ref_leaf, leaf in zip(
+        jax.tree.leaves(ref.colony.agents), jax.tree.leaves(out.colony.agents)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_leaf), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_mesh_validation():
     mesh = make_mesh(n_agents=4, n_space=2)
     spatial = make_flagship(capacity=66)  # 66 % 4 != 0
