@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lens_tpu.core.state import DIVISION_SEPARATION_UM
 from lens_tpu.environment.lattice import Lattice
 
 
@@ -179,12 +180,18 @@ class HostExchangeLoop:
     This is behaviorally the loop in SURVEY.md §3.2 minus Kafka.
     """
 
-    def __init__(self, lattice: Lattice, exchange_window: float = 1.0):
+    def __init__(
+        self,
+        lattice: Lattice,
+        exchange_window: float = 1.0,
+        seed: int = 0,
+    ):
         self.lattice = lattice
         self.window = float(exchange_window)
         self.fields = lattice.initial_fields()
         self.agents: List[HostAgent] = []
         self.time = 0.0
+        self._rng = np.random.default_rng(seed)  # division placement axes
 
     def add_agent(self, sim: CellSimulation, location: Sequence[float]) -> str:
         agent = HostAgent(sim, location)
@@ -240,9 +247,20 @@ class HostExchangeLoop:
                 continue
             sim_a, sim_b = agent.sim.divide()
             agent.sim.finalize()
-            offset = np.asarray([self.lattice.dx / 4, 0.0])
-            new_agents.append(HostAgent(sim_a, agent.location - offset))
-            new_agents.append(HostAgent(sim_b, agent.location + offset))
+            # Same placement rule as the colony fast path's `offset`
+            # divider (core.state._div_offset): daughters separate by one
+            # cell length along a uniformly random axis.
+            theta = self._rng.uniform(0.0, 2.0 * np.pi)
+            half = (DIVISION_SEPARATION_UM / 2.0) * np.asarray(
+                [np.cos(theta), np.sin(theta)]
+            )
+            hi = np.asarray(self.lattice.size) - 1e-3
+            new_agents.append(
+                HostAgent(sim_a, np.clip(agent.location + half, 0.0, hi))
+            )
+            new_agents.append(
+                HostAgent(sim_b, np.clip(agent.location - half, 0.0, hi))
+            )
         self.agents = new_agents
 
     def run(self, total_time: float) -> None:

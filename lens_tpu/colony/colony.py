@@ -248,7 +248,9 @@ class Colony:
             )
 
             leaves = list(flatten_paths(agents))
-            dummy = jnp.zeros((cap, *key.shape), key.dtype)
+            # zeros_like of a real split keeps this agnostic to the key
+            # representation (legacy uint32 arrays vs typed jax.random.key)
+            dummy = jnp.zeros_like(jax.random.split(key, cap))
             out = agents
             for i, (path, value) in enumerate(leaves):
                 name = self.compartment.dividers.get(path, "split")
@@ -294,6 +296,15 @@ class Colony:
                 )
         out = self.compartment.emit(agents)
         out["alive"] = alive
+        if self.division_trigger is not None:
+            # Saturation telemetry: rows still triggered after step_division
+            # are parents whose division was suppressed (no free row). On a
+            # sharded colony the per-shard free pools mean backlog can be
+            # nonzero while other shards have free rows — this counter is
+            # how that divergence from unsharded biology becomes visible.
+            trig = get_path(agents, self.division_trigger)
+            out["division_backlog"] = jnp.sum(alive & (trig > 0))
+            out["free_rows"] = jnp.sum(~alive)
         return out
 
     def n_alive(self, cs: ColonyState) -> jax.Array:

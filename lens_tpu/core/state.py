@@ -26,6 +26,10 @@ Dividers
 ``copy``      each daughter gets value       (concentrations, parameters)
 ``zero``      daughters restart from 0       (clocks, accumulated flux)
 ``binomial``  stochastic integer split: daughter A ~ Binomial(n, 0.5)
+``offset``    2D locations: daughters displaced +/- half a cell length
+              along a uniformly random axis (division placement — the
+              reference's lattice places daughters apart, reconstructed:
+              SURVEY.md §2 "Spatial lattice" division placement)
 """
 
 from __future__ import annotations
@@ -93,14 +97,35 @@ def _div_zero(value: Array, key: Array) -> Tuple[Array, Array]:
 
 def _div_binomial(value: Array, key: Array) -> Tuple[Array, Array]:
     # Integer-valued molecule counts partition binomially between daughters.
-    # Normal approximation keeps the draw O(1) and fixed-shape; exact for the
-    # large counts it is meant for, clipped into [0, n] for small ones.
-    n = jnp.asarray(value, jnp.float32)
-    mean = n / 2.0
-    std = jnp.sqrt(jnp.maximum(n, 0.0)) / 2.0
-    draw = mean + std * jax.random.normal(key, jnp.shape(value))
-    a = jnp.clip(jnp.round(draw), 0.0, jnp.maximum(n, 0.0))
+    # Exact Binomial(n, 0.5) draw — this divider exists for small-count
+    # molecules (plasmids, transcription factors) where the clipped-normal
+    # approximation is visibly biased below n ~ 20.
+    n = jnp.maximum(jnp.asarray(value, jnp.float32), 0.0)
+    a = jax.random.binomial(key, n, 0.5, shape=jnp.shape(value))
     return a.astype(value.dtype), (n - a).astype(value.dtype)
+
+
+# Separation between daughter centers after division is one cell length
+# (each daughter displaced half of it): a 2 um E. coli divides into two
+# 1 um-spaced daughters. Shared by the jitted `offset` divider and the
+# host bridge's division placement so both paths agree.
+DIVISION_SEPARATION_UM = 1.0
+
+
+def _div_offset(value: Array, key: Array) -> Tuple[Array, Array]:
+    # Division placement for a [2] location leaf: daughters move apart
+    # along a uniformly random axis. (The reference divides along the
+    # cell's long axis; headings are not part of this leaf, so a random
+    # axis is the isotropic equivalent.) The spatial wrapper clips
+    # locations to the lattice domain after division.
+    theta = jax.random.uniform(key, (), minval=0.0, maxval=2.0 * jnp.pi)
+    half = (DIVISION_SEPARATION_UM / 2.0) * jnp.stack(
+        [jnp.cos(theta), jnp.sin(theta)]
+    ).astype(value.dtype)
+    return value + half, value - half
+
+
+_div_offset.stochastic = True
 
 
 # Randomness policy lives WITH the divider definition: the colony layer
@@ -116,6 +141,7 @@ DIVIDERS: Dict[str, Callable[[Array, Array], Tuple[Array, Array]]] = {
     "copy": _div_copy,
     "zero": _div_zero,
     "binomial": _div_binomial,
+    "offset": _div_offset,
 }
 
 # ---------------------------------------------------------------------------

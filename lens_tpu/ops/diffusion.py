@@ -79,6 +79,20 @@ def diffuse_xla(
 # ---------------------------------------------------------------------------
 
 
+#: The kernel holds 2 copies of one [H, W] slab (in + out block) in VMEM;
+#: budget half of a v5e core's ~16 MiB so other buffers and padding to
+#: (8, 128) tiling always fit.
+_VMEM_SLAB_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _fits_vmem(fields: jnp.ndarray) -> bool:
+    _, h, w = fields.shape
+    # account for tiling padding: VMEM allocations round up to (8, 128)
+    h_pad = -(-h // 8) * 8
+    w_pad = -(-w // 128) * 128
+    return 2 * h_pad * w_pad * fields.dtype.itemsize <= _VMEM_SLAB_BUDGET_BYTES
+
+
 def diffuse_pallas(
     fields: jnp.ndarray,
     alpha: jnp.ndarray,
@@ -135,6 +149,8 @@ def diffuse(
     """
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if impl == "pallas" and not _fits_vmem(fields):
+            impl = "xla"  # slab too big for on-core VMEM: XLA tiles instead
     if impl == "xla":
         return diffuse_xla(fields, alpha, n_substeps)
     if impl == "pallas":

@@ -47,7 +47,8 @@ class LPResult(NamedTuple):
     objective: jnp.ndarray  # scalar c @ x
     primal_residual: jnp.ndarray  # ||A x - b||_inf
     dual_gap: jnp.ndarray   # complementarity gap mu = (x'z + s w) / 2R
-    converged: jnp.ndarray  # bool: gap and residual below tol
+    converged: jnp.ndarray  # bool: gap, primal AND dual residuals below tol
+    dual_residual: jnp.ndarray  # ||c - A^T y - z + w||_inf (scaled system)
 
 
 class _IPState(NamedTuple):
@@ -172,8 +173,18 @@ def linprog_box(
         eta = 0.995
         alpha_p = eta * jnp.minimum(_max_step(x, dx), _max_step(s, ds))
         alpha_d = eta * jnp.minimum(_max_step(z, dz), _max_step(w, dw))
-        go = mu > floor
-        step = lambda v, dv, a: jnp.where(go & jnp.isfinite(dv).all(), v + a * dv, v)
+        # One shared finiteness flag across ALL direction components:
+        # stepping primal while freezing dual (or vice versa) would leave
+        # an inconsistent iterate, so the whole step is all-or-nothing.
+        finite = (
+            jnp.isfinite(dx).all()
+            & jnp.isfinite(ds).all()
+            & jnp.isfinite(dy).all()
+            & jnp.isfinite(dz).all()
+            & jnp.isfinite(dw).all()
+        )
+        go = (mu > floor) & finite
+        step = lambda v, dv, a: jnp.where(go, v + a * dv, v)
         return _IPState(
             x=step(x, dx, alpha_p),
             s=step(s, ds, alpha_p),
@@ -198,8 +209,18 @@ def linprog_box(
     primal_residual = jnp.max(jnp.abs(A @ x - b)) if m else jnp.asarray(0.0, dtype)
     gap = (state.x @ state.z + state.s @ state.w) / (2 * r)
     scale = 1.0 + jnp.max(jnp.abs(b)) if m else jnp.asarray(1.0, dtype)
-    converged = (gap < tol * (1.0 + jnp.abs(c @ x))) & (
-        primal_residual < jnp.sqrt(jnp.asarray(tol, dtype)) * scale
+    # Dual residual at the final iterate (scaled/shifted system): without
+    # this, an iteration-starved primal-feasible point could report
+    # converged=True with suboptimal fluxes.
+    dual_residual = jnp.max(
+        jnp.abs(c - A.T @ state.y - state.z + state.w)
+    )
+    dual_scale = 1.0 + jnp.max(jnp.abs(c))
+    sqrt_tol = jnp.sqrt(jnp.asarray(tol, dtype))
+    converged = (
+        (gap < tol * (1.0 + jnp.abs(c @ x)))
+        & (primal_residual < sqrt_tol * scale)
+        & (dual_residual < sqrt_tol * dual_scale)
     )
     return LPResult(
         x=x,
@@ -207,6 +228,7 @@ def linprog_box(
         primal_residual=primal_residual,
         dual_gap=gap,
         converged=converged,
+        dual_residual=dual_residual,
     )
 
 

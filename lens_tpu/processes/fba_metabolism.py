@@ -212,6 +212,18 @@ class FBAMetabolism(Process):
         self.kms = jnp.asarray(kms)
         self.uptake_mask = jnp.asarray(uptake_mask)
         self.biomass_index = self.reactions.index(net["objective"])
+        # Availability-cap bookkeeping: the cap must bound the SUMMED
+        # uptake per external species, so each import reaction gets an
+        # equal share of its species' availability (two importers of one
+        # species may not jointly overdraw the bin — the lattice's >=0
+        # clamp would otherwise create mass).
+        pos = np.clip(exchange, 0.0, None)               # [E, R]
+        self._import_indicator = jnp.asarray((pos > 0).astype(np.float32))
+        self._import_coeff = jnp.asarray(
+            np.maximum(pos.sum(axis=0), 1e-12), jnp.float32
+        )  # [R] units of species imported per unit flux
+        # (the per-step active-importer share is computed in next_update,
+        # after regulation gates are known)
 
     # -- declarative surface --------------------------------------------------
 
@@ -262,14 +274,29 @@ class FBAMetabolism(Process):
     def next_update(self, timestep, states):
         ext = jnp.stack([states["external"][mol] for mol in self.external])
 
-        # 1. Environment-dependent uptake bounds: MM saturation, plus a hard
-        # cap so dt * uptake never exceeds the locally available amount.
-        # [R] external concentration feeding each import reaction (0 for
-        # non-import reactions; import columns are one-hot in exchange_matrix).
-        env_of_rxn = jnp.clip(self.exchange_matrix, 0.0, None).T @ ext
-        saturation = env_of_rxn / (self.kms + env_of_rxn + 1e-12)
+        # 1. Boolean regulation gates, computed first: the availability cap
+        # below splits each species among its ACTIVE importers only.
+        env = {mol: ext[e] for e, mol in enumerate(self.external)}
+        gate = jnp.ones(len(self.reactions), ext.dtype)
+        for j, rule in self._rules.items():
+            gate = gate.at[j].set(rule(env))
+
+        # 2. Environment-dependent uptake bounds: MM saturation on the raw
+        # species concentration (Km is in concentration units), plus a hard
+        # cap so dt * SUMMED uptake per species never exceeds the locally
+        # available amount — each active importer gets an equal share.
+        # Default network: one importer per species, coeff 1 — identical to
+        # a per-reaction cap.
+        ext_of_rxn = self._import_indicator.T @ ext  # [R] raw species conc
+        saturation = ext_of_rxn / (self.kms + ext_of_rxn + 1e-12)
+        active = gate * self.uptake_mask                       # [R]
+        share = jnp.maximum(
+            self._import_indicator.T @ (self._import_indicator @ active), 1.0
+        )  # [R] active importers of this reaction's species
         avail_cap = (
-            self.config["uptake_cap_fraction"] * env_of_rxn / timestep
+            self.config["uptake_cap_fraction"]
+            * ext_of_rxn
+            / (self._import_coeff * share * timestep)
         )
         ub = jnp.where(
             self.uptake_mask,
@@ -279,14 +306,11 @@ class FBAMetabolism(Process):
         lb = jnp.where(self.uptake_mask, jnp.zeros_like(self.lb), self.lb)
         lb = jnp.minimum(lb, ub)  # keep the box consistent under capping
 
-        # 2. Boolean regulation clamps both bounds of gated reactions.
-        env = {mol: ext[e] for e, mol in enumerate(self.external)}
-        for j, rule in self._rules.items():
-            gate = rule(env)
-            lb = lb.at[j].mul(gate)
-            ub = ub.at[j].mul(gate)
+        # 3. Regulation clamps both bounds of gated reactions.
+        lb = lb * gate
+        ub = ub * gate
 
-        # 3. The LP: max biomass s.t. S v = 0, lb <= v <= ub.
+        # 4. The LP: max biomass s.t. S v = 0, lb <= v <= ub.
         sol = flux_balance(
             self.stoichiometry,
             self.objective,
@@ -299,7 +323,7 @@ class FBAMetabolism(Process):
         ok = sol.converged
         v = jnp.where(ok, sol.x, jnp.zeros_like(sol.x))
 
-        # 4. Deltas. Exchange port counts net secretion (negative=uptake).
+        # 5. Deltas. Exchange port counts net secretion (negative=uptake).
         net_uptake = self.exchange_matrix @ v          # [E], + = imported
         growth = v[self.biomass_index]
         return {

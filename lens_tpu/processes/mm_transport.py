@@ -116,7 +116,9 @@ class BrownianMotility(Process):
                 "location": {
                     "_default": jnp.zeros(2, jnp.float32),
                     "_updater": "set",
-                    "_divider": "copy",
+                    # division placement: daughters separate by a cell
+                    # length along a random axis (core.state._div_offset)
+                    "_divider": "offset",
                 },
             },
         }

@@ -44,8 +44,14 @@ class TestHostLoop:
         vb = loop.agents[1].sim.volume
         np.testing.assert_allclose(va, vb)
         assert va < 1.9
-        # daughters placed apart
-        assert not np.allclose(loop.agents[0].location, loop.agents[1].location)
+        # daughters placed apart — by the same separation the colony fast
+        # path's `offset` divider uses (one cell length)
+        from lens_tpu.core.state import DIVISION_SEPARATION_UM
+
+        sep = np.linalg.norm(
+            loop.agents[0].location - loop.agents[1].location
+        )
+        np.testing.assert_allclose(sep, DIVISION_SEPARATION_UM, rtol=1e-6)
 
     def test_population_growth_over_generations(self):
         loop = HostExchangeLoop(small_lattice())

@@ -100,6 +100,22 @@ def test_determinism_same_seed():
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+def test_emit_reports_division_backlog_at_capacity():
+    """A full colony suppresses divisions; the emit slice must say so
+    (saturation telemetry — critical on sharded colonies whose per-shard
+    free pools can starve locally)."""
+    colony, cs = growth_colony(capacity=2, n_alive=2, threshold=2.0)
+    cs2, _ = colony.run(cs, 80.0, 1.0)  # both rows want to divide by t~70
+    assert int(colony.n_alive(cs2)) == 2  # no free rows: suppressed
+    emit = colony.emit(cs2)
+    assert int(emit["free_rows"]) == 0
+    assert int(emit["division_backlog"]) == 2
+    # and a colony with headroom reports no backlog after dividing
+    colony2, cs_b = growth_colony(capacity=8, n_alive=1, threshold=2.0)
+    cs_b2, _ = colony2.run(cs_b, 80.0, 1.0)
+    assert int(colony2.emit(cs_b2)["division_backlog"]) == 0
+
+
 def test_emit_trajectory_contains_alive():
     colony, cs = growth_colony(capacity=8, n_alive=1)
     _, traj = colony.run(cs, 100.0, 1.0, emit_every=50)

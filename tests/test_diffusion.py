@@ -76,6 +76,21 @@ def test_pallas_interpret_matches_xla():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
 
+def test_vmem_guard():
+    """`auto` must not route slabs beyond the VMEM budget to the Pallas
+    kernel (one [H, W] molecule slab lives whole in VMEM by design)."""
+    from lens_tpu.ops.diffusion import _VMEM_SLAB_BUDGET_BYTES, _fits_vmem
+
+    ok = jnp.zeros((1, 256, 256), jnp.float32)
+    too_big = jnp.zeros((1, 2048, 2048), jnp.float32)  # 2 * 16 MiB
+    assert _fits_vmem(ok)
+    assert not _fits_vmem(too_big)
+    # padding to the (8, 128) tile is accounted for
+    padded = jnp.zeros((1, 1025, 1025), jnp.float32)
+    assert 2 * 1032 * 1152 * 4 > _VMEM_SLAB_BUDGET_BYTES
+    assert not _fits_vmem(padded)
+
+
 def test_dispatch_and_stability_helper():
     assert stable_substeps(0.0, 1.0, 1.0) == 1
     # alpha = 600*1/25 = 24 -> needs >= 24/0.225 ~ 107 substeps

@@ -91,6 +91,66 @@ class TestPhenomena:
         taken = -float(upd["exchange"]["glc_exchange"])
         assert taken <= 0.01 + 1e-5
 
+    def test_two_importers_share_availability_cap(self):
+        """Two import reactions for one species may not jointly overdraw
+        the bin: the cap bounds their SUMMED uptake."""
+        import copy
+
+        net = copy.deepcopy(
+            __import__(
+                "lens_tpu.processes.fba_metabolism", fromlist=["x"]
+            ).CORE_RFBA_NETWORK
+        )
+        # second glucose importer, as permissive as the first
+        net["reactions"]["glc_uptake2"] = {
+            "stoich": {"C": 2.0},
+            "bounds": (0.0, 1.0),
+            "exchange": "glc",
+            "km": 0.5,
+            "rule": "",
+        }
+        p = FBAMetabolism({"network": net})
+        s = p.initial_state()
+        s["external"]["glc"] = jnp.asarray(0.01)  # scarce
+        s["external"]["ace"] = jnp.asarray(0.0)
+        s["external"]["o2"] = jnp.asarray(5.0)
+        dt = 10.0
+        upd = p.next_update(dt, s)
+        taken = -float(upd["exchange"]["glc_exchange"])
+        assert taken <= 0.01 + 1e-5, taken
+
+    def test_gated_importer_does_not_dilute_share(self):
+        """The availability split counts ACTIVE importers only: a
+        regulation-silenced importer must not halve the live one's cap."""
+        import copy
+
+        from lens_tpu.processes.fba_metabolism import CORE_RFBA_NETWORK
+
+        net = copy.deepcopy(CORE_RFBA_NETWORK)
+        net["reactions"]["glc_uptake2"] = {
+            "stoich": {"C": 2.0},
+            "bounds": (0.0, 1.0),
+            "exchange": "glc",
+            "km": 0.5,
+            "rule": "not glc",  # off whenever glucose is present
+        }
+        p = FBAMetabolism({"network": net})
+        s = p.initial_state()
+        # scarce enough that the availability cap binds (not the MM bound),
+        # rich enough that maintenance stays feasible
+        s["external"]["glc"] = jnp.asarray(0.5)
+        s["external"]["ace"] = jnp.asarray(0.0)
+        s["external"]["o2"] = jnp.asarray(5.0)
+        dt = 10.0
+        upd = p.next_update(dt, s)
+        assert float(upd["fluxes"]["lp_converged"]) == 1.0
+        taken = -float(upd["exchange"]["glc_exchange"])
+        cap = p.config["uptake_cap_fraction"] * 0.5
+        # the single ACTIVE importer gets the whole capped share; a static
+        # two-importer split would stop at cap/2
+        assert taken > 0.8 * cap, (taken, cap)
+        assert taken <= 0.5 + 1e-4
+
 
 class TestIntegration:
     def test_vmap_over_colony(self):

@@ -139,6 +139,61 @@ def test_bad_wiring_raises():
         )
 
 
+def test_division_places_daughters_apart():
+    """Under zero motility, daughters must still separate (the `offset`
+    location divider) — round-1 co-located them forever."""
+    from lens_tpu.core.state import DIVISION_SEPARATION_UM
+    from lens_tpu.processes.growth import DivideTrigger, Growth
+
+    comp = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport({"vmax": 0.0}),
+            "motility": BrownianMotility({"sigma": 0.0}),
+            "growth": Growth({"rate": 0.5}),  # fast: divides in a few steps
+            "divide_trigger": DivideTrigger(),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "motility": {"boundary": ("boundary",)},
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+        },
+    )
+    colony = Colony(comp, 8, division_trigger=("global", "divide"))
+    lattice = Lattice(
+        molecules=["glucose"], shape=(16, 16), size=(16.0, 16.0),
+        diffusion=0.0, initial=10.0, timestep=1.0,
+    )
+    spatial = SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            "glucose": (("boundary", "external", "glucose"),
+                        ("boundary", "exchange", "glucose_exchange")),
+        },
+        location_path=("boundary", "location"),
+    )
+    ss = spatial.initial_state(
+        1, jax.random.PRNGKey(0),
+        locations=np.broadcast_to(
+            np.asarray([8.0, 8.0], np.float32), (8, 2)
+        ).copy(),
+    )
+    for _ in range(4):
+        ss = spatial.step(ss, 1.0)
+        if int(jnp.sum(ss.colony.alive)) >= 2:
+            break
+    alive = np.asarray(ss.colony.alive)
+    assert alive.sum() == 2, "expected exactly one division"
+    locs = np.asarray(ss.colony.agents["boundary"]["location"])[alive]
+    sep = np.linalg.norm(locs[0] - locs[1])
+    np.testing.assert_allclose(sep, DIVISION_SEPARATION_UM, rtol=1e-5)
+
+
 def test_exact_conservation_with_division_and_motility():
     """Regression (caught in verify): division used to zero the exchange
     accumulator before the field was debited, and the scatter hit the

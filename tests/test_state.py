@@ -73,6 +73,44 @@ def test_divide_binomial_conserves_counts():
     assert abs(float(a["counts"]) - 5000.0) < 250.0
 
 
+def test_divide_binomial_small_counts_exact():
+    """The divider must be a true binomial, not a normal approximation:
+    for n=1 the daughters split 1/0 or 0/1 with p=1/2 each — a clipped
+    normal piles excess mass on the boundaries instead."""
+    import numpy as np
+
+    ones = 0
+    trials = 400
+    for s in range(trials):
+        a, b = divide_state(
+            {"n": jnp.float32(1.0)}, jax.random.PRNGKey(s), {("n",): "binomial"}
+        )
+        av, bv = float(a["n"]), float(b["n"])
+        assert (av, bv) in ((1.0, 0.0), (0.0, 1.0))
+        ones += int(av)
+    # p=0.5 within 5 sigma (sigma=10 for 400 trials)
+    assert abs(ones - trials / 2) < 50, ones
+
+
+def test_divide_offset_separates_locations():
+    from lens_tpu.core.state import DIVISION_SEPARATION_UM
+
+    loc = jnp.asarray([10.0, 20.0], jnp.float32)
+    a, b = divide_state(
+        {"loc": loc}, jax.random.PRNGKey(7), {("loc",): "offset"}
+    )
+    import numpy as np
+
+    sep = np.linalg.norm(np.asarray(a["loc"]) - np.asarray(b["loc"]))
+    np.testing.assert_allclose(sep, DIVISION_SEPARATION_UM, rtol=1e-5)
+    # midpoint is the parent location
+    np.testing.assert_allclose(
+        (np.asarray(a["loc"]) + np.asarray(b["loc"])) / 2,
+        np.asarray(loc),
+        rtol=1e-5,
+    )
+
+
 def test_updater_registry_complete():
     for name in ("accumulate", "nonnegative_accumulate", "set", "null"):
         assert name in UPDATERS
