@@ -137,27 +137,35 @@ def config_3():
 
 
 def config_4():
+    """100k-cell MIXED-SPECIES colony: two distinct process sets (ODE
+    kinetics vs hybrid Gillespie+ODE) on one 256x256 two-molecule lattice
+    — the genuinely heterogeneous north-star scenario."""
     import jax
 
-    from lens_tpu.colony.colony import Colony
-    from lens_tpu.models.composites import hybrid_cell
+    from lens_tpu.models.composites import mixed_species_lattice
 
-    n = 102400
-    colony = Colony(
-        hybrid_cell({}), capacity=n, division_trigger=("global", "divide")
+    n_each = 50_000
+    multi, _ = mixed_species_lattice(
+        {
+            "capacity": {"ecoli": 51200, "scavenger": 51200},
+            "shape": (256, 256),
+        }
     )
 
     def build():
-        state = colony.initial_state(100000, key=jax.random.PRNGKey(0))
+        state = multi.initial_state(
+            {"ecoli": n_each, "scavenger": n_each}, jax.random.PRNGKey(0)
+        )
         window = jax.jit(
-            lambda s: colony.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+            lambda s: multi.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
         )
         return state, window
 
-    rate, elapsed = _measure(build, n)
+    rate, elapsed = _measure(build, 2 * n_each)
     return {
         "config": 4,
-        "scenario": "100k mixed hybrid Gillespie+ODE colony (north star)",
+        "scenario": "100k mixed-species colony, 2 process sets, "
+        "256x256 lattice (north star)",
         "metric": "agent-steps/sec",
         "value": round(rate, 1),
     }

@@ -31,6 +31,21 @@ from lens_tpu.core.topology import Path, TopologySpec, normalize_topology
 from lens_tpu.utils.dicts import deep_merge, flatten_paths, get_path, set_path
 
 
+def _strong(x) -> jnp.ndarray:
+    """To a jnp array with a STRONG (non-weak) dtype.
+
+    Python scalars become weak-typed jax arrays; a state built from them
+    changes aval signature after one scan (outputs are strong), forcing a
+    full recompile on the second call of any jitted step/run — measured at
+    0.3-4 s per composite, and the round-1 config-3 "throughput" number
+    was in fact this recompile. Routing defaults/overrides through numpy
+    (whose dtypes are never weak) makes initial states aval-identical to
+    evolved states; jnp.asarray canonicalizes the width itself (64->32
+    under default config, preserved under x64 mode).
+    """
+    return jnp.asarray(np.asarray(x))
+
+
 class Compartment:
     """A wired set of Processes sharing a state tree.
 
@@ -89,7 +104,7 @@ class Compartment:
                             f"{name}.{port}.{var}: schema leaf needs '_default'"
                         )
                     path = base + (var,)
-                    default = jnp.asarray(leaf["_default"])
+                    default = _strong(leaf["_default"])
                     if path in self.updaters:
                         # Shared variable: declarations must agree — silent
                         # first-wins hides wiring bugs.
@@ -130,7 +145,7 @@ class Compartment:
                         f"schema variable (typo?)"
                     )
             state = deep_merge(state, overrides)
-        return jax.tree.map(jnp.asarray, state)
+        return jax.tree.map(_strong, state)
 
     # -- views ---------------------------------------------------------------
 

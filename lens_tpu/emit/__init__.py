@@ -32,6 +32,7 @@ from lens_tpu.emit.log import (
     encode_record,
     frame,
     make_header,
+    make_segment,
     read_experiment,
     stack_records,
 )
@@ -47,19 +48,28 @@ class Emitter:
     def emit(self, record: Mapping[str, Any]) -> None:
         raise NotImplementedError
 
+    def _host_trajectory(self, trajectory: Any, times: Any):
+        """Shared preamble: device->host transfer + times default.
+        Returns ``(host_tree, times)`` or ``None`` for an empty tree."""
+        host = jax.device_get(trajectory)
+        leaves = jax.tree.leaves(host)
+        if not leaves:
+            return None
+        steps = leaves[0].shape[0]
+        times = np.asarray(times) if times is not None else np.arange(steps)
+        return host, times
+
     def emit_trajectory(self, trajectory: Any, times: Any = None) -> None:
         """Emit a device trajectory (leaves [T, ...]) as T records.
 
         One ``device_get`` for the whole segment; per-step splitting is
         host-side numpy slicing.
         """
-        host = jax.device_get(trajectory)
-        leaves = jax.tree.leaves(host)
-        if not leaves:
+        got = self._host_trajectory(trajectory, times)
+        if got is None:
             return
-        steps = leaves[0].shape[0]
-        times = np.asarray(times) if times is not None else np.arange(steps)
-        for t in range(steps):
+        host, times = got
+        for t in range(len(times)):
             record = jax.tree.map(lambda x: x[t], host)
             record["__time__"] = times[t]
             self.emit(record)
@@ -205,6 +215,18 @@ class LogEmitter(Emitter):
 
     def emit(self, record: Mapping[str, Any]) -> None:
         self._writer.write(encode_record(record))
+
+    def emit_trajectory(self, trajectory: Any, times: Any = None) -> None:
+        """Write the whole segment as ONE record (O(leaves), not
+        O(T * leaves)): the device hands the trajectory over already
+        stacked; per-step splitting is deferred to the offline read path
+        (``log.expand_segment``). The bytes still stream through the
+        background writer, so the step loop never blocks on disk."""
+        got = self._host_trajectory(trajectory, times)
+        if got is None:
+            return
+        host, times = got
+        self._writer.write(encode_record(make_segment(host, times)))
 
     def flush(self) -> None:
         self._writer.flush()

@@ -112,6 +112,36 @@ def is_header(record: Mapping) -> bool:
     return "__header__" in record
 
 
+def make_segment(trajectory: Mapping, times: np.ndarray) -> Dict[str, Any]:
+    """A SEGMENT record: one record carrying a whole stacked [T, ...]
+    trajectory + its times. Writing a segment is O(leaves) instead of the
+    per-step O(T * leaves) — at 100k agents x dense emit the per-step
+    Python serialization loop dominated the host path (the device already
+    hands the trajectory over stacked; splitting it to re-stack at read
+    time was pure overhead)."""
+    return {"__segment__": dict(trajectory), "__times__": np.asarray(times)}
+
+
+def is_segment(record: Mapping) -> bool:
+    return "__segment__" in record
+
+
+def expand_segment(record: Mapping) -> Iterator[Dict[str, Any]]:
+    """Per-step records from a segment record (offline read path)."""
+    seg = record["__segment__"]
+    times = np.asarray(record["__times__"])
+
+    def slice_t(node: Any, t: int) -> Any:
+        if isinstance(node, Mapping):
+            return {k: slice_t(v, t) for k, v in node.items()}
+        return np.asarray(node)[t]
+
+    for t in range(len(times)):
+        row = slice_t(seg, t)
+        row["__time__"] = times[t]
+        yield row
+
+
 def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     """Read a whole log: (header dict, list of data records)."""
     header: Dict[str, Any] = {}
@@ -124,6 +154,8 @@ def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
                 "config": json.loads(str(h["config_json"])),
                 "format_version": int(h["format_version"]),
             }
+        elif is_segment(record):
+            records.extend(expand_segment(record))
         else:
             records.append(record)
     return header, records

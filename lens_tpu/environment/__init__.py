@@ -1,4 +1,14 @@
 from lens_tpu.environment.lattice import Lattice
+from lens_tpu.environment.multispecies import (
+    MultiSpeciesColony,
+    MultiSpeciesState,
+)
 from lens_tpu.environment.spatial import SpatialColony, SpatialState
 
-__all__ = ["Lattice", "SpatialColony", "SpatialState"]
+__all__ = [
+    "Lattice",
+    "MultiSpeciesColony",
+    "MultiSpeciesState",
+    "SpatialColony",
+    "SpatialState",
+]

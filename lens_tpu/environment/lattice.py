@@ -113,6 +113,7 @@ class Lattice:
         locations: jnp.ndarray,
         alive: jnp.ndarray | None = None,
         share_bins: bool = True,
+        occupancy: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         """Gather each agent's local concentration: [N, M].
 
@@ -130,9 +131,14 @@ class Lattice:
         i, j = self.bin_of(locations)
         local = fields[:, i, j].T
         if share_bins:
-            if alive is None:
-                raise ValueError("share_bins needs the alive mask")
-            occ = self.occupancy(locations, alive)[i, j]
+            if occupancy is None:
+                if alive is None:
+                    raise ValueError("share_bins needs the alive mask")
+                occupancy = self.occupancy(locations, alive)
+            # ``occupancy`` may be passed precomputed so callers stepping
+            # SEVERAL agent populations against one lattice (multi-species)
+            # can share bins across all of them, not just within one.
+            occ = occupancy[i, j]
             local = local / (
                 jnp.maximum(occ, 1.0)[:, None] * self.exchange_scale
             )

@@ -1,0 +1,256 @@
+"""Mixed-species colonies: distinct process sets sharing one lattice.
+
+The reference's mixed-species experiments boot DIFFERENT agent types onto
+the same environment lattice — each cell type has its own process set,
+and the outer agent neither knows nor cares which inner sim answers an
+exchange window (reconstructed: SURVEY.md §2 "Boot registry" agent types,
+§7 hard-part #1 "mixed process-sets per agent").
+
+Under SPMD there are two ways to get heterogeneity (SURVEY.md §7):
+masked unified state (every process runs on every agent, masked off) or
+**per-species subcolonies** — this module implements the latter, which is
+the TPU-idiomatic choice:
+
+- each species is its own :class:`~lens_tpu.colony.colony.Colony` with
+  its own compartment, so each species' biology is one clean ``vmap``
+  over a densely-packed agent axis — no wasted FLOPs on masked-off
+  processes, no schema union across species;
+- the lattice is shared: gathers/scatters run per species against the
+  same field array, with **combined occupancy** (all species' live cells
+  in a bin split its content) so shared-bin mass conservation spans
+  species exactly as it spans agents within one species;
+- division stays within a species (cells breed true), so each
+  subcolony's row-activation machinery is untouched.
+
+Each species' agent axis can be sharded independently with ``shard_map``
+(the same data-parallel layout ``parallel.runner`` gives one species);
+the fields axis shards spatially as usual. Scale limits are per species:
+capacity is preallocated per subcolony.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.colony.colony import Colony, ColonyState
+from lens_tpu.core.schedule import scan_schedule
+from lens_tpu.core.topology import Path, normalize_path
+from lens_tpu.environment.lattice import Lattice
+from lens_tpu.environment.spatial import FieldPort, SpatialColony
+from lens_tpu.utils.dicts import get_path, set_path
+
+
+class MultiSpeciesState(NamedTuple):
+    species: Dict[str, ColonyState]  # one stacked subcolony per species
+    fields: jax.Array                # [M, H, W] shared lattice fields
+
+
+class MultiSpeciesColony:
+    """N species, one lattice, one jitted step.
+
+    Parameters
+    ----------
+    species:
+        name -> ``SpatialColony`` built against the SAME ``lattice``
+        object (their per-species port wiring and validation are reused;
+        their own ``step`` is not — stepping happens here so occupancy,
+        scatter and diffusion are shared across species).
+    lattice:
+        The shared environment.
+    share_bins:
+        As in :class:`SpatialColony`, but occupancy counts live cells of
+        ALL species in a bin.
+    """
+
+    def __init__(
+        self,
+        species: Mapping[str, SpatialColony],
+        lattice: Lattice,
+        share_bins: bool = True,
+    ):
+        if not species:
+            raise ValueError("need at least one species")
+        if "fields" in species:
+            raise ValueError(
+                'species name "fields" is reserved (the emit trajectory '
+                "carries the lattice under that key)"
+            )
+        for name, sp in species.items():
+            if sp.lattice is not lattice:
+                raise ValueError(
+                    f"species {name!r} was built against a different "
+                    f"Lattice object; all species must share one"
+                )
+        self.species: Dict[str, SpatialColony] = dict(species)
+        self.lattice = lattice
+        self.share_bins = bool(share_bins)
+
+    # -- construction --------------------------------------------------------
+
+    def initial_state(
+        self,
+        n_alive: Mapping[str, int],
+        key: jax.Array,
+        overrides: Mapping[str, Mapping] | None = None,
+        locations: Mapping[str, jax.Array] | None = None,
+    ) -> MultiSpeciesState:
+        """Per-species row construction + one shared field array."""
+        overrides = overrides or {}
+        locations = locations or {}
+        states: Dict[str, ColonyState] = {}
+        for idx, name in enumerate(sorted(self.species)):
+            sp = self.species[name]
+            ss = sp.initial_state(
+                int(n_alive.get(name, 0)),
+                jax.random.fold_in(key, idx),
+                overrides=overrides.get(name),
+                locations=locations.get(name),
+            )
+            states[name] = ss.colony
+        return MultiSpeciesState(
+            species=states, fields=self.lattice.initial_fields()
+        )
+
+    # -- stepping ------------------------------------------------------------
+
+    def total_occupancy(self, ms: MultiSpeciesState) -> jax.Array:
+        """Live-cell count per bin, summed over every species: [H, W]."""
+        occ = jnp.zeros(self.lattice.shape, jnp.float32)
+        for name, sp in self.species.items():
+            cs = ms.species[name]
+            locs = get_path(cs.agents, sp.location_path)
+            occ = occ + self.lattice.occupancy(locs, cs.alive)
+        return occ
+
+    def step(self, ms: MultiSpeciesState, timestep: float) -> MultiSpeciesState:
+        """One exchange window for every species + the shared fields.
+
+        Same pre-step-bin semantics as :meth:`SpatialColony.step`, with
+        cross-species combined occupancy in the gather and ONE clamp after
+        all species' exchanges land (so inter-species accounting is a
+        single mass balance, not per-species application order).
+        """
+        if abs(timestep - self.lattice.timestep) > 1e-9:
+            raise ValueError(
+                f"timestep={timestep} != lattice.timestep="
+                f"{self.lattice.timestep}"
+            )
+        fields = ms.fields
+        occ = self.total_occupancy(ms) if self.share_bins else None
+
+        # 1. gather per species (shared for consuming ports — divided by
+        # the ALL-species occupancy — raw for sense-only ports)
+        stepped: Dict[str, ColonyState] = {}
+        pre_locations: Dict[str, jax.Array] = {}
+        for name, sp in self.species.items():
+            cs = ms.species[name]
+            locs = get_path(cs.agents, sp.location_path)
+            pre_locations[name] = locs
+            local_shared = self.lattice.local_concentrations(
+                fields, locs, cs.alive,
+                share_bins=self.share_bins, occupancy=occ,
+            )
+            local_raw = (
+                self.lattice.local_concentrations(
+                    fields, locs, cs.alive, share_bins=False
+                )
+                if any(p.exchange is None for p in sp.field_ports.values())
+                else local_shared
+            )
+            agents = cs.agents
+            for mol, port in sp.field_ports.items():
+                local = local_raw if port.exchange is None else local_shared
+                col = local[:, self.lattice.index(mol)]
+                prev = get_path(agents, port.local)
+                agents = set_path(
+                    agents, port.local, jnp.where(cs.alive, col, prev)
+                )
+            stepped[name] = cs._replace(agents=agents)
+
+        # 2. biology per species — one vmap per process set
+        for name, sp in self.species.items():
+            stepped[name] = sp.colony.step_biology(stepped[name], timestep)
+
+        # 3. scatter ALL species' exchanges into the PRE-STEP bins, one
+        # combined delta, one >=0 clamp
+        delta = jnp.zeros_like(fields)
+        for name, sp in self.species.items():
+            cs = stepped[name]
+            agents = cs.agents
+            cap_rows = cs.alive.shape[0]
+            exchange = jnp.stack(
+                [
+                    get_path(agents, sp.field_ports[mol].exchange)
+                    if mol in sp.field_ports
+                    and sp.field_ports[mol].exchange is not None
+                    else jnp.zeros(cap_rows)
+                    for mol in self.lattice.molecules
+                ],
+                axis=1,
+            )  # [rows, M]
+            i, j = self.lattice.bin_of(pre_locations[name])
+            contrib = (
+                exchange * cs.alive[:, None] * self.lattice.exchange_scale
+            )
+            delta = delta.at[:, i, j].add(contrib.T)
+            for mol, port in sp.field_ports.items():
+                if port.exchange is None:
+                    continue
+                agents = set_path(
+                    agents, port.exchange,
+                    jnp.zeros_like(get_path(agents, port.exchange)),
+                )
+            stepped[name] = cs._replace(agents=agents)
+        fields = jnp.maximum(fields + delta, 0.0)
+
+        # 4. division per species, then clip onto the domain
+        h, w = self.lattice.size
+        for name, sp in self.species.items():
+            cs = sp.colony.step_division(stepped[name])
+            agents = cs.agents
+            loc = get_path(agents, sp.location_path)
+            loc = jnp.clip(
+                loc, jnp.zeros(2, loc.dtype),
+                jnp.asarray([h, w], loc.dtype) - 1e-3,
+            )
+            stepped[name] = cs._replace(
+                agents=set_path(agents, sp.location_path, loc),
+                step=cs.step + 1,
+            )
+
+        # 5. diffusion, once
+        fields = self.lattice.step_fields(fields)
+        return MultiSpeciesState(species=stepped, fields=fields)
+
+    def run(
+        self,
+        ms: MultiSpeciesState,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+    ) -> Tuple[MultiSpeciesState, dict]:
+        def emit_fn(carry):
+            emit = {
+                name: sp.colony.emit(carry.species[name])
+                for name, sp in self.species.items()
+            }
+            emit["fields"] = carry.fields
+            return emit
+
+        return scan_schedule(
+            lambda c: self.step(c, timestep), emit_fn, ms,
+            total_time, timestep, emit_every,
+        )
+
+    # -- diagnostics ---------------------------------------------------------
+
+    def total_field_mass(self, ms: MultiSpeciesState) -> jax.Array:
+        return jnp.sum(ms.fields, axis=(1, 2))
+
+    def n_alive(self, ms: MultiSpeciesState) -> Dict[str, jax.Array]:
+        return {
+            name: jnp.sum(ms.species[name].alive) for name in self.species
+        }

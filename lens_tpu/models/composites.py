@@ -356,6 +356,134 @@ def rfba_lattice(
 
 
 @register_composite
+def mixed_species_lattice(
+    config: Mapping | None = None,
+):
+    """Config 4, genuinely mixed: two species with DISTINCT process sets
+    on one lattice (SURVEY.md §7 hard-part #1; the reference boots
+    different agent types onto the same environment).
+
+    - ``ecoli``: deterministic kinetics — Michaelis–Menten glucose
+      transport + exponential growth + division + Brownian motility.
+    - ``scavenger``: hybrid stochastic — tau-leap Gillespie gene
+      expression beside Michaelis–Menten ACETATE transport + growth +
+      division + motility.
+
+    The species couple through the shared two-molecule field (combined
+    bin occupancy) while running entirely different programs — each is
+    its own vmap, so neither pays for the other's processes. Returns
+    ``(multi, {"ecoli": compartment, "scavenger": compartment})``.
+    """
+    c = _cfg(
+        {
+            "capacity": {"ecoli": 512, "scavenger": 512},
+            "shape": (64, 64),
+            "size": None,             # defaults to 10 um bins
+            "diffusion": {"glucose": 600.0, "acetate": 900.0},
+            "initial": {"glucose": 10.0, "acetate": 5.0},
+            "timestep": 1.0,
+            "division": True,
+            "ecoli": {
+                "transport": {},
+                "growth": {},
+                "divide": {},
+                "motility": {"sigma": 0.5},
+            },
+            "scavenger": {
+                "transport": {"molecule": "acetate", "vmax": 0.05},
+                "expression": {},
+                "growth": {"rate": 0.0003},
+                "divide": {},
+                "motility": {"sigma": 0.5},
+            },
+        },
+        config,
+    )
+    from lens_tpu.environment.multispecies import MultiSpeciesColony
+
+    shape = tuple(c["shape"])
+    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
+    lattice = Lattice(
+        molecules=["glucose", "acetate"],
+        shape=shape,
+        size=size,
+        diffusion=c["diffusion"],
+        initial=c["initial"],
+        timestep=c["timestep"],
+    )
+
+    def _species(compartment: Compartment, capacity: int, mols):
+        colony = Colony(
+            compartment,
+            capacity=capacity,
+            division_trigger=("global", "divide") if c["division"] else None,
+        )
+        return SpatialColony(
+            colony,
+            lattice,
+            field_ports={
+                mol: (
+                    ("boundary", "external", mol),
+                    ("boundary", "exchange", f"{mol}_exchange"),
+                )
+                for mol in mols
+            },
+            location_path=("boundary", "location"),
+        )
+
+    e = c["ecoli"]
+    ecoli = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport(e["transport"]),
+            "growth": Growth(e["growth"]),
+            "divide_trigger": DivideTrigger(e["divide"]),
+            "motility": BrownianMotility(e["motility"]),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+            "motility": {"boundary": ("boundary",)},
+        },
+    )
+    s = c["scavenger"]
+    scavenger = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport(s["transport"]),
+            "expression": StochasticExpression(s["expression"]),
+            "growth": Growth(s["growth"]),
+            "divide_trigger": DivideTrigger(s["divide"]),
+            "motility": BrownianMotility(s["motility"]),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "expression": {"counts": ("counts",), "rates": ("rates",)},
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+            "motility": {"boundary": ("boundary",)},
+        },
+    )
+    multi = MultiSpeciesColony(
+        species={
+            "ecoli": _species(ecoli, int(c["capacity"]["ecoli"]), ["glucose"]),
+            "scavenger": _species(
+                scavenger, int(c["capacity"]["scavenger"]), ["acetate"]
+            ),
+        },
+        lattice=lattice,
+    )
+    return multi, {"ecoli": ecoli, "scavenger": scavenger}
+
+
+@register_composite
 def ecoli_lattice(
     config: Mapping | None = None,
 ) -> Tuple[SpatialColony, Compartment]:

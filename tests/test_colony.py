@@ -100,6 +100,33 @@ def test_determinism_same_seed():
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+def test_initial_state_is_strong_typed_no_recompile():
+    """Initial states must have the SAME aval signature as evolved states
+    (no weak-typed leaves): the round-1 benches were silently recompiling
+    the whole window on their first post-warm-up call — config 3's
+    "throughput" was ~3.5k/s of compile time against a real ~10M/s."""
+    from lens_tpu.models.composites import minimal_wcecoli
+
+    comp = minimal_wcecoli({})
+    colony = Colony(comp, 64, division_trigger=("global", "divide"))
+    st = colony.initial_state(
+        16, key=jax.random.PRNGKey(0),
+        overrides={"metabolites": {"glc": 50.0}},
+    )
+    for path, leaf in jax.tree_util.tree_leaves_with_path(st):
+        assert not getattr(leaf, "weak_type", False), path
+
+    step = jax.jit(lambda s: colony.step(s, 1.0))
+    out = step(st)
+    sig = lambda tree: [
+        (l.shape, l.dtype, getattr(l, "weak_type", False))
+        for l in jax.tree.leaves(tree)
+    ]
+    assert sig(out) == sig(st)
+    jax.block_until_ready(step(out))
+    assert step._cache_size() == 1, "evolved state forced a recompile"
+
+
 def test_emit_reports_division_backlog_at_capacity():
     """A full colony suppresses divisions; the emit slice must say so
     (saturation telemetry — critical on sharded colonies whose per-shard
