@@ -95,13 +95,62 @@ def _div_zero(value: Array, key: Array) -> Tuple[Array, Array]:
     return z, z
 
 
+# Exact CDF inversion handles counts up to this; above it the clipped
+# normal approximation's bias is < 1e-3 of a count and undetectable.
+_BINOMIAL_EXACT_MAX = 64
+
+
+def _binomial_half(key: Array, n: Array) -> Array:
+    """Draw Binomial(n, 1/2), exactly for n <= _BINOMIAL_EXACT_MAX.
+
+    Hand-rolled instead of ``jax.random.binomial`` because that sampler's
+    internal ``while_loop`` seeds its carry with replicated scalar
+    constants while the body outputs shard-varying values, so it fails
+    shard_map's varying-manual-axes check — division inside the sharded
+    colony runners (parallel.runner / parallel.multispecies) would not
+    trace. Here every loop carry derives from ``n``/``u`` (varying where
+    the inputs are), which is VMA-safe, and the fixed-trip ``fori_loop``
+    is also friendlier to XLA than rejection sampling.
+
+    Exact branch: CDF inversion with the p=1/2 pmf recurrence
+    pmf(k+1) = pmf(k) * (n-k)/(k+1); smallest k with CDF(k) >= u is an
+    exact draw. Above the cutoff: round(n/2 + sqrt(n)/2 * z) clipped to
+    [0, n].
+    """
+    n = jnp.asarray(n, jnp.float32)
+    ku, kz = jax.random.split(key)
+    u = jax.random.uniform(ku, jnp.shape(n))
+    n_small = jnp.minimum(n, float(_BINOMIAL_EXACT_MAX))
+    pmf0 = jnp.exp2(-n_small)
+
+    def body(k, carry):
+        cdf, pmf, res = carry
+        kf = jnp.float32(k)
+        cdf = cdf + pmf
+        hit = (cdf >= u) & (res < 0.0)
+        res = jnp.where(hit, kf, res)
+        pmf = pmf * (n_small - kf) / (kf + 1.0)
+        return cdf, pmf, res
+
+    res0 = jnp.full_like(n, -1.0)
+    exact = jax.lax.fori_loop(
+        0, _BINOMIAL_EXACT_MAX + 1, body,
+        (jnp.zeros_like(n), pmf0, res0),
+    )[2]
+    # float roundoff can leave CDF(n) a hair under u: land on n
+    exact = jnp.where(exact < 0.0, n_small, exact)
+    z = jax.random.normal(kz, jnp.shape(n))
+    approx = jnp.clip(jnp.round(0.5 * n + 0.5 * jnp.sqrt(n) * z), 0.0, n)
+    return jnp.where(n <= float(_BINOMIAL_EXACT_MAX), exact, approx)
+
+
 def _div_binomial(value: Array, key: Array) -> Tuple[Array, Array]:
     # Integer-valued molecule counts partition binomially between daughters.
     # Exact Binomial(n, 0.5) draw — this divider exists for small-count
     # molecules (plasmids, transcription factors) where the clipped-normal
     # approximation is visibly biased below n ~ 20.
     n = jnp.maximum(jnp.asarray(value, jnp.float32), 0.0)
-    a = jax.random.binomial(key, n, 0.5, shape=jnp.shape(value))
+    a = _binomial_half(key, n)
     return a.astype(value.dtype), (n - a).astype(value.dtype)
 
 

@@ -12,19 +12,27 @@ data.
 from lens_tpu.models.composites import (
     composite_registry,
     register_composite,
+    chemotaxis_lattice,
     ecoli_lattice,
     grow_divide,
     hybrid_cell,
     minimal_ode,
+    minimal_wcecoli,
+    mixed_species_lattice,
+    rfba_lattice,
     toggle_colony,
 )
 
 __all__ = [
     "composite_registry",
     "register_composite",
+    "chemotaxis_lattice",
     "ecoli_lattice",
     "grow_divide",
     "hybrid_cell",
     "minimal_ode",
+    "minimal_wcecoli",
+    "mixed_species_lattice",
+    "rfba_lattice",
     "toggle_colony",
 ]

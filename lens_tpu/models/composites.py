@@ -451,26 +451,30 @@ def mixed_species_lattice(
         },
     )
     s = c["scavenger"]
-    scavenger = Compartment(
-        processes={
-            "transport": MichaelisMentenTransport(s["transport"]),
-            "expression": StochasticExpression(s["expression"]),
-            "growth": Growth(s["growth"]),
-            "divide_trigger": DivideTrigger(s["divide"]),
-            "motility": BrownianMotility(s["motility"]),
+    # "expression": None drops the (stochastic) expression process — a
+    # fully deterministic scavenger for equality tests / dry runs.
+    scav_procs = {"transport": MichaelisMentenTransport(s["transport"])}
+    scav_topo = {
+        "transport": {
+            "external": ("boundary", "external"),
+            "internal": ("cell",),
+            "exchange": ("boundary", "exchange"),
         },
-        topology={
-            "transport": {
-                "external": ("boundary", "external"),
-                "internal": ("cell",),
-                "exchange": ("boundary", "exchange"),
-            },
-            "expression": {"counts": ("counts",), "rates": ("rates",)},
-            "growth": {"global": ("global",)},
-            "divide_trigger": {"global": ("global",)},
-            "motility": {"boundary": ("boundary",)},
-        },
+    }
+    if s["expression"] is not None:
+        scav_procs["expression"] = StochasticExpression(s["expression"])
+        scav_topo["expression"] = {"counts": ("counts",), "rates": ("rates",)}
+    scav_procs.update(
+        growth=Growth(s["growth"]),
+        divide_trigger=DivideTrigger(s["divide"]),
+        motility=BrownianMotility(s["motility"]),
     )
+    scav_topo.update(
+        growth={"global": ("global",)},
+        divide_trigger={"global": ("global",)},
+        motility={"boundary": ("boundary",)},
+    )
+    scavenger = Compartment(processes=scav_procs, topology=scav_topo)
     multi = MultiSpeciesColony(
         species={
             "ecoli": _species(ecoli, int(c["capacity"]["ecoli"]), ["glucose"]),

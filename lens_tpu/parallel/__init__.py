@@ -20,10 +20,12 @@ from lens_tpu.parallel.mesh import (
     colony_pspecs,
     make_mesh,
     mesh_shardings,
+    multispecies_pspecs,
     spatial_pspecs,
 )
 from lens_tpu.parallel.halo import diffuse_halo
 from lens_tpu.parallel.runner import ShardedSpatialColony
+from lens_tpu.parallel.multispecies import ShardedMultiSpeciesColony
 from lens_tpu.parallel.distributed import (
     coordinator_only,
     distribute,
@@ -37,8 +39,10 @@ __all__ = [
     "mesh_shardings",
     "colony_pspecs",
     "spatial_pspecs",
+    "multispecies_pspecs",
     "diffuse_halo",
     "ShardedSpatialColony",
+    "ShardedMultiSpeciesColony",
     "initialize",
     "global_mesh",
     "distribute",

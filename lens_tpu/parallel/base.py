@@ -1,0 +1,104 @@
+"""Shared compile/cache machinery for the sharded colony runners.
+
+Both SPMD runners (``runner.ShardedSpatialColony``,
+``multispecies.ShardedMultiSpeciesColony``) wrap a per-device block
+program in ``shard_map`` + ``jit`` and cache the compiled step and run
+programs. That contract — timestep pinned to the lattice's precomputed
+diffusion substeps, one cached step, run programs cached per
+``(total_time, timestep, emit_every)`` — lives here once so the two
+runners cannot diverge.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+
+
+class ShardedRunnerBase:
+    """Subclasses provide:
+
+    - ``self.mesh``: the 2D (agents x space) mesh;
+    - ``_lattice()``: the shared :class:`~lens_tpu.environment.lattice.Lattice`
+      (timestep guard);
+    - ``_pspecs(example)``: PartitionSpecs pytree for ``example`` states;
+    - ``_block_step(state, timestep)``: the per-device program;
+    - ``_emit_fn(carry)``: the emit slice for ``run``.
+    """
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._step = None
+        self._step_dt = None
+        self._run_cache = {}
+
+    # subclass hooks ---------------------------------------------------------
+
+    def _lattice(self):
+        raise NotImplementedError
+
+    def _pspecs(self, example):
+        raise NotImplementedError
+
+    def _block_step(self, state, timestep: float):
+        raise NotImplementedError
+
+    def _emit_fn(self, carry) -> dict:
+        raise NotImplementedError
+
+    # shared machinery -------------------------------------------------------
+
+    def step_fn(self, example, timestep: float):
+        """Build the jitted shard_map step for states shaped like
+        ``example``."""
+        lattice = self._lattice()
+        if abs(timestep - lattice.timestep) > 1e-9:
+            raise ValueError(
+                f"timestep={timestep} != lattice.timestep="
+                f"{lattice.timestep}: the lattice precomputes its "
+                f"diffusion substeps — construct it with the run timestep"
+            )
+        specs = self._pspecs(example)
+        body = jax.shard_map(
+            partial(self._block_step, timestep=timestep),
+            mesh=self.mesh,
+            in_specs=(specs,),
+            out_specs=specs,
+        )
+        return jax.jit(body)
+
+    def _cached_step(self, example, timestep: float):
+        if self._step is None:
+            self._step = self.step_fn(example, timestep)
+            self._step_dt = timestep
+        elif self._step_dt != timestep:
+            raise ValueError(
+                "timestep changed between calls; rebuild via step_fn"
+            )
+        return self._step
+
+    def step(self, state, timestep: float):
+        return self._cached_step(state, timestep)(state)
+
+    def run(
+        self, state, total_time: float, timestep: float, emit_every: int = 1
+    ) -> Tuple[object, dict]:
+        """Scan the sharded step; emit slices keep the sharded layout (no
+        host round-trips inside the loop). Compiled programs cached per
+        ``(total_time, timestep, emit_every)``, sharing the cached step
+        with ``step()``."""
+        from lens_tpu.core.schedule import scan_schedule
+
+        step = self._cached_step(state, timestep)
+        cache_key = (total_time, timestep, emit_every)
+        run = self._run_cache.get(cache_key)
+        if run is None:
+            run = jax.jit(
+                lambda s: scan_schedule(
+                    step, self._emit_fn, s, total_time, timestep, emit_every
+                )
+            )
+            self._run_cache[cache_key] = run
+        return run(state)

@@ -77,6 +77,19 @@ def spatial_pspecs(spatial_state) -> "jax.tree_util.PyTreeDef":
     )
 
 
+def multispecies_pspecs(ms_state) -> "jax.tree_util.PyTreeDef":
+    """PartitionSpecs for a MultiSpeciesState: every species' ColonyState
+    split on the agent axis (each species' rows are their own block per
+    device — capacities need not match across species), shared fields
+    [M, H, W] split along H on the space axis."""
+    return type(ms_state)(
+        species={
+            name: colony_pspecs(cs) for name, cs in ms_state.species.items()
+        },
+        fields=P(None, SPACE_AXIS, None),
+    )
+
+
 def mesh_shardings(mesh: Mesh, pspecs):
     """Turn a pytree of PartitionSpecs into NamedShardings on ``mesh``."""
     return jax.tree.map(

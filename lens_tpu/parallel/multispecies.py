@@ -1,0 +1,247 @@
+"""ShardedMultiSpeciesColony: the mixed-species flagship on a device mesh.
+
+The single-species SPMD step (``parallel.runner.ShardedSpatialColony``)
+shards one colony's agent axis; the north-star scenario (BASELINE.json
+config 4 — a 100k-cell mixed colony) is a ``MultiSpeciesColony``: N
+species with DISTINCT process sets coupled through ONE lattice
+(``environment.multispecies``). This module gives that colony the same
+explicit-collective layout (SURVEY.md §2 parallelism table — agent-axis
+sharding is mandated for *all* colony forms):
+
+- every species' agent axis is split over the ``agents`` mesh axis —
+  each device holds one block of rows of EVERY species, so each species'
+  biology stays one clean per-block ``vmap`` (no schema union, no masked
+  FLOPs — the same property the unsharded design was chosen for);
+- the shared fields strip is split over the ``space`` axis exactly as in
+  the single-species runner (``all_gather``-style psum assembly,
+  ``ppermute`` diffusion halos);
+- the cross-species couplings are the two reductions the unsharded step
+  does in HBM: **combined occupancy** (sum over species, then ``psum``
+  over the agent axis) and the **combined exchange delta** (one
+  scatter-add canvas summed over species and shards, one ``>= 0`` clamp)
+  — so shared-bin mass conservation spans species AND shards.
+
+Division stays per species per shard (each species-block has its own
+free-row pool), mirroring the single-species runner's design decision;
+the ``division_backlog`` emit makes per-shard saturation observable.
+
+PRNG discipline matches the runner: each species' stored key advances
+identically on every shard; stochastic draws fold in the shard's
+``axis_index`` so shards sample independent streams.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from lens_tpu.colony.colony import ColonyState
+from lens_tpu.environment.multispecies import (
+    MultiSpeciesColony,
+    MultiSpeciesState,
+)
+from lens_tpu.parallel.base import ShardedRunnerBase
+from lens_tpu.parallel.mesh import (
+    AGENTS_AXIS,
+    SPACE_AXIS,
+    multispecies_pspecs,
+    validate_divisible,
+)
+from lens_tpu.utils.dicts import get_path, set_path
+
+
+class ShardedMultiSpeciesColony(ShardedRunnerBase):
+    """Wraps a MultiSpeciesColony with a mesh-sharded step/run.
+
+    The wrapped ``multi`` provides all wiring (per-species field ports,
+    location paths, share_bins) and the per-block biology; this class
+    owns only the collectives. Deterministic composites produce
+    trajectories equal to the unsharded path (tested); stochastic
+    composites draw per-shard streams, so trajectories differ from
+    unsharded by PRNG layout only.
+    """
+
+    def __init__(self, multi: MultiSpeciesColony, mesh: Mesh):
+        for name, sp in multi.species.items():
+            try:
+                validate_divisible(
+                    sp.colony.capacity, multi.lattice.shape[0], mesh
+                )
+            except ValueError as e:
+                raise ValueError(f"species {name!r}: {e}") from None
+        super().__init__(mesh)
+        self.multi = multi
+        self.n_space = mesh.shape[SPACE_AXIS]
+
+    # -- construction --------------------------------------------------------
+
+    def initial_state(self, n_alive, key, **kwargs) -> MultiSpeciesState:
+        """Build on host, then place per the mesh layout (multi-host safe
+        via :func:`parallel.distributed.distribute`)."""
+        from lens_tpu.parallel.distributed import distribute
+
+        ms = self.multi.initial_state(n_alive, key, **kwargs)
+        return distribute(ms, self.mesh, multispecies_pspecs(ms))
+
+    # -- the SPMD step -------------------------------------------------------
+
+    def _block_step(
+        self, ms: MultiSpeciesState, timestep: float
+    ) -> MultiSpeciesState:
+        """Per-device block program (runs inside shard_map). Mirrors
+        ``MultiSpeciesColony.step`` stage for stage; every cross-device
+        movement is an explicit collective."""
+        multi, lattice = self.multi, self.multi.lattice
+        strip = ms.fields
+        a_idx = lax.axis_index(AGENTS_AXIS)
+        s_idx = lax.axis_index(SPACE_AXIS)
+        m, h_local, w = strip.shape
+        h_full = h_local * self.n_space
+
+        # Assemble the full field: strip -> zero canvas -> psum over the
+        # space axis (an all-gather in psum clothing; psum lets the VMA
+        # checker prove the result is space-invariant).
+        full_fields = lax.psum(
+            lax.dynamic_update_slice_in_dim(
+                jnp.zeros((m, h_full, w), strip.dtype),
+                strip, s_idx * h_local, axis=1,
+            ),
+            SPACE_AXIS,
+        )  # [M, H, W]
+
+        bins: Dict[str, tuple] = {}
+        for name, sp in multi.species.items():
+            cs = ms.species[name]
+            locs = get_path(cs.agents, sp.location_path)
+            bins[name] = lattice.bin_of(locs)
+
+        # Cross-species combined occupancy: sum this block's live cells of
+        # EVERY species per bin, then psum over agent shards -> the same
+        # global [H, W] occupancy the unsharded step computes in HBM.
+        occ = None
+        if multi.share_bins:
+            occ_block = jnp.zeros(lattice.shape, jnp.float32)
+            for name, sp in multi.species.items():
+                cs = ms.species[name]
+                locs = get_path(cs.agents, sp.location_path)
+                occ_block = occ_block + lattice.occupancy(locs, cs.alive)
+            occ = lax.psum(occ_block, AGENTS_AXIS)
+
+        # 1. gather per species (consuming ports see the ALL-species
+        # shared concentration; sense-only ports see the raw bin value —
+        # same split as environment.spatial step 1)
+        stepped: Dict[str, ColonyState] = {}
+        for name, sp in multi.species.items():
+            cs = ms.species[name]
+            i, j = bins[name]
+            local_raw = full_fields[:, i, j].T  # [rows, M]
+            local_shared = local_raw
+            if multi.share_bins:
+                local_shared = local_raw / (
+                    jnp.maximum(occ[i, j], 1.0)[:, None]
+                    * lattice.exchange_scale
+                )
+            agents = cs.agents
+            for mol, port in sp.field_ports.items():
+                local = local_raw if port.exchange is None else local_shared
+                col = local[:, lattice.index(mol)]
+                prev = get_path(agents, port.local)
+                agents = set_path(
+                    agents, port.local, jnp.where(cs.alive, col, prev)
+                )
+            stepped[name] = cs._replace(agents=agents)
+
+        # 2. biology per species — one vmap per process set per block;
+        # stochastic draws fold in the shard id, stored key unchanged
+        for name, sp in multi.species.items():
+            cs = stepped[name]
+            shard_key = jax.random.fold_in(cs.key, a_idx)
+            cs = sp.colony.step_biology(
+                cs._replace(key=shard_key), timestep
+            )
+            stepped[name] = cs._replace(key=stepped[name].key)
+
+        # 3. scatter ALL species' exchanges into the PRE-STEP bins: one
+        # combined full-canvas delta, psum over agent shards, ONE clamp
+        delta = jnp.zeros_like(full_fields)
+        for name, sp in multi.species.items():
+            cs = stepped[name]
+            agents = cs.agents
+            rows = cs.alive.shape[0]
+            exchange = jnp.stack(
+                [
+                    get_path(agents, sp.field_ports[mol].exchange)
+                    if mol in sp.field_ports
+                    and sp.field_ports[mol].exchange is not None
+                    else jnp.zeros(rows)
+                    for mol in lattice.molecules
+                ],
+                axis=1,
+            )  # [rows, M]
+            i, j = bins[name]
+            contrib = exchange * cs.alive[:, None] * lattice.exchange_scale
+            delta = delta.at[:, i, j].add(contrib.T)
+            for mol, port in sp.field_ports.items():
+                if port.exchange is None:
+                    continue
+                agents = set_path(
+                    agents, port.exchange,
+                    jnp.zeros_like(get_path(agents, port.exchange)),
+                )
+            stepped[name] = cs._replace(agents=agents)
+        delta = lax.psum(delta, AGENTS_AXIS)
+        strip = jnp.maximum(
+            strip
+            + lax.dynamic_slice_in_dim(delta, s_idx * h_local, h_local, axis=1),
+            0.0,
+        )
+
+        # 4. per-shard division per species, then clip onto the domain
+        h, w_um = lattice.size
+        for name, sp in multi.species.items():
+            cs = stepped[name]
+            if sp.colony.division_trigger is not None:
+                key, sub = jax.random.split(cs.key)
+                sub = jax.random.fold_in(sub, a_idx)
+                d_agents, d_alive = sp.colony._divide(
+                    cs.agents, cs.alive, sub
+                )
+                cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
+            agents = cs.agents
+            loc = get_path(agents, sp.location_path)
+            loc = jnp.clip(
+                loc, jnp.zeros(2, loc.dtype),
+                jnp.asarray([h, w_um], loc.dtype) - 1e-3,
+            )
+            stepped[name] = cs._replace(
+                agents=set_path(agents, sp.location_path, loc),
+                step=cs.step + 1,
+            )
+
+        # 5. diffusion on the strip with ppermute halos, once
+        from lens_tpu.parallel.halo import diffuse_halo
+
+        strip = diffuse_halo(
+            strip, lattice.alpha, lattice.n_substeps, SPACE_AXIS, self.n_space
+        )
+        return MultiSpeciesState(species=stepped, fields=strip)
+
+    # -- ShardedRunnerBase hooks --------------------------------------------
+
+    def _lattice(self):
+        return self.multi.lattice
+
+    def _pspecs(self, example: MultiSpeciesState):
+        return multispecies_pspecs(example)
+
+    def _emit_fn(self, carry: MultiSpeciesState) -> dict:
+        emit = {
+            name: sp.colony.emit(carry.species[name])
+            for name, sp in self.multi.species.items()
+        }
+        emit["fields"] = carry.fields
+        return emit

@@ -22,16 +22,13 @@ streams while the stored key advances identically everywhere.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from lens_tpu.core.schedule import scan_schedule
 from lens_tpu.environment.spatial import SpatialColony, SpatialState
+from lens_tpu.parallel.base import ShardedRunnerBase
 from lens_tpu.parallel.mesh import (
     AGENTS_AXIS,
     SPACE_AXIS,
@@ -42,7 +39,7 @@ from lens_tpu.parallel.mesh import (
 from lens_tpu.utils.dicts import get_path, set_path
 
 
-class ShardedSpatialColony:
+class ShardedSpatialColony(ShardedRunnerBase):
     """Wraps a SpatialColony with a mesh-sharded step/run.
 
     The wrapped ``spatial`` provides all wiring (field ports, location
@@ -56,12 +53,9 @@ class ShardedSpatialColony:
         validate_divisible(
             spatial.colony.capacity, spatial.lattice.shape[0], mesh
         )
+        super().__init__(mesh)
         self.spatial = spatial
-        self.mesh = mesh
         self.n_space = mesh.shape[SPACE_AXIS]
-        self._step = None      # built lazily (needs an example state's pspecs)
-        self._step_dt = None
-        self._run_cache = {}   # (total_time, timestep, emit_every) -> jitted run
 
     # -- construction --------------------------------------------------------
 
@@ -184,59 +178,15 @@ class ShardedSpatialColony:
         )
         return SpatialState(colony=cs, fields=strip)
 
-    def step_fn(self, example: SpatialState, timestep: float):
-        """Build the jitted shard_map step for states shaped like ``example``."""
-        if abs(timestep - self.spatial.lattice.timestep) > 1e-9:
-            raise ValueError(
-                f"timestep={timestep} != lattice.timestep="
-                f"{self.spatial.lattice.timestep}: the lattice precomputes "
-                f"its diffusion substeps — construct it with the run timestep"
-            )
-        specs = spatial_pspecs(example)
-        body = jax.shard_map(
-            partial(self._block_step, timestep=timestep),
-            mesh=self.mesh,
-            in_specs=(specs,),
-            out_specs=specs,
-        )
-        return jax.jit(body)
+    # -- ShardedRunnerBase hooks --------------------------------------------
 
-    def _cached_step(self, ss: SpatialState, timestep: float):
-        if self._step is None:
-            self._step = self.step_fn(ss, timestep)
-            self._step_dt = timestep
-        elif self._step_dt != timestep:
-            raise ValueError("timestep changed between calls; rebuild via step_fn")
-        return self._step
+    def _lattice(self):
+        return self.spatial.lattice
 
-    def step(self, ss: SpatialState, timestep: float) -> SpatialState:
-        return self._cached_step(ss, timestep)(ss)
+    def _pspecs(self, example: SpatialState):
+        return spatial_pspecs(example)
 
-    def run(
-        self,
-        ss: SpatialState,
-        total_time: float,
-        timestep: float,
-        emit_every: int = 1,
-    ) -> Tuple[SpatialState, dict]:
-        """Scan the sharded step; emits slice the sharded state directly
-        (XLA propagates the layout — no host round-trips inside the loop).
-        Compiled programs are cached per (total_time, timestep, emit_every),
-        sharing the cached step with ``step()``."""
-        step = self._cached_step(ss, timestep)
-        cache_key = (total_time, timestep, emit_every)
-        run = self._run_cache.get(cache_key)
-        if run is None:
-
-            def emit_fn(carry):
-                emit = self.spatial.colony.emit(carry.colony)
-                emit["fields"] = carry.fields
-                return emit
-
-            run = jax.jit(
-                lambda s: scan_schedule(
-                    step, emit_fn, s, total_time, timestep, emit_every
-                )
-            )
-            self._run_cache[cache_key] = run
-        return run(ss)
+    def _emit_fn(self, carry: SpatialState) -> dict:
+        emit = self.spatial.colony.emit(carry.colony)
+        emit["fields"] = carry.fields
+        return emit

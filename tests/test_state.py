@@ -92,6 +92,34 @@ def test_divide_binomial_small_counts_exact():
     assert abs(ones - trials / 2) < 50, ones
 
 
+def test_binomial_half_distribution():
+    """The hand-rolled VMA-safe sampler (core.state._binomial_half) is a
+    true Binomial(n, 1/2): check the full pmf at n=6 against exact
+    probabilities, and mean/variance in the normal-approximation regime."""
+    import numpy as np
+    from scipy import stats
+
+    from lens_tpu.core.state import _binomial_half
+
+    keys = jax.random.split(jax.random.PRNGKey(42), 4000)
+    draws = jax.vmap(
+        lambda k: _binomial_half(k, jnp.float32(6.0))
+    )(keys)
+    counts = np.bincount(np.asarray(draws, np.int64), minlength=7)
+    expected = stats.binom.pmf(np.arange(7), 6, 0.5) * 4000
+    chi2 = float(((counts - expected) ** 2 / expected).sum())
+    # 6 dof; P(chi2 > 22.5) ~ 0.1%
+    assert chi2 < 22.5, (chi2, counts)
+
+    big = jax.vmap(
+        lambda k: _binomial_half(k, jnp.float32(10000.0))
+    )(keys)
+    big = np.asarray(big)
+    assert abs(big.mean() - 5000.0) < 4 * 50.0 / np.sqrt(4000)
+    assert abs(big.std() - 50.0) < 5.0
+    np.testing.assert_allclose(big, np.round(big))  # integral
+
+
 def test_divide_offset_separates_locations():
     from lens_tpu.core.state import DIVISION_SEPARATION_UM
 
