@@ -136,6 +136,41 @@ def config_3():
     }
 
 
+def config_3b():
+    """Config 3 at reference model scale: each agent solves the
+    24-metabolite x 35-reaction ecoli_core regulated-FBA LP AND steps a
+    32-gene stochastic expression model, every second, with division."""
+    import jax
+
+    from lens_tpu.models.composites import rfba_lattice
+
+    n = 1024
+    spatial, _ = rfba_lattice(
+        {
+            "capacity": n,
+            "shape": (64, 64),
+            "metabolism": {"network": "ecoli_core"},
+            "expression": {"genes": "ecoli_core"},
+        }
+    )
+
+    def build():
+        state = spatial.initial_state(n, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": "3b",
+        "scenario": "1k agents, ecoli_core rFBA LP (24x35, 60-iter IPM) + "
+        "32-gene expression per agent per step, 64x64 lattice, division",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
 def config_4():
     """100k-cell MIXED-SPECIES colony: two distinct process sets (ODE
     kinetics vs hybrid Gillespie+ODE) on one 256x256 two-molecule lattice
@@ -171,7 +206,51 @@ def config_4():
     }
 
 
-CONFIGS = {0: config_0, 1: config_1, 2: config_2, 3: config_3, 4: config_4}
+def config_2e():
+    """Config 2 with DENSE emission: every step's emit slice is produced
+    and materialized (the reference's every-step MongoDB emit pattern,
+    SURVEY.md §3.5). The window returns the trajectory, so XLA cannot
+    dead-code-eliminate the emit work; the gap to config 2 is the
+    emission cost."""
+    import jax
+
+    from lens_tpu.models.composites import ecoli_lattice
+
+    n = 10240
+    spatial, _ = ecoli_lattice({"capacity": n})
+
+    def build():
+        state = spatial.initial_state(n, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, WINDOW_S, 1.0, emit_every=1)
+        )
+        return state, window
+
+    import time
+
+    state, window = build()
+    state, traj = jax.block_until_ready(window(state))  # warm-up
+    t0 = time.perf_counter()
+    jax.block_until_ready(window(state))
+    elapsed = time.perf_counter() - t0
+    return {
+        "config": "2e",
+        "scenario": "config 2 with emit_every=1 (dense per-step emission, "
+        "trajectory materialized)",
+        "metric": "agent-steps/sec",
+        "value": round(n * WINDOW_S / elapsed, 1),
+    }
+
+
+CONFIGS = {
+    0: config_0,
+    1: config_1,
+    2: config_2,
+    "2e": config_2e,
+    3: config_3,
+    "3b": config_3b,
+    4: config_4,
+}
 
 
 def _probe_backend(timeout: float = 180.0) -> str | None:
@@ -207,7 +286,10 @@ def main() -> None:
 
     import jax
 
-    wanted = [int(a) for a in sys.argv[1:]] or sorted(CONFIGS)
+    def _key(a: str):
+        return int(a) if a.isdigit() else a
+
+    wanted = [_key(a) for a in sys.argv[1:]] or list(CONFIGS)
     report = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),

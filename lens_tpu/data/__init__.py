@@ -67,3 +67,86 @@ def load_tsv(name: str) -> List[Dict[str, Any]]:
 def load_table(name: str, key: str, value: str) -> Dict[Any, Any]:
     """Collapse a TSV into a {row[key]: row[value]} mapping."""
     return {row[key]: row[value] for row in load_tsv(name)}
+
+
+def _parse_terms(cell: Any) -> Dict[str, float]:
+    """Parse a `species:coeff species:coeff` cell into a dict."""
+    if cell is None:
+        return {}
+    out: Dict[str, float] = {}
+    for term in str(cell).split():
+        name, _, coeff = term.rpartition(":")
+        if not name:
+            raise ValueError(f"malformed stoichiometry term {term!r}")
+        out[name] = float(coeff)
+    return out
+
+
+def load_rfba_network(prefix: str = "ecoli_core") -> Dict[str, Any]:
+    """Load a regulated-FBA network from ``{prefix}_species.tsv`` +
+    ``{prefix}_reactions.tsv`` into the network-dict format
+    :class:`~lens_tpu.processes.fba_metabolism.FBAMetabolism` consumes.
+
+    This is the data-layer path for reference-scale metabolism (SURVEY.md
+    §2 "Data layer": reaction stoichiometries as flat files + loaders;
+    "Metabolism": Covert–Palsson 2002 lineage): the network is *content*,
+    not code — editing the TSV changes the model without touching any
+    Python. The species file fixes ordering (internal = steady-state LP
+    rows, external = lattice-coupled fields); each reaction row carries
+    stoichiometry, bounds, exchange coupling with Michaelis–Menten ``km``,
+    and a boolean regulation rule over external species.
+    """
+    internal: list = []
+    external: list = []
+    for row in load_tsv(f"{prefix}_species.tsv"):
+        kind = row.get("type")
+        if kind == "internal":
+            internal.append(row["species"])
+        elif kind == "external":
+            external.append(row["species"])
+        else:
+            raise ValueError(
+                f"species {row.get('species')!r}: type must be "
+                f"'internal' or 'external', got {kind!r}"
+            )
+    reactions: Dict[str, dict] = {}
+    objective = None
+    for row in load_tsv(f"{prefix}_reactions.tsv"):
+        name = row["reaction"]
+        stoich = _parse_terms(row.get("stoichiometry"))
+        bad = [s for s in stoich if s not in internal]
+        if bad:
+            raise ValueError(
+                f"reaction {name!r}: stoichiometry names non-internal "
+                f"species {bad}"
+            )
+        exchanges = _parse_terms(row.get("exchanges"))
+        bad = [s for s in exchanges if s not in external]
+        if bad:
+            raise ValueError(
+                f"reaction {name!r}: exchanges names non-external "
+                f"species {bad}"
+            )
+        reactions[name] = {
+            "stoich": stoich,
+            "bounds": (float(row["lb"]), float(row["ub"])),
+            "exchanges": exchanges,
+            # blank km cell -> the process default (0.5); an explicit 0
+            # in the TSV disables MM saturation for that import
+            "km": 0.5 if row.get("km") is None else float(row["km"]),
+            "rule": str(row["rule"]) if row.get("rule") else "",
+        }
+        if row.get("objective"):
+            if objective is not None:
+                raise ValueError(
+                    f"two objective reactions: {objective!r} and {name!r}"
+                )
+            objective = name
+    if objective is None:
+        raise ValueError(f"{prefix}: no reaction has objective=1")
+    return {
+        "internal": internal,
+        "external": external,
+        "reactions": reactions,
+        "objective": objective,
+    }

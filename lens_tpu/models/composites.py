@@ -321,12 +321,30 @@ def rfba_lattice(
             "initial": {"glc": 10.0, "ace": 0.0, "o2": 5.0},
             "timestep": 1.0,
             "metabolism": {},
+            "expression": None,
             "divide": {},
             "motility": {"sigma": 0.5},
             "division": True,
         },
         config,
     )
+    if c["metabolism"].get("network") == "ecoli_core":
+        # Reference-scale network: the loader supplies 7 external species;
+        # fill lattice defaults for the ones the small-network defaults
+        # don't name, and give the float32 LP the conditioning recipe it
+        # needs at this size (see FBAMetabolism.defaults["lp_leak"]).
+        c["metabolism"] = _cfg(
+            {"lp_leak": 1.5e-3, "lp_tol": 1e-4, "lp_iterations": 60},
+            c["metabolism"],
+        )
+        c["diffusion"] = _cfg(
+            {"lcts": 500.0, "nh4": 1800.0, "co2": 1900.0, "eth": 1200.0},
+            c["diffusion"],
+        )
+        c["initial"] = _cfg(
+            {"lcts": 0.0, "nh4": 5.0, "co2": 0.0, "eth": 0.0},
+            c["initial"],
+        )
     metabolism = FBAMetabolism(c["metabolism"])
     processes = {
         "metabolism": metabolism,
@@ -345,6 +363,36 @@ def rfba_lattice(
         "divide_trigger": {"global": ("global",)},
         "motility": {"boundary": ("boundary",)},
     }
+    if c.get("expression") is not None:
+        # Metabolism + transcription in one compartment (config 3's
+        # composite shape): the gene table's regulation rules read the
+        # SAME boundary concentrations the LP's rules do, so e.g. the lac
+        # genes and the lcts_uptake reaction switch together.
+        from lens_tpu.processes.genome_expression import GenomeExpression
+
+        expr = GenomeExpression(c["expression"])
+        missing = [
+            mol for mol in expr.rule_species
+            if mol not in metabolism.external
+        ]
+        if missing:
+            raise ValueError(
+                f"expression rules read {missing}, not lattice molecules "
+                f"of this network ({list(metabolism.external)})"
+            )
+        # Shared boundary variables: declarations must agree (core.engine).
+        # external_defaults is only read by ports_schema (lazily), so the
+        # one constructed instance can be configured after the fact — the
+        # gene table is parsed and its rules compiled exactly once.
+        expr.config["external_defaults"] = {
+            mol: 10.0 for mol in expr.rule_species
+        }
+        processes["expression"] = expr
+        topology["expression"] = {
+            "counts": ("counts",),
+            "rates": ("rates",),
+            "external": ("boundary", "external"),
+        }
     compartment = Compartment(processes=processes, topology=topology)
     return _spatial_colony(
         compartment,

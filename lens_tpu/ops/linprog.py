@@ -238,6 +238,8 @@ def flux_balance(
     lb: jnp.ndarray,
     ub: jnp.ndarray,
     n_iter: int = 35,
+    tol: float = 1e-5,
+    leak: float = 0.0,
 ) -> LPResult:
     """FBA: ``max objective @ v  s.t.  S @ v = 0, lb <= v <= ub``.
 
@@ -248,13 +250,40 @@ def flux_balance(
     ``(lb, ub)`` (the network is static)::
 
         sol = jax.vmap(lambda l, u: flux_balance(S, obj, l, u))(lbs, ubs)
+
+    ``leak > 0`` relaxes each steady-state row to ``|S v| <= leak`` by
+    appending a zero-cost identity slack column per metabolite. This is a
+    float32-conditioning requirement for realistically sized regulated
+    networks, not a tuning knob: when regulation gates every reaction
+    touching some metabolite (e.g. the FADH2 row of a core-carbon network
+    under anaerobiosis), that row of the normal-equations matrix
+    ``A D A^T`` goes to zero as the barrier weights collapse, the float32
+    Cholesky breaks down, and the solve freezes unconverged. The slack
+    column guarantees each row a healthy pivot exactly when it is needed
+    — a metabolite whose reactions are all gated has a *valueless* slack,
+    which the barrier keeps interior (healthy d); valuable metabolites'
+    slacks saturate, but their rows have active reaction columns anyway.
+    The modeling cost is an O(leak) bias in fluxes/objective (a cell may
+    "find" up to ``leak`` of any metabolite per unit time). At the
+    default scale used by the FBA process (1.5e-3 vs O(1) fluxes) this is
+    far below biological parameter uncertainty; tests pin the bias
+    against a HiGHS oracle on the SAME relaxed problem.
     """
+    S = jnp.asarray(stoichiometry)
+    m, r = S.shape
+    c = -jnp.asarray(objective)
+    if leak > 0.0 and m:
+        S = jnp.concatenate([S, jnp.eye(m, dtype=S.dtype)], axis=1)
+        c = jnp.concatenate([c, jnp.zeros(m, c.dtype)])
+        lb = jnp.concatenate([jnp.asarray(lb), jnp.full(m, -leak, S.dtype)])
+        ub = jnp.concatenate([jnp.asarray(ub), jnp.full(m, leak, S.dtype)])
     res = linprog_box(
-        -jnp.asarray(objective),
-        stoichiometry,
-        jnp.zeros(stoichiometry.shape[0], stoichiometry.dtype),
+        c,
+        S,
+        jnp.zeros(m, S.dtype),
         lb,
         ub,
         n_iter=n_iter,
+        tol=tol,
     )
-    return res._replace(objective=-res.objective)
+    return res._replace(objective=-res.objective, x=res.x[:r])

@@ -30,6 +30,9 @@ from lens_tpu.processes.mm_transport import (  # noqa: E402
 from lens_tpu.processes.stochastic_expression import (  # noqa: E402
     StochasticExpression,
 )
+from lens_tpu.processes.genome_expression import (  # noqa: E402
+    GenomeExpression,
+)
 from lens_tpu.processes.derivers import (  # noqa: E402
     DeriveConcentrations,
     DeriveVolume,
@@ -61,6 +64,7 @@ __all__ = [
     "MichaelisMentenTransport",
     "BrownianMotility",
     "StochasticExpression",
+    "GenomeExpression",
     "DeriveConcentrations",
     "DeriveVolume",
     "DivideCondition",

@@ -137,6 +137,10 @@ class FBAMetabolism(Process):
     name = "fba_metabolism"
 
     defaults = {
+        # A network dict (CORE_RFBA_NETWORK's shape) or the name of a
+        # packaged network, loaded via data.load_rfba_network (e.g.
+        # "ecoli_core" — the 24-metabolite x 35-reaction Covert–Palsson
+        # -style network in lens_tpu/data/ecoli_core_reactions.tsv).
         "network": CORE_RFBA_NETWORK,
         # fg mass per unit biomass flux·s. Calibration: aerobic glucose
         # growth solves at v_bio ~ 0.8, so dm/dt ~ 0.24 fg/s doubles a
@@ -145,6 +149,14 @@ class FBAMetabolism(Process):
         "mass_yield": 0.3,
         "regulation_threshold": 0.05,  # mM presence threshold for rules
         "lp_iterations": 30,
+        "lp_tol": 1e-5,
+        # Steady-state leak relaxation (ops.linprog.flux_balance): 0 keeps
+        # S v = 0 exact — fine for small networks. Reference-scale
+        # regulated networks NEED ~1.5e-3 for the float32 solve to stay
+        # conditioned when regulation gates whole metabolite rows (see
+        # flux_balance docstring); pair with lp_tol=1e-4, lp_iterations
+        # ~60 (what the `rfba_lattice` composite sets for "ecoli_core").
+        "lp_leak": 0.0,
         # Exchange accounting happens in environment units; uptake is also
         # capped so one window cannot import more than is locally present.
         "uptake_cap_fraction": 0.9,
@@ -153,6 +165,10 @@ class FBAMetabolism(Process):
     def __init__(self, config=None):
         super().__init__(config)
         net = self.config["network"]
+        if isinstance(net, str):
+            from lens_tpu.data import load_rfba_network
+
+            net = load_rfba_network(net)
         self.internal: Tuple[str, ...] = tuple(net["internal"])
         self.external: Tuple[str, ...] = tuple(net["external"])
         self.reactions: Tuple[str, ...] = tuple(net["reactions"])
@@ -176,12 +192,21 @@ class FBAMetabolism(Process):
             for s, coeff in rxn["stoich"].items():
                 stoich[i_index[s], j] = coeff
             lb[j], ub[j] = rxn["bounds"]
+            # Exchange coupling: either an `exchanges` dict (the data-layer
+            # form; several species per reaction, fractional coefficients
+            # like o2:0.5 for lumped oxphos) or the legacy single
+            # `exchange` + `exchange_stoich` pair.
+            pairs = dict(rxn.get("exchanges") or {})
             mol = rxn.get("exchange")
             if mol is not None:
+                pairs[mol] = rxn.get("exchange_stoich", 1.0)
+            for mol, coeff in pairs.items():
                 e = self.external.index(mol)
-                exchange[e, j] = rxn.get("exchange_stoich", 1.0)
-                if exchange[e, j] > 0:  # an import: env-limited
+                exchange[e, j] = coeff
+                if coeff > 0:  # an import: env-limited
                     uptake_mask[j] = True
+                    # km=0 is meaningful (disables MM saturation):
+                    # honor an explicit zero, default only a MISSING key
                     kms[j] = rxn.get("km", 0.5)
             rule = rxn.get("rule", "")
             if rule:
@@ -271,9 +296,14 @@ class FBAMetabolism(Process):
 
     # -- dynamics -------------------------------------------------------------
 
-    def next_update(self, timestep, states):
-        ext = jnp.stack([states["external"][mol] for mol in self.external])
+    def regulated_bounds(self, ext, timestep):
+        """The per-agent LP box: regulation gates + environment limits.
 
+        ``ext``: [n_external] local concentrations in ``self.external``
+        order. Returns ``(lb, ub)`` — exactly the bounds ``next_update``
+        hands the LP, exposed so oracle tests can re-solve the identical
+        problem.
+        """
         # 1. Boolean regulation gates, computed first: the availability cap
         # below splits each species among its ACTIVE importers only.
         env = {mol: ext[e] for e, mol in enumerate(self.external)}
@@ -286,7 +316,8 @@ class FBAMetabolism(Process):
         # cap so dt * SUMMED uptake per species never exceeds the locally
         # available amount — each active importer gets an equal share.
         # Default network: one importer per species, coeff 1 — identical to
-        # a per-reaction cap.
+        # a per-reaction cap. (A reaction importing SEVERAL species — none
+        # packaged — would saturate on their summed concentration.)
         ext_of_rxn = self._import_indicator.T @ ext  # [R] raw species conc
         saturation = ext_of_rxn / (self.kms + ext_of_rxn + 1e-12)
         active = gate * self.uptake_mask                       # [R]
@@ -309,14 +340,21 @@ class FBAMetabolism(Process):
         # 3. Regulation clamps both bounds of gated reactions.
         lb = lb * gate
         ub = ub * gate
+        return lb, ub
 
-        # 4. The LP: max biomass s.t. S v = 0, lb <= v <= ub.
+    def next_update(self, timestep, states):
+        ext = jnp.stack([states["external"][mol] for mol in self.external])
+        lb, ub = self.regulated_bounds(ext, timestep)
+
+        # 4. The LP: max biomass s.t. S v = 0 (to lp_leak), lb <= v <= ub.
         sol = flux_balance(
             self.stoichiometry,
             self.objective,
             lb,
             ub,
             n_iter=self.config["lp_iterations"],
+            tol=self.config["lp_tol"],
+            leak=self.config["lp_leak"],
         )
         # A failed solve (infeasible bounds — e.g. maintenance cannot be
         # met) means no growth and no exchange, not garbage fluxes.

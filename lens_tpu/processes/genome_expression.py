@@ -1,0 +1,172 @@
+"""Genome-scale stochastic expression: a gene TABLE, one tau-leap.
+
+:mod:`~lens_tpu.processes.stochastic_expression` steps ONE gene; the
+reference's expression layer is a whole regulated gene complement driven
+from its flat-file knowledge base (reconstructed: SURVEY.md §2 "Gene
+expression processes" + "Data layer"). This process closes that scale
+gap the TPU way: all G genes' (mRNA, protein) counts are two ``[G]``
+vector leaves stepped by ONE tau-leap over a block-diagonal 4G-reaction
+network — per-agent cost is a fixed [4G, 2G] matmul, which ``vmap``
+batches across the colony onto the MXU.
+
+Regulation couples transcription to the environment: each gene may carry
+a boolean rule over EXTERNAL species (``utils.regulation_logic``, same
+grammar as the rFBA reaction rules), and a false rule gates that gene's
+transcription propensity to zero — the lac operon reads the same
+glucose/lactose fields the metabolism LP does.
+
+Gene complement comes from the data layer: ``genes="ecoli_core"`` loads
+``data/ecoli_core_genes.tsv`` (32 genes, the enzymes of the ecoli_core
+rFBA network). Rates are schema state (``_updater: null``), so per-agent
+overrides still work as in the one-gene process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.core.process import Process
+from lens_tpu.ops.gillespie import tau_leap_window
+from lens_tpu.processes import register
+from lens_tpu.utils.regulation_logic import compile_rule
+
+#: per-gene reaction block [4, 2]; species order (mRNA, protein)
+_BLOCK = np.asarray(
+    [
+        [1.0, 0.0],   # transcription
+        [0.0, 1.0],   # translation
+        [-1.0, 0.0],  # mRNA decay
+        [0.0, -1.0],  # protein decay
+    ],
+    np.float32,
+)
+
+
+@register
+class GenomeExpression(Process):
+    name = "genome_expression"
+    stochastic = True
+
+    defaults = {
+        # name of a packaged gene table ("ecoli_core") or a list of row
+        # dicts with keys gene/k_tx/k_tl/d_m/d_p and optional rule.
+        "genes": "ecoli_core",
+        "substeps": 10,
+        "regulation_threshold": 0.05,  # presence threshold for rules
+        # Schema default for external species read by rules; shared-path
+        # declarations must agree across processes (core.engine), so wire
+        # this to match co-wired transport/metabolism processes.
+        "external_defaults": {},
+    }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        genes = self.config["genes"]
+        if isinstance(genes, str):
+            from lens_tpu.data import load_tsv
+
+            genes = load_tsv(f"{genes}_genes.tsv")
+        self.genes: List[str] = [str(row["gene"]) for row in genes]
+        if len(self.genes) != len(set(self.genes)):
+            raise ValueError("duplicate gene names in the gene table")
+        g = len(self.genes)
+
+        def col(key):
+            return np.asarray([float(row[key]) for row in genes], np.float32)
+
+        self._k_tx = col("k_tx")
+        self._k_tl = col("k_tl")
+        self._d_m = col("d_m")
+        self._d_p = col("d_p")
+        self._rules: Dict[int, Any] = {}
+        rule_species: List[str] = []
+        for i, row in enumerate(genes):
+            rule = row.get("rule") or ""
+            if rule:
+                compiled = compile_rule(
+                    str(rule), threshold=self.config["regulation_threshold"]
+                )
+                self._rules[i] = compiled
+                rule_species.extend(compiled.names)
+        self.rule_species: List[str] = sorted(set(rule_species))
+        # block-diagonal genome stoichiometry [4G, 2G]
+        self._stoich = jnp.asarray(np.kron(np.eye(g, dtype=np.float32), _BLOCK))
+
+    # -- declarative surface -------------------------------------------------
+
+    def ports_schema(self):
+        g = len(self.genes)
+        count = {
+            "_default": np.zeros(g, np.float32),
+            "_updater": "nonnegative_accumulate",
+            "_divider": "binomial",
+        }
+        rate = lambda v: {
+            "_default": v,
+            "_updater": "null",
+            "_divider": "copy",
+            "_emit": False,
+        }
+        schema = {
+            "counts": {"mrna": dict(count), "protein": dict(count)},
+            "rates": {
+                "k_tx": rate(self._k_tx),
+                "k_tl": rate(self._k_tl),
+                "d_m": rate(self._d_m),
+                "d_p": rate(self._d_p),
+            },
+        }
+        if self.rule_species:
+            defaults = self.config["external_defaults"]
+            schema["external"] = {
+                mol: {
+                    "_default": float(defaults.get(mol, 0.0)),
+                    "_updater": "null",
+                    "_divider": "copy",
+                }
+                for mol in self.rule_species
+            }
+        return schema
+
+    # -- dynamics ------------------------------------------------------------
+
+    def next_update(self, timestep, states, key=None):
+        g = len(self.genes)
+        m = states["counts"]["mrna"]
+        p = states["counts"]["protein"]
+        r = states["rates"]
+
+        gate = jnp.ones(g, m.dtype)
+        if self._rules:
+            env = {mol: states["external"][mol] for mol in self.rule_species}
+            for i, rule in self._rules.items():
+                gate = gate.at[i].set(rule(env))
+
+        counts = jnp.stack([m, p], axis=1).reshape(2 * g)  # [2G] interleaved
+
+        def propensities(x):
+            xm = x.reshape(g, 2)
+            props = jnp.stack(
+                [
+                    r["k_tx"] * gate,
+                    r["k_tl"] * xm[:, 0],
+                    r["d_m"] * xm[:, 0],
+                    r["d_p"] * xm[:, 1],
+                ],
+                axis=1,
+            )  # [G, 4]
+            return props.reshape(4 * g)
+
+        new = tau_leap_window(
+            key, counts, self._stoich, propensities, timestep,
+            int(self.config["substeps"]),
+        ).reshape(g, 2)
+        return {
+            "counts": {
+                "mrna": new[:, 0] - m,
+                "protein": new[:, 1] - p,
+            },
+        }
