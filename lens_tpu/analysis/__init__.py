@@ -192,6 +192,241 @@ def plot_field_snapshots(
     return out_path
 
 
+# -- lineage ------------------------------------------------------------------
+
+
+def lineage_table(timeseries: Mapping) -> Dict[int, Dict[str, Any]]:
+    """Reconstruct the lineage tree from an emitted trajectory.
+
+    Uses the colony layer's framework-level lineage emit
+    (``timeseries["lineage"]``: cell_id/parent_id/birth_step per row per
+    emit): every id that was ever live becomes one node. Returns
+    ``{cell_id: {parent, birth_step, row, t_first, t_last, generation,
+    children}}``. Generations walk parent chains; a parent that was never
+    observed live (divided away between sparse emits) still appears as a
+    node (``observed=False``) so chains never break.
+    """
+    lin = timeseries["lineage"]
+    cell_id = np.asarray(lin["cell_id"])      # [T, N]
+    parent_id = np.asarray(lin["parent_id"])  # [T, N]
+    birth = np.asarray(lin["birth_step"])     # [T, N]
+    alive = np.asarray(timeseries["alive"]).astype(bool)
+    t = _times(timeseries, cell_id.shape[0])
+
+    table: Dict[int, Dict[str, Any]] = {}
+    for s in range(cell_id.shape[0]):
+        for row in np.nonzero(alive[s])[0]:
+            cid = int(cell_id[s, row])
+            node = table.get(cid)
+            if node is None:
+                table[cid] = {
+                    "parent": int(parent_id[s, row]),
+                    "birth_step": int(birth[s, row]),
+                    "row": int(row),
+                    "t_first": float(t[s]),
+                    "t_last": float(t[s]),
+                    "observed": True,
+                    "children": [],
+                }
+            else:
+                node["t_last"] = float(t[s])
+    # Materialize ONE placeholder node per missing parent (a cell that
+    # divided away entirely between sparse emits): its own ancestry is
+    # unknowable from the trajectory, so the chain is truncated there
+    # (parent=-1) rather than walked further.
+    for cid in list(table):
+        pid = table[cid]["parent"]
+        if pid != -1 and pid not in table:
+            table[pid] = {
+                "parent": -1,  # unknown further back
+                "birth_step": 0,
+                "row": -1,
+                "t_first": float("nan"),
+                "t_last": float("nan"),
+                "observed": False,
+                "children": [],
+            }
+    for cid, node in table.items():
+        pid = node["parent"]
+        if pid != -1 and pid in table:
+            table[pid]["children"].append(cid)
+
+    def generation(cid: int, seen=()) -> int:
+        node = table[cid]
+        if "generation" in node:
+            return node["generation"]
+        pid = node["parent"]
+        g = 0 if (pid == -1 or pid not in table or pid in seen) else (
+            generation(pid, seen + (cid,)) + 1
+        )
+        node["generation"] = g
+        return g
+
+    for cid in table:
+        generation(cid)
+    return table
+
+
+def ancestry(table: Mapping[int, Mapping], cell: int) -> List[int]:
+    """Root-first chain of ids from a founder down to ``cell``."""
+    chain = [cell]
+    while True:
+        pid = table[chain[-1]]["parent"]
+        if pid == -1 or pid not in table:
+            break
+        chain.append(pid)
+    return chain[::-1]
+
+
+def plot_lineage(
+    timeseries: Mapping,
+    out_path: str = "out/lineage.png",
+    max_founders: int = 16,
+) -> str:
+    """The lineage tree: one horizontal life-line per cell (birth -> last
+    seen), vertical connectors at divisions — the reference's
+    multi-generation trace, reconstructed from ids instead of per-process
+    bookkeeping."""
+    plt = _plt()
+    table = lineage_table(timeseries)
+    founders = sorted(
+        cid for cid, n in table.items()
+        if n["parent"] == -1 or n["parent"] not in table
+    )[:max_founders]
+
+    ys: Dict[int, float] = {}
+    next_leaf = [0.0]
+
+    def layout(cid: int) -> float:
+        node = table[cid]
+        kids = [k for k in node["children"] if k in table]
+        if not kids:
+            ys[cid] = next_leaf[0]
+            next_leaf[0] += 1.0
+        else:
+            ys[cid] = float(np.mean([layout(k) for k in kids]))
+        return ys[cid]
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for f in founders:
+        layout(f)
+    for cid, y in ys.items():
+        node = table[cid]
+        if not node["observed"]:
+            continue
+        color = plt.cm.viridis(
+            (node["generation"] % 8) / 8.0
+        )
+        ax.plot(
+            [node["t_first"], node["t_last"]], [y, y],
+            color=color, linewidth=1.2,
+        )
+        for k in node["children"]:
+            if k in ys and table[k]["observed"]:
+                ax.plot(
+                    [table[k]["t_first"]] * 2, [y, ys[k]],
+                    color="gray", linewidth=0.6, alpha=0.7,
+                )
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("lineage position")
+    ax.set_title(
+        f"lineage tree ({len(ys)} cells, "
+        f"{max(n['generation'] for n in table.values()) + 1} generations)"
+    )
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+def plot_generation_trace(
+    timeseries: Mapping,
+    path: Sequence[str],
+    cell: Optional[int] = None,
+    out_path: str = "out/generation_trace.png",
+) -> str:
+    """One variable followed through a cell's whole ancestry: each
+    ancestor's segment plotted over its lifetime, division times marked.
+    ``cell`` defaults to a deepest-generation cell."""
+    plt = _plt()
+    table = lineage_table(timeseries)
+    if cell is None:
+        cell = max(table, key=lambda c: table[c]["generation"])
+    chain = [c for c in ancestry(table, cell) if table[c]["observed"]]
+    values = get_path(timeseries, path)  # [T, N]
+    lin_id = np.asarray(timeseries["lineage"]["cell_id"])
+    alive = np.asarray(timeseries["alive"]).astype(bool)
+    t = _times(timeseries, values.shape[0])
+
+    fig, ax = plt.subplots(figsize=(8, 4))
+    for cid in chain:
+        row = table[cid]["row"]
+        sel = alive[:, row] & (lin_id[:, row] == cid)
+        if not sel.any():
+            continue
+        ax.plot(t[sel], values[sel, row], linewidth=1.2, label=f"id {cid}")
+        ax.axvline(t[sel][-1], color="gray", linewidth=0.5, alpha=0.5)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel(SEP_TITLE.join(path))
+    ax.set_title(
+        f"{SEP_TITLE.join(path)} across {len(chain)} generations"
+    )
+    if len(chain) <= 12:
+        ax.legend(fontsize=7)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+def animate_fields(
+    timeseries: Mapping,
+    molecule_index: int = 0,
+    out_path: str = "out/fields.gif",
+    locations: Optional[np.ndarray] = None,
+    dx: float = 1.0,
+    fps: int = 8,
+) -> str:
+    """Animated lattice field (+ optional live-cell overlay) — the
+    reference's field animation, written as a GIF via Pillow."""
+    plt = _plt()
+    from matplotlib.animation import FuncAnimation, PillowWriter
+
+    fields = np.asarray(timeseries["fields"])  # [T, M, H, W]
+    t = _times(timeseries, fields.shape[0])
+    vmin = float(fields[:, molecule_index].min())
+    vmax = float(fields[:, molecule_index].max())
+    fig, ax = plt.subplots(figsize=(5, 4.2))
+    im = ax.imshow(
+        fields[0, molecule_index], origin="lower",
+        vmin=vmin, vmax=vmax, cmap="viridis",
+    )
+    fig.colorbar(im, ax=ax, shrink=0.85)
+    scat = None
+    if locations is not None:
+        scat = ax.scatter([], [], s=3, c="red", alpha=0.7)
+    title = ax.set_title("")
+
+    def update(s):
+        im.set_data(fields[s, molecule_index])
+        title.set_text(f"t={float(t[s]):g}s")
+        artists = [im, title]
+        if scat is not None:
+            alive = np.asarray(timeseries["alive"])[s].astype(bool)
+            pts = np.asarray(locations)[s][alive] / dx
+            scat.set_offsets(pts[:, ::-1])  # (col=x, row=y)
+            artists.append(scat)
+        return artists
+
+    anim = FuncAnimation(fig, update, frames=fields.shape[0], blit=False)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    anim.save(out_path, writer=PillowWriter(fps=fps))
+    plt.close(fig)
+    return out_path
+
+
 __all__ = [
     "load",
     "alive_counts",
@@ -199,6 +434,11 @@ __all__ = [
     "plot_timeseries",
     "plot_colony_growth",
     "plot_field_snapshots",
+    "lineage_table",
+    "ancestry",
+    "plot_lineage",
+    "plot_generation_trace",
+    "animate_fields",
     "flatten_leaves",
     "get_path",
 ]

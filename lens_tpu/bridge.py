@@ -160,15 +160,28 @@ class CompartmentSimulation:
 
 
 class HostAgent:
-    """Bookkeeping for one cell in the host loop (id, sim, location)."""
+    """Bookkeeping for one cell in the host loop (id, sim, location).
+
+    ``parent_id``/``birth_time`` mirror the fast path's lineage emit
+    (colony layer): both daughters of a division are NEW agents carrying
+    their parent's id, so host-loop experiments reconstruct the same
+    lineage trees the colony trajectories do."""
 
     _next_id = 0
 
-    def __init__(self, sim: CellSimulation, location: Sequence[float]):
+    def __init__(
+        self,
+        sim: CellSimulation,
+        location: Sequence[float],
+        parent_id: Optional[str] = None,
+        birth_time: float = 0.0,
+    ):
         self.sim = sim
         self.location = np.asarray(location, np.float64)
         self.agent_id = f"agent_{HostAgent._next_id}"
         HostAgent._next_id += 1
+        self.parent_id = parent_id
+        self.birth_time = float(birth_time)
 
 
 class HostExchangeLoop:
@@ -256,10 +269,16 @@ class HostExchangeLoop:
             )
             hi = np.asarray(self.lattice.size) - 1e-3
             new_agents.append(
-                HostAgent(sim_a, np.clip(agent.location + half, 0.0, hi))
+                HostAgent(
+                    sim_a, np.clip(agent.location + half, 0.0, hi),
+                    parent_id=agent.agent_id, birth_time=self.time,
+                )
             )
             new_agents.append(
-                HostAgent(sim_b, np.clip(agent.location - half, 0.0, hi))
+                HostAgent(
+                    sim_b, np.clip(agent.location - half, 0.0, hi),
+                    parent_id=agent.agent_id, birth_time=self.time,
+                )
             )
         self.agents = new_agents
 

@@ -138,6 +138,26 @@ class Colony:
                         agents, path, jnp.broadcast_to(value, base.shape).astype(base.dtype)
                     )
         alive = jnp.arange(self.capacity) < n_alive
+        if self.division_trigger is not None:
+            # Lineage bookkeeping (framework-level, not schema-declared):
+            # founders' cell_id = their row; division assigns BOTH
+            # daughters fresh ids and records the parent's id, so offline
+            # analysis can reconstruct the full binary lineage tree from
+            # any emitted trajectory (the reference's multi-generation
+            # traces, SURVEY.md §2 "Analysis"). row_id is the immutable
+            # physical row index (globally unique even when the agent
+            # axis is sharded — it rides the shard split), used to mint
+            # collision-free ids inside per-shard division.
+            rows = jnp.arange(self.capacity, dtype=jnp.int32)
+            agents = dict(
+                agents,
+                lineage={
+                    "cell_id": rows,
+                    "parent_id": jnp.full(self.capacity, -1, jnp.int32),
+                    "birth_step": jnp.zeros(self.capacity, jnp.int32),
+                    "row_id": rows,
+                },
+            )
         if key is None:
             key = jax.random.PRNGKey(0)
         return ColonyState(
@@ -177,7 +197,7 @@ class Colony:
         if self.division_trigger is None:
             return cs
         key, sub = jax.random.split(cs.key)
-        agents, alive = self._divide(cs.agents, cs.alive, sub)
+        agents, alive = self._divide(cs.agents, cs.alive, sub, cs.step)
         return cs._replace(agents=agents, alive=alive, key=key)
 
     def step(self, cs: ColonyState, timestep: float) -> ColonyState:
@@ -208,7 +228,11 @@ class Colony:
     # -- division ------------------------------------------------------------
 
     def _divide(
-        self, agents: dict, alive: jax.Array, key: jax.Array
+        self,
+        agents: dict,
+        alive: jax.Array,
+        key: jax.Array,
+        step: jax.Array | int = 0,
     ) -> Tuple[dict, jax.Array]:
         """Vectorized division: all triggered rows split at once.
 
@@ -253,6 +277,8 @@ class Colony:
             dummy = jnp.zeros_like(jax.random.split(key, cap))
             out = agents
             for i, (path, value) in enumerate(leaves):
+                if path[0] == "lineage":
+                    continue  # handled below, not by schema dividers
                 name = self.compartment.dividers.get(path, "split")
                 divider = DIVIDERS[name]
                 # Key policy is declared on the divider itself (see
@@ -272,6 +298,40 @@ class Colony:
                 # nothing else lands)
                 new_val = new_val.at[slot].set(b, mode="drop")
                 out = set_path(out, path, new_val)
+
+            lin = agents.get("lineage")
+            if lin is not None:
+                # Both daughters are NEW cells: fresh ids minted from the
+                # immutable global row_id so ids never collide across
+                # steps or shards. Daughter A (parent's row) gets
+                # base + row_id, daughter B (claimed slot) gets
+                # base + capacity + row_id[slot]; bases advance by
+                # 2*capacity per step, so id ranges are disjoint from the
+                # founders' [0, capacity) and from every other step.
+                # (int32: overflows after ~2^31/(2*capacity) steps —
+                # ~20k steps at 50k capacity, beyond typical experiments.)
+                step32 = jnp.asarray(step, jnp.int32)
+                base = (step32 + 1) * jnp.int32(2 * self.capacity)
+                row_id = lin["row_id"]
+                old_id = lin["cell_id"]
+                slot_row = row_id[jnp.clip(slot, 0, cap - 1)]
+                cell_id = jnp.where(can_divide, base + row_id, old_id)
+                cell_id = cell_id.at[slot].set(
+                    base + jnp.int32(self.capacity) + slot_row, mode="drop"
+                )
+                parent_id = jnp.where(can_divide, old_id, lin["parent_id"])
+                parent_id = parent_id.at[slot].set(old_id, mode="drop")
+                birth = jnp.where(can_divide, step32, lin["birth_step"])
+                birth = birth.at[slot].set(step32, mode="drop")
+                out = dict(
+                    out,
+                    lineage=dict(
+                        lin,
+                        cell_id=cell_id,
+                        parent_id=parent_id,
+                        birth_step=birth,
+                    ),
+                )
 
             return out, alive.at[slot].set(True, mode="drop")
 
@@ -296,6 +356,10 @@ class Colony:
                 )
         out = self.compartment.emit(agents)
         out["alive"] = alive
+        if "lineage" in agents:
+            # cell/parent ids + birth step: the offline lineage-tree key
+            # (analysis.lineage_table reconstructs generations from these)
+            out["lineage"] = dict(agents["lineage"])
         if self.division_trigger is not None:
             # Saturation telemetry: rows still triggered after step_division
             # are parents whose division was suppressed (no free row). On a

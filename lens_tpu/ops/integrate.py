@@ -13,8 +13,12 @@ replaced by fixed-step explicit integrators built on ``lax.scan``:
 
 Adaptive stepping is deliberately NOT the default: under ``vmap`` a
 per-agent adaptive controller would serialize to the worst agent anyway.
-Stiff regimes are handled by raising the substep count (cheap: the scan is
-compiled once) — or by the Rosenbrock path in a later revision.
+Stiff regimes get the ``"implicit"`` stepper instead — implicit Euler
+with a fixed Newton iteration (L-stable, so dt is set by accuracy, not
+stability), the fixed-shape counterpart of the reference's LSODA
+automatic stiff switching: the Jacobian comes from ``jax.jacfwd`` and
+each Newton step is one small dense solve, which ``vmap`` batches across
+the colony.
 
 RHS signature: ``rhs(t, y, args) -> dy/dt`` (same pytree structure as y).
 """
@@ -63,7 +67,42 @@ def rk4_step(rhs: RHS, t, y, dt, args=None):
     )
 
 
-_STEPPERS = {"euler": euler_step, "heun": heun_step, "rk4": rk4_step}
+def implicit_euler_step(rhs: RHS, t, y, dt, args=None, newton_iters: int = 4):
+    """One L-stable implicit-Euler step via fixed-iteration Newton.
+
+    Solves ``y1 = y + dt * rhs(t + dt, y1)``. The state pytree is
+    raveled to a vector; each Newton iteration forms the dense Jacobian
+    with ``jax.jacfwd`` and solves ``(I - dt J) delta = -residual``.
+    Fixed iteration count keeps shapes/trace static (SURVEY.md §4's
+    vmap-across-agents requirement); for the few-species kinetic systems
+    processes integrate, 3–4 iterations reach Newton's quadratic basin.
+    Stability: A- and L-stable, so stiff relaxation rates (|lambda| dt
+    >> 1) damp instead of exploding — the regime where rk4 diverges.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    flat0, unravel = ravel_pytree(y)
+    n = flat0.size
+    dt = jnp.asarray(dt, flat0.dtype)
+
+    def f(v):
+        return ravel_pytree(rhs(t + dt, unravel(v), args))[0]
+
+    def newton(v, _):
+        residual = v - flat0 - dt * f(v)
+        A = jnp.eye(n, dtype=flat0.dtype) - dt * jax.jacfwd(f)(v)
+        return v - jnp.linalg.solve(A, residual), None
+
+    v, _ = jax.lax.scan(newton, flat0, None, length=newton_iters)
+    return unravel(v)
+
+
+_STEPPERS = {
+    "euler": euler_step,
+    "heun": heun_step,
+    "rk4": rk4_step,
+    "implicit": implicit_euler_step,
+}
 
 
 def odeint_window(

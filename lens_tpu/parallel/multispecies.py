@@ -208,7 +208,7 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
                 key, sub = jax.random.split(cs.key)
                 sub = jax.random.fold_in(sub, a_idx)
                 d_agents, d_alive = sp.colony._divide(
-                    cs.agents, cs.alive, sub
+                    cs.agents, cs.alive, sub, cs.step
                 )
                 cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
             agents = cs.agents

@@ -157,7 +157,9 @@ class ShardedSpatialColony(ShardedRunnerBase):
         if colony.division_trigger is not None:
             key, sub = jax.random.split(cs.key)
             sub = jax.random.fold_in(sub, a_idx)
-            d_agents, d_alive = colony._divide(cs.agents, cs.alive, sub)
+            d_agents, d_alive = colony._divide(
+                cs.agents, cs.alive, sub, cs.step
+            )
             cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
         agents = cs.agents
         loc = get_path(agents, spatial.location_path)

@@ -185,3 +185,104 @@ class TestAnalysis:
             ts, out_path=str(tmp_path / "f.png"), locations=locs
         )
         assert os.path.getsize(p) > 1000
+
+
+class TestLineage:
+    """Framework-level lineage: colony._divide mints fresh ids for both
+    daughters and records the parent id; analysis reconstructs the tree
+    (VERDICT r2 item 5)."""
+
+    def deep_colony(self, total=260.0, emit_every=5):
+        from lens_tpu.colony.colony import Colony
+        from lens_tpu.models.composites import grow_divide
+
+        # fast growth + low division threshold -> several generations
+        comp = grow_divide({"growth": {"rate": 0.01}})
+        colony = Colony(
+            comp, capacity=64, division_trigger=("global", "divide")
+        )
+        cs = colony.initial_state(2, key=jax.random.PRNGKey(4))
+        final, traj = colony.run(cs, total, 1.0, emit_every=emit_every)
+        return colony, final, traj
+
+    def test_ids_unique_and_parents_recorded(self):
+        colony, final, traj = self.deep_colony()
+        assert int(jnp.sum(final.alive)) > 8  # several rounds of division
+        lin = final.agents["lineage"]
+        ids = np.asarray(lin["cell_id"])[np.asarray(final.alive)]
+        assert len(set(ids.tolist())) == len(ids)  # unique among live
+        parents = np.asarray(lin["parent_id"])[np.asarray(final.alive)]
+        # every live cell today was born by division (founders divided
+        # away over 260 s at rate 0.01 -> threshold 2.0 by ~t=70)
+        assert (parents >= 0).all()
+        # both-daughters-new convention: no live cell keeps a founder id
+        # after its row divided; birth steps are populated
+        assert (np.asarray(lin["birth_step"])[np.asarray(final.alive)] > 0).any()
+
+    def test_lineage_table_generations(self):
+        from lens_tpu.analysis import ancestry, lineage_table
+
+        _, _, traj = self.deep_colony()
+        table = lineage_table(traj)
+        gens = max(n["generation"] for n in table.values())
+        assert gens >= 3, f"expected >=3 generations, got {gens}"
+        # every observed non-founder's parent resolves into the table
+        for cid, node in table.items():
+            if node["parent"] != -1:
+                assert node["parent"] in table
+        # ancestry chains are root-first and consistent
+        deepest = max(table, key=lambda c: table[c]["generation"])
+        chain = ancestry(table, deepest)
+        assert chain[-1] == deepest
+        assert len(chain) == table[deepest]["generation"] + 1
+
+    def test_lineage_plots_render(self, tmp_path):
+        from lens_tpu.analysis import plot_generation_trace, plot_lineage
+
+        _, _, traj = self.deep_colony()
+        p1 = plot_lineage(traj, out_path=str(tmp_path / "lineage.png"))
+        p2 = plot_generation_trace(
+            traj, ("global", "volume"),
+            out_path=str(tmp_path / "trace.png"),
+        )
+        assert os.path.getsize(p1) > 1000
+        assert os.path.getsize(p2) > 1000
+
+    def test_field_animation_renders(self, tmp_path):
+        from lens_tpu.analysis import animate_fields
+
+        ts = {
+            "fields": np.random.rand(5, 1, 8, 8).astype(np.float32),
+            "alive": np.ones((5, 4), bool),
+        }
+        locs = np.random.rand(5, 4, 2) * 8.0
+        p = animate_fields(
+            ts, out_path=str(tmp_path / "f.gif"), locations=locs, fps=4
+        )
+        assert os.path.getsize(p) > 1000
+
+    def test_sharded_lineage_ids_unique(self):
+        """Per-shard division mints ids from the GLOBAL row_id leaf, so
+        ids stay unique across shards."""
+        from lens_tpu.models import ecoli_lattice
+        from lens_tpu.parallel import ShardedSpatialColony, make_mesh
+
+        spatial = ecoli_lattice(
+            {
+                "capacity": 128,
+                "shape": (32, 32),
+                "size": (32.0, 32.0),
+                "growth": {"rate": 0.05},
+                "transport": {"yield_": 1.0, "k_consume": 0.0},
+            }
+        )[0]
+        mesh = make_mesh(n_agents=4, n_space=2)
+        sharded = ShardedSpatialColony(spatial, mesh)
+        ss = sharded.initial_state(60, jax.random.PRNGKey(2))
+        out, _ = sharded.run(ss, 20.0, 1.0, emit_every=20)
+        alive = np.asarray(out.colony.alive)
+        assert alive.sum() > 60  # divisions happened on the mesh
+        ids = np.asarray(out.colony.agents["lineage"]["cell_id"])[alive]
+        assert len(set(ids.tolist())) == len(ids)
+        parents = np.asarray(out.colony.agents["lineage"]["parent_id"])[alive]
+        assert (parents >= -1).all()
