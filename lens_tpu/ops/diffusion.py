@@ -79,10 +79,13 @@ def diffuse_xla(
 # ---------------------------------------------------------------------------
 
 
-#: The kernel holds 2 copies of one [H, W] slab (in + out block) in VMEM;
-#: budget half of a v5e core's ~16 MiB so other buffers and padding to
-#: (8, 128) tiling always fit.
-_VMEM_SLAB_BUDGET_BYTES = 8 * 1024 * 1024
+#: The kernel's VMEM working set is ~6 slabs of one [H, W] field: input
+#: block, output block, and the four shifted stencil copies the
+#: concatenates materialize (measured on v5e: a 4 MiB slab allocates
+#: 23.8 MiB of scoped VMEM). Budget against 14 MiB of the core's 16 MiB
+#: so tiling padding and scalar buffers always fit.
+_VMEM_KERNEL_SLABS = 6
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
 
 
 def _fits_vmem(fields: jnp.ndarray) -> bool:
@@ -90,7 +93,8 @@ def _fits_vmem(fields: jnp.ndarray) -> bool:
     # account for tiling padding: VMEM allocations round up to (8, 128)
     h_pad = -(-h // 8) * 8
     w_pad = -(-w // 128) * 128
-    return 2 * h_pad * w_pad * fields.dtype.itemsize <= _VMEM_SLAB_BUDGET_BYTES
+    slab = h_pad * w_pad * fields.dtype.itemsize
+    return _VMEM_KERNEL_SLABS * slab <= _VMEM_BUDGET_BYTES
 
 
 def diffuse_pallas(
@@ -148,9 +152,20 @@ def diffuse(
     'pallas_interpret' (for CPU tests of the kernel logic).
     """
     if impl == "auto":
+        # Recorded A/B on TPU v5e (bench_diffusion_ab.py ->
+        # BENCH_DIFFUSION_AB.json, round 3; SURVEY.md §7 step 5 "keep
+        # whichever wins"). The decisive number is IN CONTEXT: the
+        # config-2 colony window runs 8.46M agent-steps/s with the Pallas
+        # kernel vs 5.24M with the XLA path (1.6x) — inside the big step
+        # program XLA spills the substep scan to HBM, while the kernel
+        # pins the slab in VMEM. (A stencil chain benchmarked ALONE flips
+        # the result — XLA fuses it perfectly when it's the whole program
+        # — which is why this decision is recorded from the in-context
+        # run; see the AB json for both.) Over the VMEM budget, XLA's
+        # tiling is the only option.
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         if impl == "pallas" and not _fits_vmem(fields):
-            impl = "xla"  # slab too big for on-core VMEM: XLA tiles instead
+            impl = "xla"
     if impl == "xla":
         return diffuse_xla(fields, alpha, n_substeps)
     if impl == "pallas":

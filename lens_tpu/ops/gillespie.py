@@ -54,7 +54,12 @@ def tau_leap_step(
     )  # [R, S]
     max_fire = jnp.floor(jnp.min(supportable, axis=1))  # [R]
     events = jnp.minimum(events, max_fire)
-    new = counts + events @ stoich
+    # Full f32 precision: TPU matmuls default to bfloat16, whose 8-bit
+    # mantissa would round event/count sums above 256 to non-integers —
+    # molecule counts must stay exact integers.
+    new = counts + jnp.matmul(
+        events, stoich, precision=jax.lax.Precision.HIGHEST
+    )
     return jnp.maximum(new, 0.0)
 
 

@@ -88,6 +88,19 @@ def linprog_box(
     ``converged`` comes back False and ``primal_residual`` large — callers
     (e.g. the FBA process) treat that as "no feasible flux" and clamp.
     """
+    # Full f32 matmul precision for the whole solve: TPU matmuls default
+    # to bfloat16, whose 8-bit mantissa collapses the normal-equations
+    # conditioning — measured on-device: every LP of the ecoli_core
+    # network reports unconverged under the default precision, all
+    # converge under float32, at identical wall-clock (these matrices are
+    # far too small for the MXU's bf16 advantage to matter).
+    with jax.default_matmul_precision("float32"):
+        return _linprog_box_impl(
+            c, A, b, lb, ub, n_iter, tol, regularization
+        )
+
+
+def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
     dtype = jnp.result_type(c.dtype, jnp.float32)
     c = jnp.asarray(c, dtype)
     A = jnp.asarray(A, dtype)

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -343,6 +344,14 @@ class FBAMetabolism(Process):
         return lb, ub
 
     def next_update(self, timestep, states):
+        # f32 matmuls throughout: bf16 (the TPU default) exchange/bound
+        # arithmetic would leak ~0.4% of every flux, breaking the
+        # lattice mass-conservation contract (and the LP itself needs it
+        # — see ops.linprog).
+        with jax.default_matmul_precision("float32"):
+            return self._next_update(timestep, states)
+
+    def _next_update(self, timestep, states):
         ext = jnp.stack([states["external"][mol] for mol in self.external])
         lb, ub = self.regulated_bounds(ext, timestep)
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -164,7 +165,12 @@ class Metabolism(Process):
         for i, rule in self._rules.items():
             gates = gates.at[i].set(rule(env))
         fluxes = self.vmax * saturation * gates  # [R], mM/s
-        dpools = timestep * (fluxes @ self.stoichiometry)  # [S] — the matmul
+        # f32 precision: the TPU's default bf16 matmul would leak ~0.4%
+        # of every flux into/out of the pools (mass-conservation breaker)
+        dpools = timestep * jnp.matmul(
+            fluxes, self.stoichiometry,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [S] — the matmul
         biomass_idx = self.species.index(self.biomass_species)
         dmass = self.config["mass_yield"] * jnp.maximum(
             dpools[biomass_idx], 0.0
