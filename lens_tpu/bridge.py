@@ -159,6 +159,72 @@ class CompartmentSimulation:
         pass
 
 
+class ExternalSnapshotAdapter:
+    """CellSimulation adapter for snapshot-API external models (wcEcoli
+    shape): proof that the five-method protocol generalizes beyond
+    ``Compartment`` (SURVEY.md §2 "wcEcoli bridge").
+
+    The external model is any object with the snapshot-style surface the
+    whole-cell lineage exposes:
+
+    - ``set_media({molecule: concentration})`` — environment in;
+    - ``advance_to(t)`` — run internal simulation to absolute time t;
+    - ``get_snapshot() -> dict`` with at least ``exchange_totals``
+      ({molecule: CUMULATIVE net secretion since birth}) and optionally
+      ``volume`` and ``ready_to_divide``;
+    - ``divide_snapshot() -> (snapshot_a, snapshot_b)`` — daughter
+      snapshots;
+    - a ``model_factory(snapshot)`` (passed to this adapter) that boots a
+      new model instance from a daughter snapshot.
+
+    The adapter owns the cumulative->per-window exchange differencing
+    (external models account since birth; the exchange loop wants this
+    window's delta), so external code needs no knowledge of exchange
+    windows at all.
+    """
+
+    def __init__(self, model, model_factory):
+        self.model = model
+        self.model_factory = model_factory
+        self._last_totals: Dict[str, float] = {}
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        self.model.set_media(dict(update))
+
+    def run_incremental(self, run_until: float) -> None:
+        self.model.advance_to(float(run_until))
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        snap = self.model.get_snapshot()
+        totals = dict(snap.get("exchange_totals", {}))
+        exchange = {
+            mol: total - self._last_totals.get(mol, 0.0)
+            for mol, total in totals.items()
+        }
+        self._last_totals = totals
+        update: Dict[str, Any] = {"exchange": exchange}
+        update["divide"] = bool(snap.get("ready_to_divide", False))
+        if "volume" in snap:
+            update["volume"] = float(snap["volume"])
+        return update
+
+    def divide(self):
+        snap_a, snap_b = self.model.divide_snapshot()
+        return (
+            ExternalSnapshotAdapter(
+                self.model_factory(snap_a), self.model_factory
+            ),
+            ExternalSnapshotAdapter(
+                self.model_factory(snap_b), self.model_factory
+            ),
+        )
+
+    def finalize(self) -> None:
+        close = getattr(self.model, "close", None)
+        if close is not None:
+            close()
+
+
 class HostAgent:
     """Bookkeeping for one cell in the host loop (id, sim, location).
 

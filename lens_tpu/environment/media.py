@@ -118,15 +118,25 @@ def fields_from_media(lattice, media: MediaDict) -> jnp.ndarray:
 
 
 def timeline_segments(
-    events: Sequence[TimelineEvent], total_time: float
+    events: Sequence[TimelineEvent],
+    total_time: float,
+    start_time: float = 0.0,
 ) -> List[Tuple[float, float, MediaDict]]:
-    """Cut ``[0, total_time)`` into ``(start, duration, media)`` segments."""
+    """Cut ``[start_time, start_time + total_time)`` into
+    ``(abs_start, duration, media)`` segments.
+
+    ``start_time`` matters for segmented/checkpointed runs: a
+    continuation covering [250, 500) of a ``"0 minimal, 400 lactose"``
+    timeline gets the minimal segment [250, 400) and the lactose shift
+    at 400 — event times are ABSOLUTE simulation times, not offsets into
+    each run call.
+    """
+    end_time = start_time + total_time
     out: List[Tuple[float, float, MediaDict]] = []
     for k, (start, media) in enumerate(events):
-        if start >= total_time:
-            break
-        end = events[k + 1][0] if k + 1 < len(events) else total_time
-        end = min(end, total_time)
-        if end > start:
-            out.append((start, end - start, media))
+        nxt = events[k + 1][0] if k + 1 < len(events) else end_time
+        s = max(start, start_time)
+        e = min(nxt, end_time)
+        if e > s:
+            out.append((s, e - s, media))
     return out

@@ -238,16 +238,22 @@ class SpatialColony:
         total_time: float,
         timestep: float,
         emit_every: int = 1,
+        start_time: float = 0.0,
     ) -> Tuple[SpatialState, dict]:
         """Run with media changes: the timeline splits the run into
-        segments; each segment is one jitted scan; at each boundary the
-        fields are reset from the segment's media recipe (host-side — a
-        few device stores per media switch, off the hot path).
+        segments; each segment is one jitted scan; at each media EVENT
+        the fields are reset from the new recipe (host-side — a few
+        device stores per media switch, off the hot path).
 
         ``timeline`` accepts anything ``environment.media.parse_timeline``
         does, e.g. ``"0 minimal, 500 minimal_lactose"``. Segment
         boundaries snap to whole steps (each duration must be a multiple
         of ``timestep * emit_every``, same contract as ``run``).
+
+        ``start_time`` is this call's absolute simulation time: event
+        times are absolute, so a checkpointed continuation starting at
+        t=250 keeps its evolved fields (no spurious reset) and still
+        applies later events on schedule.
         """
         from lens_tpu.environment.media import (
             fields_from_media,
@@ -256,9 +262,15 @@ class SpatialColony:
         )
 
         events = parse_timeline(timeline)
+        event_times = {t for t, _ in events}
         trajectories = []
-        for start, duration, media in timeline_segments(events, total_time):
-            ss = ss._replace(fields=fields_from_media(self.lattice, media))
+        for seg_start, duration, media in timeline_segments(
+            events, total_time, start_time
+        ):
+            if any(abs(seg_start - t) < 1e-9 for t in event_times):
+                ss = ss._replace(
+                    fields=fields_from_media(self.lattice, media)
+                )
             ss, traj = self.run(ss, duration, timestep, emit_every)
             trajectories.append(traj)
         trajectory = jax.tree.map(

@@ -57,6 +57,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # {"agents": N, "space": M} -> shard_map over a global (N x M) mesh
     # via parallel.ShardedSpatialColony; None -> single-program jit.
     # Multi-host bring-up (parallel.initialize) happens automatically.
+    # Optional "stripe" (default True) deals initially-alive rows
+    # round-robin across agent shards (per-shard division pools start
+    # balanced); False keeps the contiguous row layout, making sharded
+    # trajectories row-for-row comparable to unsharded ones.
     "mesh": None,
 }
 
@@ -103,10 +107,6 @@ class Experiment:
                 raise ValueError(
                     "config 'mesh' needs a spatial composite (lattice model)"
                 )
-            if self.config["timeline"] is not None:
-                raise ValueError(
-                    "config 'mesh' and 'timeline' cannot be combined yet"
-                )
             from lens_tpu.parallel import (
                 ShardedSpatialColony,
                 global_mesh,
@@ -135,7 +135,10 @@ class Experiment:
         n = int(self.config["n_agents"])
         overrides = self.config["overrides"] or None
         if self.runner is not None:
-            return self.runner.initial_state(n, key, overrides=overrides)
+            stripe = bool(self.config["mesh"].get("stripe", True))
+            return self.runner.initial_state(
+                n, key, stripe=stripe, overrides=overrides
+            )
         if self.spatial is not None:
             return self.spatial.initial_state(n, key, overrides=overrides)
         return self.colony.initial_state(n, overrides=overrides, key=key)
@@ -152,12 +155,22 @@ class Experiment:
     def _run_segment(self, state, duration: float):
         dt = float(self.config["timestep"])
         emit_every = int(self.config["emit_every"])
+        # Timeline event times are ABSOLUTE: a checkpointed segment (or a
+        # resume) starting at t>0 must continue the timeline from where
+        # the state's step counter says it is, not restart it.
+        start_time = self._state_step(state) * dt
         if self.runner is not None:
+            if self.config["timeline"] is not None:
+                return self.runner.run_timeline(
+                    state, self.config["timeline"], duration, dt,
+                    emit_every, start_time=start_time,
+                )
             return self.runner.run(state, duration, dt, emit_every)
         if self.spatial is not None:
             if self.config["timeline"] is not None:
                 return self.spatial.run_timeline(
-                    state, self.config["timeline"], duration, dt, emit_every
+                    state, self.config["timeline"], duration, dt,
+                    emit_every, start_time=start_time,
                 )
             return self.spatial.run(state, duration, dt, emit_every)
         return self.colony.run(state, duration, dt, emit_every)

@@ -102,3 +102,51 @@ class ShardedRunnerBase:
             )
             self._run_cache[cache_key] = run
         return run(state)
+
+    def run_timeline(
+        self,
+        state,
+        timeline,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+        start_time: float = 0.0,
+    ) -> Tuple[object, dict]:
+        """Run with media changes on the SHARDED path: same semantics as
+        ``SpatialColony.run_timeline`` — the timeline splits the run into
+        segments, each segment is one jitted sharded scan, and at each
+        media EVENT the fields are rebuilt from the new recipe (host-side,
+        re-placed with the state's field sharding — a few device stores
+        per media switch, off the hot path).
+
+        ``start_time`` is this call's absolute simulation time; event
+        times are absolute, so a checkpoint segment covering [250, 500)
+        of a t=400 shift applies the shift at 400 and does NOT re-reset
+        fields at 250 (segment starts that are not event times keep the
+        evolved fields).
+        """
+        import jax.numpy as jnp
+
+        from lens_tpu.environment.media import (
+            fields_from_media,
+            parse_timeline,
+            timeline_segments,
+        )
+        from lens_tpu.parallel.distributed import place_like
+
+        events = parse_timeline(timeline)
+        event_times = {t for t, _ in events}
+        trajectories = []
+        for seg_start, duration, media in timeline_segments(
+            events, total_time, start_time
+        ):
+            if any(abs(seg_start - t) < 1e-9 for t in event_times):
+                fields = fields_from_media(self._lattice(), media)
+                fields = place_like(fields, state.fields.sharding)
+                state = state._replace(fields=fields)
+            state, traj = self.run(state, duration, timestep, emit_every)
+            trajectories.append(traj)
+        trajectory = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *trajectories
+        )
+        return state, trajectory

@@ -139,6 +139,21 @@ def global_mesh(
     return Mesh(grid, axis_names=(AGENTS_AXIS, SPACE_AXIS))
 
 
+def place_like(leaf, sharding):
+    """One host-local array -> a device array with ``sharding``.
+
+    Multi-host safe: ``jax.device_put`` only works single-process (a
+    NamedSharding spanning non-addressable devices rejects it); on a
+    multi-host mesh each process materializes just its addressable
+    shards via ``make_array_from_callback``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(leaf, sharding)
+    return jax.make_array_from_callback(
+        np.shape(leaf), sharding, lambda idx: np.asarray(leaf)[idx]
+    )
+
+
 def distribute(state, mesh: Mesh, pspecs):
     """Host-local full-size state -> globally sharded device arrays.
 
@@ -148,12 +163,4 @@ def distribute(state, mesh: Mesh, pspecs):
     another's memory and no cross-host scatter happens at startup.
     """
     shardings = mesh_shardings(mesh, pspecs)
-    if jax.process_count() == 1:
-        return jax.device_put(state, shardings)
-    return jax.tree.map(
-        lambda leaf, sharding: jax.make_array_from_callback(
-            np.shape(leaf), sharding, lambda idx, _leaf=leaf: np.asarray(_leaf)[idx]
-        ),
-        state,
-        shardings,
-    )
+    return jax.tree.map(place_like, state, shardings)

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -96,6 +97,31 @@ def mesh_shardings(mesh: Mesh, pspecs):
         lambda spec: NamedSharding(mesh, spec),
         pspecs,
         is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def stripe_colony_rows(colony_state, n_blocks: int):
+    """Permute a ColonyState's rows so initially-alive cells spread
+    EVENLY across the ``n_blocks`` agent-axis shards.
+
+    ``initial_state`` marks rows ``[0, n_alive)`` alive; distributed
+    contiguously, they pile into the first shards — shard 0's division
+    pool exhausts (``division_backlog`` > 0) while later shards sit
+    empty. Before any dynamics all rows are exchangeable, so a pure
+    permutation is biology-neutral; after it, old row ``i`` sits at
+    block ``i % n_blocks``, slot ``i // n_blocks`` — founders and free
+    rows alike are dealt round-robin across shards.
+    """
+    cap = colony_state.alive.shape[0]
+    if cap % n_blocks:
+        raise ValueError(f"capacity {cap} not divisible by {n_blocks} blocks")
+    block = cap // n_blocks
+    p = jnp.arange(cap)
+    src = (p % block) * n_blocks + p // block
+    take = lambda leaf: leaf[src]
+    return colony_state._replace(
+        agents=jax.tree.map(take, colony_state.agents),
+        alive=take(colony_state.alive),
     )
 
 

@@ -79,12 +79,25 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
 
     # -- construction --------------------------------------------------------
 
-    def initial_state(self, n_alive, key, **kwargs) -> MultiSpeciesState:
+    def initial_state(
+        self, n_alive, key, stripe: bool = True, **kwargs
+    ) -> MultiSpeciesState:
         """Build on host, then place per the mesh layout (multi-host safe
-        via :func:`parallel.distributed.distribute`)."""
+        via :func:`parallel.distributed.distribute`). ``stripe`` deals
+        each species' alive rows round-robin across agent shards (see
+        :meth:`ShardedSpatialColony.initial_state`)."""
         from lens_tpu.parallel.distributed import distribute
+        from lens_tpu.parallel.mesh import stripe_colony_rows
 
         ms = self.multi.initial_state(n_alive, key, **kwargs)
+        if stripe:
+            n_blocks = self.mesh.shape[AGENTS_AXIS]
+            ms = ms._replace(
+                species={
+                    name: stripe_colony_rows(cs, n_blocks)
+                    for name, cs in ms.species.items()
+                }
+            )
         return distribute(ms, self.mesh, multispecies_pspecs(ms))
 
     # -- the SPMD step -------------------------------------------------------

@@ -59,16 +59,30 @@ class ShardedSpatialColony(ShardedRunnerBase):
 
     # -- construction --------------------------------------------------------
 
-    def initial_state(self, n_alive: int, key, **kwargs) -> SpatialState:
+    def initial_state(
+        self, n_alive: int, key, stripe: bool = True, **kwargs
+    ) -> SpatialState:
         """Build on host, then place per the mesh sharding layout.
 
         Placement goes through :func:`parallel.distributed.distribute`, so
         the same call works on a multi-host mesh (each host constructs the
         full state and keeps only its addressable shards).
+
+        ``stripe`` (default) deals alive rows round-robin across agent
+        shards (:func:`parallel.mesh.stripe_colony_rows`) so every
+        shard's division pool starts equally loaded; pass False to keep
+        the contiguous layout (e.g. to study per-shard saturation).
         """
         from lens_tpu.parallel.distributed import distribute
+        from lens_tpu.parallel.mesh import stripe_colony_rows
 
         ss = self.spatial.initial_state(n_alive, key, **kwargs)
+        if stripe:
+            ss = ss._replace(
+                colony=stripe_colony_rows(
+                    ss.colony, self.mesh.shape[AGENTS_AXIS]
+                )
+            )
         return distribute(ss, self.mesh, spatial_pspecs(ss))
 
     # -- the SPMD step -------------------------------------------------------

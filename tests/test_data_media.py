@@ -84,6 +84,20 @@ class TestTimeline:
         segs = timeline_segments(events, 400.0)
         assert len(segs) == 1 and segs[0][1] == 400.0
 
+    def test_segments_start_time(self):
+        """Event times are ABSOLUTE: a continuation covering [250, 500)
+        of a t=400 shift gets [250,400) on the old media + [400,500) on
+        the new — checkpointed/segmented runs must not restart timelines."""
+        events = parse_timeline("0 minimal, 400 minimal_lactose")
+        segs = timeline_segments(events, 250.0, start_time=250.0)
+        assert [(s, d) for s, d, _ in segs] == [(250.0, 150.0), (400.0, 100.0)]
+        assert segs[0][2] == events[0][1]   # still minimal before 400
+        assert segs[1][2] == events[1][1]   # lactose from 400
+        # a continuation entirely within one media epoch: one segment,
+        # whose start is NOT an event time (callers must not reset fields)
+        segs = timeline_segments(events, 100.0, start_time=100.0)
+        assert [(s, d) for s, d, _ in segs] == [(100.0, 100.0)]
+
     def test_fields_from_media(self):
         lattice = Lattice(
             molecules=["glucose", "lactose"], shape=(8, 8), timestep=1.0

@@ -115,7 +115,12 @@ class TestExperimentMesh:
         with Experiment(base) as exp:
             exp.run()
             plain = exp.emitter.timeseries()
-        with Experiment({**base, "mesh": {"agents": 4, "space": 2}}) as exp:
+        # stripe=False: row-for-row comparison against the unsharded run
+        # (the default striping permutes rows, which is biology-neutral
+        # but breaks positional equality)
+        with Experiment(
+            {**base, "mesh": {"agents": 4, "space": 2, "stripe": False}}
+        ) as exp:
             assert exp.runner is not None
             exp.run()
             sharded = exp.emitter.timeseries()
