@@ -88,6 +88,18 @@ def _build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--quiet", action="store_true")
 
     sub.add_parser("list", help="list composites, processes, emitters")
+
+    demo = sub.add_parser(
+        "demo",
+        help="run ONE process standalone and save its timeseries plot "
+        "(the reference's per-process __main__ dev harness)",
+    )
+    demo.add_argument("process", help="registered process name (see list)")
+    demo.add_argument("--time", type=float, default=100.0)
+    demo.add_argument("--timestep", type=float, default=1.0)
+    demo.add_argument("--config", default="{}", help="process config JSON")
+    demo.add_argument("--out-dir", default="out")
+    demo.add_argument("--seed", type=int, default=0)
     return p
 
 
@@ -127,6 +139,20 @@ def main(argv=None) -> int:
         print("composites:", ", ".join(sorted(composite_registry)))
         print("processes: ", ", ".join(sorted(process_registry)))
         print("emitters:  ", ", ".join(sorted(EMITTERS)))
+        return 0
+
+    if args.command == "demo":
+        from lens_tpu.processes.standalone import demo as run_demo
+
+        out = run_demo(
+            args.process,
+            total_time=args.time,
+            timestep=args.timestep,
+            config=json.loads(args.config),
+            out_dir=args.out_dir,
+            seed=args.seed,
+        )
+        print(f"plot: {out['plot']}")
         return 0
 
     from lens_tpu.experiment import Experiment

@@ -186,7 +186,15 @@ class ExternalSnapshotAdapter:
     def __init__(self, model, model_factory):
         self.model = model
         self.model_factory = model_factory
-        self._last_totals: Dict[str, float] = {}
+        # Seed the differencing baseline from the model's CURRENT totals:
+        # a model attached mid-life (checkpoint restore, or a daughter
+        # snapshot that carries cumulative accounting forward) must not
+        # have its whole lifetime exchange scattered into the first
+        # window.
+        snap = model.get_snapshot()
+        self._last_totals: Dict[str, float] = dict(
+            snap.get("exchange_totals", {})
+        )
 
     def apply_outer_update(self, update: Mapping[str, Any]) -> None:
         self.model.set_media(dict(update))

@@ -117,6 +117,42 @@ def fields_from_media(lattice, media: MediaDict) -> jnp.ndarray:
     )
 
 
+def run_media_timeline(
+    state,
+    timeline,
+    total_time: float,
+    start_time: float,
+    run_segment,
+    reset_fields,
+):
+    """The shared timeline-driven run loop (ONE copy for the unsharded
+    and sharded paths): split ``[start_time, start_time+total_time)`` at
+    media events, reset fields only at segment starts that ARE event
+    times (a checkpoint continuation mid-epoch keeps its evolved
+    fields), run each segment, concatenate trajectories.
+
+    ``run_segment(state, duration) -> (state, trajectory)``;
+    ``reset_fields(state, media) -> state``.
+    """
+    import jax
+    import jax.numpy as _jnp
+
+    events = parse_timeline(timeline)
+    event_times = {t for t, _ in events}
+    trajectories = []
+    for seg_start, duration, media in timeline_segments(
+        events, total_time, start_time
+    ):
+        if any(abs(seg_start - t) < 1e-9 for t in event_times):
+            state = reset_fields(state, media)
+        state, traj = run_segment(state, duration)
+        trajectories.append(traj)
+    trajectory = jax.tree.map(
+        lambda *xs: _jnp.concatenate(xs, axis=0), *trajectories
+    )
+    return state, trajectory
+
+
 def timeline_segments(
     events: Sequence[TimelineEvent],
     total_time: float,

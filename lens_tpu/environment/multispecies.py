@@ -15,10 +15,12 @@ the TPU-idiomatic choice:
   its own compartment, so each species' biology is one clean ``vmap``
   over a densely-packed agent axis — no wasted FLOPs on masked-off
   processes, no schema union across species;
-- the lattice is shared: gathers/scatters run per species against the
-  same field array, with **combined occupancy** (all species' live cells
-  in a bin split its content) so shared-bin mass conservation spans
-  species exactly as it spans agents within one species;
+- the lattice is shared: every species' rows concatenate onto ONE agent
+  axis for the lattice couplings — one occupancy, one gather, one
+  scatter per step regardless of species count — with **combined
+  occupancy** (all species' live cells in a bin split its content) so
+  shared-bin mass conservation spans species exactly as it spans agents
+  within one species;
 - division stays within a species (cells breed true), so each
   subcolony's row-activation machinery is untouched.
 
@@ -116,14 +118,40 @@ class MultiSpeciesColony:
 
     # -- stepping ------------------------------------------------------------
 
+    def _row_slices(self, ms: MultiSpeciesState) -> Dict[str, slice]:
+        """Static row slice of each species within the concatenated
+        all-species agent axis (dict order = iteration order)."""
+        out: Dict[str, slice] = {}
+        offset = 0
+        for name in self.species:
+            rows = ms.species[name].alive.shape[0]
+            out[name] = slice(offset, offset + rows)
+            offset += rows
+        return out
+
     def total_occupancy(self, ms: MultiSpeciesState) -> jax.Array:
         """Live-cell count per bin, summed over every species: [H, W]."""
-        occ = jnp.zeros(self.lattice.shape, jnp.float32)
-        for name, sp in self.species.items():
-            cs = ms.species[name]
-            locs = get_path(cs.agents, sp.location_path)
-            occ = occ + self.lattice.occupancy(locs, cs.alive)
-        return occ
+        locs, alive = self._concat_rows(ms)
+        return self.lattice.occupancy(locs, alive)
+
+    def _concat_rows(self, ms: MultiSpeciesState):
+        """All species' (locations, alive) stacked on one row axis.
+
+        The cross-species couplings (combined occupancy, one gather, one
+        scatter) run over this concatenated axis — O(1) lattice ops per
+        step regardless of species count, instead of one gather/scatter
+        pipeline per species.
+        """
+        locs = jnp.concatenate(
+            [
+                get_path(ms.species[name].agents, sp.location_path)
+                for name, sp in self.species.items()
+            ]
+        )
+        alive = jnp.concatenate(
+            [ms.species[name].alive for name in self.species]
+        )
+        return locs, alive
 
     def step(self, ms: MultiSpeciesState, timestep: float) -> MultiSpeciesState:
         """One exchange window for every species + the shared fields.
@@ -139,63 +167,66 @@ class MultiSpeciesColony:
                 f"{self.lattice.timestep}"
             )
         fields = ms.fields
-        occ = self.total_occupancy(ms) if self.share_bins else None
+        rows = self._row_slices(ms)
+        all_locs, all_alive = self._concat_rows(ms)
 
-        # 1. gather per species (shared for consuming ports — divided by
-        # the ALL-species occupancy — raw for sense-only ports)
+        # 1. ONE gather for all species (shared for consuming ports —
+        # divided by the ALL-species occupancy — raw for sense-only
+        # ports), then split by static row slices
+        local_shared_all = self.lattice.local_concentrations(
+            fields, all_locs, all_alive, share_bins=self.share_bins
+        )
+        local_raw_all = (
+            self.lattice.local_concentrations(
+                fields, all_locs, all_alive, share_bins=False
+            )
+            if any(
+                p.exchange is None
+                for sp in self.species.values()
+                for p in sp.field_ports.values()
+            )
+            else local_shared_all
+        )
         stepped: Dict[str, ColonyState] = {}
-        pre_locations: Dict[str, jax.Array] = {}
         for name, sp in self.species.items():
             cs = ms.species[name]
-            locs = get_path(cs.agents, sp.location_path)
-            pre_locations[name] = locs
-            local_shared = self.lattice.local_concentrations(
-                fields, locs, cs.alive,
-                share_bins=self.share_bins, occupancy=occ,
-            )
-            local_raw = (
-                self.lattice.local_concentrations(
-                    fields, locs, cs.alive, share_bins=False
-                )
-                if any(p.exchange is None for p in sp.field_ports.values())
-                else local_shared
-            )
             agents = cs.agents
             for mol, port in sp.field_ports.items():
-                local = local_raw if port.exchange is None else local_shared
-                col = local[:, self.lattice.index(mol)]
+                local = (
+                    local_raw_all if port.exchange is None
+                    else local_shared_all
+                )
+                col = local[rows[name], self.lattice.index(mol)]
                 prev = get_path(agents, port.local)
                 agents = set_path(
                     agents, port.local, jnp.where(cs.alive, col, prev)
                 )
             stepped[name] = cs._replace(agents=agents)
 
-        # 2. biology per species — one vmap per process set
+        # 2. biology per species — one vmap per process set (necessarily
+        # per species: each has its own program)
         for name, sp in self.species.items():
             stepped[name] = sp.colony.step_biology(stepped[name], timestep)
 
-        # 3. scatter ALL species' exchanges into the PRE-STEP bins, one
-        # combined delta, one >=0 clamp
-        delta = jnp.zeros_like(fields)
+        # 3. ONE scatter of all species' exchanges into the PRE-STEP
+        # bins, one >=0 clamp (lattice.apply_exchanges)
+        exchanges = []
         for name, sp in self.species.items():
             cs = stepped[name]
             agents = cs.agents
             cap_rows = cs.alive.shape[0]
-            exchange = jnp.stack(
-                [
-                    get_path(agents, sp.field_ports[mol].exchange)
-                    if mol in sp.field_ports
-                    and sp.field_ports[mol].exchange is not None
-                    else jnp.zeros(cap_rows)
-                    for mol in self.lattice.molecules
-                ],
-                axis=1,
+            exchanges.append(
+                jnp.stack(
+                    [
+                        get_path(agents, sp.field_ports[mol].exchange)
+                        if mol in sp.field_ports
+                        and sp.field_ports[mol].exchange is not None
+                        else jnp.zeros(cap_rows)
+                        for mol in self.lattice.molecules
+                    ],
+                    axis=1,
+                )
             )  # [rows, M]
-            i, j = self.lattice.bin_of(pre_locations[name])
-            contrib = (
-                exchange * cs.alive[:, None] * self.lattice.exchange_scale
-            )
-            delta = delta.at[:, i, j].add(contrib.T)
             for mol, port in sp.field_ports.items():
                 if port.exchange is None:
                     continue
@@ -204,7 +235,9 @@ class MultiSpeciesColony:
                     jnp.zeros_like(get_path(agents, port.exchange)),
                 )
             stepped[name] = cs._replace(agents=agents)
-        fields = jnp.maximum(fields + delta, 0.0)
+        fields = self.lattice.apply_exchanges(
+            fields, all_locs, jnp.concatenate(exchanges), all_alive
+        )
 
         # 4. division per species, then clip onto the domain
         h, w = self.lattice.size

@@ -257,26 +257,19 @@ class SpatialColony:
         """
         from lens_tpu.environment.media import (
             fields_from_media,
-            parse_timeline,
-            timeline_segments,
+            run_media_timeline,
         )
 
-        events = parse_timeline(timeline)
-        event_times = {t for t, _ in events}
-        trajectories = []
-        for seg_start, duration, media in timeline_segments(
-            events, total_time, start_time
-        ):
-            if any(abs(seg_start - t) < 1e-9 for t in event_times):
-                ss = ss._replace(
-                    fields=fields_from_media(self.lattice, media)
-                )
-            ss, traj = self.run(ss, duration, timestep, emit_every)
-            trajectories.append(traj)
-        trajectory = jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *trajectories
+        return run_media_timeline(
+            ss,
+            timeline,
+            total_time,
+            start_time,
+            run_segment=lambda s, d: self.run(s, d, timestep, emit_every),
+            reset_fields=lambda s, media: s._replace(
+                fields=fields_from_media(self.lattice, media)
+            ),
         )
-        return ss, trajectory
 
     # -- diagnostics ---------------------------------------------------------
 

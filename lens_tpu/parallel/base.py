@@ -125,28 +125,23 @@ class ShardedRunnerBase:
         fields at 250 (segment starts that are not event times keep the
         evolved fields).
         """
-        import jax.numpy as jnp
-
         from lens_tpu.environment.media import (
             fields_from_media,
-            parse_timeline,
-            timeline_segments,
+            run_media_timeline,
         )
         from lens_tpu.parallel.distributed import place_like
 
-        events = parse_timeline(timeline)
-        event_times = {t for t, _ in events}
-        trajectories = []
-        for seg_start, duration, media in timeline_segments(
-            events, total_time, start_time
-        ):
-            if any(abs(seg_start - t) < 1e-9 for t in event_times):
-                fields = fields_from_media(self._lattice(), media)
-                fields = place_like(fields, state.fields.sharding)
-                state = state._replace(fields=fields)
-            state, traj = self.run(state, duration, timestep, emit_every)
-            trajectories.append(traj)
-        trajectory = jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *trajectories
+        def reset_fields(s, media):
+            fields = fields_from_media(self._lattice(), media)
+            return s._replace(
+                fields=place_like(fields, s.fields.sharding)
+            )
+
+        return run_media_timeline(
+            state,
+            timeline,
+            total_time,
+            start_time,
+            run_segment=lambda s, d: self.run(s, d, timestep, emit_every),
+            reset_fields=reset_fields,
         )
-        return state, trajectory

@@ -126,42 +126,44 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
             SPACE_AXIS,
         )  # [M, H, W]
 
-        bins: Dict[str, tuple] = {}
-        for name, sp in multi.species.items():
-            cs = ms.species[name]
-            locs = get_path(cs.agents, sp.location_path)
-            bins[name] = lattice.bin_of(locs)
+        # This block's rows of EVERY species, concatenated — the SAME
+        # row-slice/concat methods the unsharded step uses (shape-
+        # polymorphic over the block row count), so the two paths cannot
+        # desynchronize.
+        row_slices = multi._row_slices(ms)
+        all_locs, all_alive = multi._concat_rows(ms)
+        bi, bj = lattice.bin_of(all_locs)
 
-        # Cross-species combined occupancy: sum this block's live cells of
-        # EVERY species per bin, then psum over agent shards -> the same
+        # Cross-species combined occupancy: this block's live cells of
+        # every species per bin, psum over agent shards -> the same
         # global [H, W] occupancy the unsharded step computes in HBM.
         occ = None
         if multi.share_bins:
-            occ_block = jnp.zeros(lattice.shape, jnp.float32)
-            for name, sp in multi.species.items():
-                cs = ms.species[name]
-                locs = get_path(cs.agents, sp.location_path)
-                occ_block = occ_block + lattice.occupancy(locs, cs.alive)
-            occ = lax.psum(occ_block, AGENTS_AXIS)
+            occ = lax.psum(
+                lattice.occupancy(all_locs, all_alive), AGENTS_AXIS
+            )
 
-        # 1. gather per species (consuming ports see the ALL-species
-        # shared concentration; sense-only ports see the raw bin value —
-        # same split as environment.spatial step 1)
+        # 1. ONE gather for all species from the assembled field
+        # (consuming ports see the ALL-species shared concentration;
+        # sense-only ports the raw bin value — same split as
+        # environment.spatial step 1), split by static row slices
+        local_raw_all = full_fields[:, bi, bj].T  # [rows_all, M]
+        local_shared_all = local_raw_all
+        if multi.share_bins:
+            local_shared_all = local_raw_all / (
+                jnp.maximum(occ[bi, bj], 1.0)[:, None]
+                * lattice.exchange_scale
+            )
         stepped: Dict[str, ColonyState] = {}
         for name, sp in multi.species.items():
             cs = ms.species[name]
-            i, j = bins[name]
-            local_raw = full_fields[:, i, j].T  # [rows, M]
-            local_shared = local_raw
-            if multi.share_bins:
-                local_shared = local_raw / (
-                    jnp.maximum(occ[i, j], 1.0)[:, None]
-                    * lattice.exchange_scale
-                )
             agents = cs.agents
             for mol, port in sp.field_ports.items():
-                local = local_raw if port.exchange is None else local_shared
-                col = local[:, lattice.index(mol)]
+                local = (
+                    local_raw_all if port.exchange is None
+                    else local_shared_all
+                )
+                col = local[row_slices[name], lattice.index(mol)]
                 prev = get_path(agents, port.local)
                 agents = set_path(
                     agents, port.local, jnp.where(cs.alive, col, prev)
@@ -178,26 +180,26 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
             )
             stepped[name] = cs._replace(key=stepped[name].key)
 
-        # 3. scatter ALL species' exchanges into the PRE-STEP bins: one
-        # combined full-canvas delta, psum over agent shards, ONE clamp
-        delta = jnp.zeros_like(full_fields)
+        # 3. ONE scatter of all species' exchanges into the PRE-STEP
+        # bins: combined full-canvas delta, psum over agent shards, ONE
+        # clamp
+        exchanges = []
         for name, sp in multi.species.items():
             cs = stepped[name]
             agents = cs.agents
-            rows = cs.alive.shape[0]
-            exchange = jnp.stack(
-                [
-                    get_path(agents, sp.field_ports[mol].exchange)
-                    if mol in sp.field_ports
-                    and sp.field_ports[mol].exchange is not None
-                    else jnp.zeros(rows)
-                    for mol in lattice.molecules
-                ],
-                axis=1,
+            n_rows = cs.alive.shape[0]
+            exchanges.append(
+                jnp.stack(
+                    [
+                        get_path(agents, sp.field_ports[mol].exchange)
+                        if mol in sp.field_ports
+                        and sp.field_ports[mol].exchange is not None
+                        else jnp.zeros(n_rows)
+                        for mol in lattice.molecules
+                    ],
+                    axis=1,
+                )
             )  # [rows, M]
-            i, j = bins[name]
-            contrib = exchange * cs.alive[:, None] * lattice.exchange_scale
-            delta = delta.at[:, i, j].add(contrib.T)
             for mol, port in sp.field_ports.items():
                 if port.exchange is None:
                     continue
@@ -206,6 +208,12 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
                     jnp.zeros_like(get_path(agents, port.exchange)),
                 )
             stepped[name] = cs._replace(agents=agents)
+        contrib = (
+            jnp.concatenate(exchanges)
+            * all_alive[:, None]
+            * lattice.exchange_scale
+        )
+        delta = jnp.zeros_like(full_fields).at[:, bi, bj].add(contrib.T)
         delta = lax.psum(delta, AGENTS_AXIS)
         strip = jnp.maximum(
             strip

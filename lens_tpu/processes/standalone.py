@@ -1,0 +1,90 @@
+"""Standalone process runs: the reference's per-process dev harness.
+
+Every reference process file carries a runnable ``__main__`` that steps
+the process alone against dict states and saves a plot — the de-facto
+unit-test harness (reconstructed: SURVEY.md §3.4 "standalone process
+run"). This module is that harness for ANY registered Process, exposed
+both as a library call and through the CLI::
+
+    python -m lens_tpu demo mm_transport --time 200 --out out/demo
+    python -m lens_tpu demo stochastic_expression --time 300
+
+The wiring is automatic: each port maps to a store of the same name
+(identity topology), the compartment is built from the process's own
+declared schema, and the timeseries of every emitted variable is plotted
+with :func:`lens_tpu.analysis.plot_timeseries`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+
+from lens_tpu.core.engine import Compartment
+from lens_tpu.core.process import Process
+
+
+def standalone_compartment(process: Process) -> Compartment:
+    """Wrap one process in a Compartment with identity port wiring."""
+    topology = {"process": {port: (port,) for port in process.ports_schema()}}
+    return Compartment(processes={"process": process}, topology=topology)
+
+
+def run_standalone(
+    process: Process,
+    total_time: float = 100.0,
+    timestep: float = 1.0,
+    overrides: Optional[Mapping] = None,
+    seed: int = 0,
+    emit_every: int = 1,
+) -> Tuple[dict, dict]:
+    """Step ``process`` alone; return ``(final_state, trajectory)``.
+
+    The trajectory stacks every emitted variable over time — exactly the
+    state a reference process's ``__main__`` would collect into its
+    timeseries dict.
+    """
+    comp = standalone_compartment(process)
+    state = comp.initial_state(overrides)
+    key = jax.random.PRNGKey(seed) if comp.has_stochastic else None
+    run = jax.jit(
+        lambda s: comp.run(
+            s, total_time, timestep, emit_every=emit_every, key=key
+        )
+    )
+    return run(state)
+
+
+def demo(
+    process_name: str,
+    total_time: float = 100.0,
+    timestep: float = 1.0,
+    config: Optional[Mapping[str, Any]] = None,
+    out_dir: str = "out",
+    seed: int = 0,
+) -> Dict[str, str]:
+    """Run a registered process standalone and render its timeseries.
+
+    Returns ``{"plot": path}``. The reference saved per-process plots to
+    ``out/`` the same way.
+    """
+    from lens_tpu.analysis import plot_timeseries
+    from lens_tpu.processes import process_registry
+
+    if process_name not in process_registry:
+        raise KeyError(
+            f"unknown process {process_name!r}; known: "
+            f"{sorted(process_registry)}"
+        )
+    process = process_registry[process_name](config)
+    _, trajectory = run_standalone(
+        process, total_time=total_time, timestep=timestep, seed=seed
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    plot = plot_timeseries(
+        trajectory,
+        out_path=os.path.join(out_dir, f"{process_name}.png"),
+    )
+    return {"plot": plot}

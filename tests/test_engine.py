@@ -136,3 +136,59 @@ def test_step_is_vmappable():
     batched = jax.tree.map(lambda x: jnp.broadcast_to(x, (16,)), state)
     out = jax.vmap(lambda s: comp.step(s, 1.0))(batched)
     assert out["cell"]["x"].shape == (16,)
+
+
+class TestStandaloneHarness:
+    """The reference's per-process __main__ dev harness (SURVEY.md §3.4):
+    any registered process runs alone with identity wiring and renders
+    its timeseries."""
+
+    def test_run_standalone_deterministic(self):
+        from lens_tpu.processes.mm_transport import MichaelisMentenTransport
+        from lens_tpu.processes.standalone import run_standalone
+
+        final, traj = run_standalone(
+            MichaelisMentenTransport(), total_time=50.0
+        )
+        import numpy as np
+
+        g = np.asarray(traj["internal"]["glucose_internal"])
+        assert g.shape[0] == 50
+        assert np.isfinite(g).all() and g[-1] > g[0]
+
+    def test_run_standalone_stochastic(self):
+        from lens_tpu.processes.standalone import run_standalone
+        from lens_tpu.processes.stochastic_expression import (
+            StochasticExpression,
+        )
+
+        import numpy as np
+
+        _, traj = run_standalone(StochasticExpression(), total_time=60.0)
+        m = np.asarray(traj["counts"]["mrna"])
+        assert m.shape[0] == 60 and (m >= 0).all() and m.max() > 0
+
+    def test_demo_cli_renders_plot(self, tmp_path, capsys):
+        import os
+
+        from lens_tpu.__main__ import main
+
+        rc = main(
+            [
+                "demo", "growth", "--time", "30",
+                "--out-dir", str(tmp_path),
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "plot:" in out
+        plot = out.split("plot:")[1].strip()
+        assert os.path.getsize(plot) > 1000
+
+    def test_demo_unknown_process(self):
+        import pytest
+
+        from lens_tpu.processes.standalone import demo
+
+        with pytest.raises(KeyError, match="unknown process"):
+            demo("nope")
