@@ -149,16 +149,15 @@ def plot_colony_growth(
     return out_path
 
 
-def plot_field_snapshots(
+def _snapshot_grid(
     timeseries: Mapping,
-    molecule_index: int = 0,
-    n_snapshots: int = 4,
-    out_path: str = "out/field_snapshots.png",
-    locations: Optional[np.ndarray] = None,
-    dx: float = 1.0,
+    molecule_index: int,
+    n_snapshots: int,
+    out_path: str,
+    overlay=None,
 ) -> str:
-    """Lattice field heatmaps at evenly spaced times (+ optional cell
-    overlay) — the reference's lattice snapshot/animation plot."""
+    """Shared snapshot machinery: evenly spaced field heatmaps with a
+    per-snapshot ``overlay(ax, step_index, k)`` hook, one colorbar each."""
     plt = _plt()
     fields = np.asarray(timeseries["fields"])  # [T, M, H, W]
     steps = np.linspace(0, fields.shape[0] - 1, n_snapshots).astype(int)
@@ -166,7 +165,7 @@ def plot_field_snapshots(
     vmin = fields[:, molecule_index].min()
     vmax = fields[:, molecule_index].max()
     fig, axes = plt.subplots(
-        1, n_snapshots, figsize=(4 * n_snapshots, 3.6), squeeze=False
+        1, n_snapshots, figsize=(4 * n_snapshots, 3.8), squeeze=False
     )
     for k, s in enumerate(steps):
         ax = axes[0][k]
@@ -177,14 +176,153 @@ def plot_field_snapshots(
             vmax=vmax,
             cmap="viridis",
         )
-        if locations is not None:
-            alive = np.asarray(timeseries["alive"])[s].astype(bool)
-            # locations [T, N, 2] are (row, col) in um; divide by dx for
-            # bin coordinates; imshow axes are (col=x, row=y)
-            pts = np.asarray(locations)[s][alive] / dx
-            ax.scatter(pts[:, 1], pts[:, 0], s=2, c="red", alpha=0.6)
+        if overlay is not None:
+            overlay(ax, int(s), k)
         ax.set_title(f"t={float(t[s]):g}s")
         fig.colorbar(im, ax=ax, shrink=0.8)
+    handles, labels = axes[0][0].get_legend_handles_labels()
+    if labels:
+        fig.legend(handles, labels, loc="upper right", fontsize=8)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+def plot_field_snapshots(
+    timeseries: Mapping,
+    molecule_index: int = 0,
+    n_snapshots: int = 4,
+    out_path: str = "out/field_snapshots.png",
+    locations: Optional[np.ndarray] = None,
+    dx: float = 1.0,
+) -> str:
+    """Lattice field heatmaps at evenly spaced times (+ optional cell
+    overlay) — the reference's lattice snapshot/animation plot."""
+
+    def overlay(ax, s, k):
+        if locations is None:
+            return
+        alive = np.asarray(timeseries["alive"])[s].astype(bool)
+        # locations [T, N, 2] are (row, col) in um; divide by dx for
+        # bin coordinates; imshow axes are (col=x, row=y)
+        pts = np.asarray(locations)[s][alive] / dx
+        ax.scatter(pts[:, 1], pts[:, 0], s=2, c="red", alpha=0.6)
+
+    return _snapshot_grid(
+        timeseries, molecule_index, n_snapshots, out_path, overlay
+    )
+
+
+def plot_species_snapshots(
+    timeseries: Mapping,
+    species_locations: Mapping[str, Sequence[str]] | None = None,
+    molecule_index: int = 0,
+    n_snapshots: int = 4,
+    out_path: str = "out/species_snapshots.png",
+    dx: float = 1.0,
+) -> str:
+    """Mixed-species field snapshots: one field heatmap per time with
+    EVERY species' live cells overlaid in a distinct color (the
+    reference's multi-agent-type lattice snapshot).
+
+    Expects a MultiSpeciesColony trajectory: per-species subtrees with
+    their own ``alive`` masks, plus ``fields``. ``species_locations``
+    maps species name -> path to its [T, N, 2] location leaf WITHIN the
+    species subtree (default ``("boundary", "location")`` for all).
+    """
+    plt = _plt()
+    names = [
+        k for k in timeseries.keys() if k not in ("fields", "__time__")
+    ]
+    colors = plt.cm.tab10.colors
+
+    def overlay(ax, s, k):
+        for c, name in enumerate(names):
+            sub = timeseries[name]
+            path = (
+                tuple(species_locations[name])
+                if species_locations and name in species_locations
+                else ("boundary", "location")
+            )
+            locs = get_path(sub, path)[s]
+            alive = np.asarray(sub["alive"])[s].astype(bool)
+            pts = locs[alive] / dx
+            ax.scatter(
+                pts[:, 1], pts[:, 0], s=4,
+                color=colors[c % len(colors)],
+                label=name if k == 0 else None, alpha=0.8,
+            )
+
+    return _snapshot_grid(
+        timeseries, molecule_index, n_snapshots, out_path, overlay
+    )
+
+
+def plot_expression_heatmap(
+    timeseries: Mapping,
+    gene_names: Sequence[str],
+    counts_path: Sequence[str] = ("counts", "protein"),
+    agent: int = 0,
+    out_path: str = "out/expression_heatmap.png",
+) -> str:
+    """Genes x time heatmap of one agent's expression counts — the
+    regulated-genome view (which operons are on under which media)."""
+    plt = _plt()
+    values = get_path(timeseries, counts_path)  # [T, N, G] or [T, G]
+    if values.ndim == 3:
+        values = values[:, agent, :]
+    t = _times(timeseries, values.shape[0])
+    fig, ax = plt.subplots(
+        figsize=(8, max(3.0, 0.18 * len(gene_names)))
+    )
+    im = ax.imshow(
+        values.T, aspect="auto", origin="lower", cmap="magma",
+        extent=[float(t[0]), float(t[-1]), -0.5, len(gene_names) - 0.5],
+    )
+    ax.set_yticks(range(len(gene_names)))
+    ax.set_yticklabels(gene_names, fontsize=6)
+    ax.set_xlabel("time (s)")
+    ax.set_title(SEP_TITLE.join(counts_path))
+    fig.colorbar(im, ax=ax, shrink=0.8, label="count")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
+def plot_reaction_fluxes(
+    timeseries: Mapping,
+    reaction_names: Sequence[str],
+    fluxes_path: Sequence[str] = ("fluxes", "reaction_fluxes"),
+    reactions: Sequence[str] | None = None,
+    agent: int = 0,
+    out_path: str = "out/reaction_fluxes.png",
+) -> str:
+    """Selected FBA reaction fluxes over time for one agent — the
+    metabolic-mode view (respiration vs overflow vs shunt etc.)."""
+    plt = _plt()
+    values = get_path(timeseries, fluxes_path)  # [T, N, R] or [T, R]
+    if values.ndim == 3:
+        values = values[:, agent, :]
+    t = _times(timeseries, values.shape[0])
+    wanted = list(reactions) if reactions else list(reaction_names)
+    index = {name: j for j, name in enumerate(reaction_names)}
+    unknown = [n for n in wanted if n not in index]
+    if unknown:
+        raise KeyError(
+            f"reactions {unknown} not in reaction_names "
+            f"({sorted(index)})"
+        )
+    fig, ax = plt.subplots(figsize=(8, 4.2))
+    for name in wanted:
+        ax.plot(t, values[:, index[name]], linewidth=1.1, label=name)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("flux")
+    ax.axhline(0.0, color="gray", linewidth=0.5)
+    ax.legend(fontsize=7, ncol=2)
     fig.tight_layout()
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     fig.savefig(out_path, dpi=110)
@@ -434,6 +572,9 @@ __all__ = [
     "plot_timeseries",
     "plot_colony_growth",
     "plot_field_snapshots",
+    "plot_species_snapshots",
+    "plot_expression_heatmap",
+    "plot_reaction_fluxes",
     "lineage_table",
     "ancestry",
     "plot_lineage",

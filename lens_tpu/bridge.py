@@ -50,7 +50,8 @@ class CellSimulation(Protocol):
     def generate_inner_update(self) -> Dict[str, Any]:
         """Report state for the environment: at least ``exchange``
         (molecule -> net secreted amount since last report), and
-        optionally ``volume``, ``motile_force``, ``divide`` (bool)."""
+        optionally ``volume``, ``location`` (new [2] position in um —
+        the loop applies it, clipped to the domain), ``divide`` (bool)."""
         ...
 
     def divide(self) -> Tuple["CellSimulation", "CellSimulation"]:
@@ -322,6 +323,15 @@ class HostExchangeLoop:
             self.fields = self.lattice.apply_exchanges(
                 self.fields, locations, exchange, alive
             )
+            # Motility: an inner update may report a new location (the
+            # reference's generate_inner_update carries cell geometry,
+            # SURVEY.md §3.2); clip onto the domain like the device path.
+            hi = np.asarray(self.lattice.size) - 1e-3
+            for agent, update in zip(self.agents, updates):
+                if "location" in update:
+                    agent.location = np.clip(
+                        np.asarray(update["location"], np.float64), 0.0, hi
+                    )
             self._handle_divisions(updates)
         self.fields = self.lattice.step_fields(self.fields)
         self.time = target

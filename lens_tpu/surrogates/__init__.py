@@ -89,4 +89,75 @@ class GrowDivideSurrogate:
         self.finalized = True
 
 
-__all__ = ["ConstantUptakeSurrogate", "GrowDivideSurrogate"]
+class ChemotaxisSurrogate:
+    """Run/tumble motility chasing an attractant gradient — the
+    reference's chemotaxis surrogate, host-path edition.
+
+    Temporal sensing like the real machinery's logic, minus all
+    biochemistry: keep heading while the local attractant concentration
+    rises (run), draw a fresh random heading when it falls (tumble).
+    Reports its new ``location`` each window (the host loop applies and
+    clips it).
+    """
+
+    def __init__(
+        self,
+        location,
+        molecule: str = "glucose",
+        speed: float = 1.0,
+        seed: int = 0,
+        domain=None,
+    ):
+        self.location = np.asarray(location, np.float64)
+        self.molecule = molecule
+        self.speed = float(speed)
+        # Physical domain (h, w) in um: the sim clips its OWN location so
+        # its internal position never desyncs from the loop-clipped agent
+        # (otherwise a wall-pinned cell keeps integrating outward and its
+        # temporal sensing compares concentrations against motion it
+        # never made).
+        self.domain = (
+            np.asarray(domain, np.float64) if domain is not None else None
+        )
+        self._rng = np.random.default_rng(seed)
+        theta = self._rng.uniform(0.0, 2.0 * np.pi)
+        self._heading = np.asarray([np.cos(theta), np.sin(theta)])
+        self._last = None
+        self._local = 0.0
+        self.time = 0.0
+
+    def apply_outer_update(self, update: Mapping[str, Any]) -> None:
+        self._local = float(update.get(self.molecule, 0.0))
+
+    def run_incremental(self, run_until: float) -> None:
+        dt = run_until - self.time
+        if self._last is not None and self._local < self._last:
+            theta = self._rng.uniform(0.0, 2.0 * np.pi)  # tumble
+            self._heading = np.asarray([np.cos(theta), np.sin(theta)])
+        self._last = self._local
+        self.location = self.location + self.speed * dt * self._heading
+        if self.domain is not None:
+            self.location = np.clip(
+                self.location, 0.0, self.domain - 1e-3
+            )
+        self.time = run_until
+
+    def generate_inner_update(self) -> Dict[str, Any]:
+        return {
+            "exchange": {},
+            "location": self.location.copy(),
+            "divide": False,
+        }
+
+    def divide(self):
+        raise NotImplementedError("this surrogate never divides")
+
+    def finalize(self) -> None:
+        pass
+
+
+__all__ = [
+    "ConstantUptakeSurrogate",
+    "GrowDivideSurrogate",
+    "ChemotaxisSurrogate",
+]

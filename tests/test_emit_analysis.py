@@ -286,3 +286,54 @@ class TestLineage:
         assert len(set(ids.tolist())) == len(ids)
         parents = np.asarray(out.colony.agents["lineage"]["parent_id"])[alive]
         assert (parents >= -1).all()
+
+
+class TestDomainPlots:
+    """Round-3 analysis breadth: mixed-species snapshots, expression
+    heatmaps, FBA flux traces (SURVEY §2 Analysis ~1000 LoC scope)."""
+
+    def test_species_snapshots(self, tmp_path):
+        from lens_tpu.analysis import plot_species_snapshots
+        from lens_tpu.models import mixed_species_lattice
+
+        multi, _ = mixed_species_lattice(
+            {"capacity": {"ecoli": 16, "scavenger": 16},
+             "shape": (16, 16), "size": (16.0, 16.0)}
+        )
+        ms = multi.initial_state(
+            {"ecoli": 8, "scavenger": 8}, jax.random.PRNGKey(0)
+        )
+        _, traj = multi.run(ms, 6.0, 1.0, emit_every=2)
+        p = plot_species_snapshots(
+            traj, n_snapshots=3, out_path=str(tmp_path / "sp.png")
+        )
+        assert os.path.getsize(p) > 1000
+
+    def test_expression_heatmap_and_fluxes(self, tmp_path):
+        from lens_tpu.analysis import (
+            plot_expression_heatmap,
+            plot_reaction_fluxes,
+        )
+        from lens_tpu.models.composites import rfba_lattice
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        spatial, comp = rfba_lattice(
+            {"capacity": 8, "shape": (8, 8), "division": False,
+             "metabolism": {"network": "ecoli_core"},
+             "expression": {"genes": "ecoli_core"}}
+        )
+        ss = spatial.initial_state(4, jax.random.PRNGKey(0))
+        _, traj = spatial.run(ss, 8.0, 1.0, emit_every=1)
+
+        genes = comp.processes["expression"].genes
+        p1 = plot_expression_heatmap(
+            traj, genes, out_path=str(tmp_path / "genes.png")
+        )
+        p = FBAMetabolism({"network": "ecoli_core"})
+        p2 = plot_reaction_fluxes(
+            traj, p.reactions,
+            reactions=["glc_pts", "oxphos_nadh", "pta_ack", "biomass"],
+            out_path=str(tmp_path / "flux.png"),
+        )
+        assert os.path.getsize(p1) > 1000
+        assert os.path.getsize(p2) > 1000
