@@ -1,0 +1,138 @@
+"""THE north-star scenario, actually executed end to end.
+
+BASELINE.json: "100,000-cell E. coli colony, 1 simulated hour, dt=1s" at
+>= 10,000 agent-steps/sec/chip. The benchmarks measure windows of it;
+this script RUNS it — 3600 simulated seconds of the 100k-cell
+mixed-species colony (config 4: two distinct process sets, one 256x256
+two-molecule lattice), with segmented emission, then writes a summary
+JSON and the standard plots.
+
+    python examples/north_star.py            # full hour on the TPU
+    python examples/north_star.py --small    # 2-minute CPU-sized check
+
+Writes NORTH_STAR.json + out/north_star_*.png.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="tiny CPU-sized variant (shape/cells/time scaled down)")
+    ap.add_argument("--out-dir", default="out")
+    args = ap.parse_args()
+
+    if args.small:
+        from lens_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lens_tpu.models.composites import mixed_species_lattice
+
+    if args.small:
+        cap_each, n_each, shape, total, seg = 256, 200, (32, 32), 120.0, 30.0
+    else:
+        cap_each, n_each, shape, total, seg = 51200, 50000, (256, 256), 3600.0, 300.0
+
+    multi, _ = mixed_species_lattice(
+        {"capacity": {"ecoli": cap_each, "scavenger": cap_each},
+         "shape": shape}
+    )
+    state = multi.initial_state(
+        {"ecoli": n_each, "scavenger": n_each}, jax.random.PRNGKey(0)
+    )
+
+    n_segments = int(round(total / seg))
+    emit_every = max(int(seg) // 10, 1)   # ~10 emits per segment
+    t_wall0 = time.perf_counter()
+    alive_series = []
+    glc_series = []
+    trajs = []
+    for k in range(n_segments):
+        t0 = time.perf_counter()
+        state, traj = multi.run(state, seg, 1.0, emit_every=emit_every)
+        jax.block_until_ready(state)
+        wall = time.perf_counter() - t0
+        alive = {
+            name: int(jnp.sum(state.species[name].alive))
+            for name in multi.species
+        }
+        glc = float(jnp.sum(state.fields[multi.lattice.index("glucose")]))
+        alive_series.append(alive)
+        glc_series.append(glc)
+        trajs.append(
+            {  # keep only small per-segment series for plotting
+                name: {"alive": np.asarray(traj[name]["alive"])}
+                for name in multi.species
+            }
+        )
+        rate = (sum(alive.values()) * seg) / wall
+        print(
+            f"segment {k + 1}/{n_segments}: sim t={int((k + 1) * seg)}s "
+            f"wall={wall:.1f}s alive={alive} ~{rate:,.0f} agent-steps/s",
+            flush=True,
+        )
+
+    wall_total = time.perf_counter() - t_wall0
+    total_agents = sum(alive_series[-1].values())
+    summary = {
+        "scenario": "north star: 100k-cell mixed colony, 1 sim hour, dt=1s"
+        if not args.small else "north star (small CPU variant)",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "sim_seconds": total,
+        "wall_seconds": round(wall_total, 1),
+        "sim_faster_than_real_time_x": round(total / wall_total, 2),
+        "final_alive": alive_series[-1],
+        "mean_agent_steps_per_sec": round(
+            sum(sum(a.values()) for a in alive_series) * seg / wall_total, 1
+        ),
+        "glucose_field_total": glc_series,
+    }
+    out_name = "NORTH_STAR.json" if not args.small else "NORTH_STAR_SMALL.json"
+    with open(out_name, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "glucose_field_total"}))
+
+    # population curves per species across the whole run
+    os.makedirs(args.out_dir, exist_ok=True)
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4))
+    for name in multi.species:
+        counts = np.concatenate(
+            [t[name]["alive"].sum(axis=1) for t in trajs]
+        )
+        ax.plot(
+            np.arange(1, len(counts) + 1) * emit_every, counts, label=name
+        )
+    ax.set_xlabel("simulated time (s)")
+    ax.set_ylabel("live cells")
+    ax.set_title(summary["scenario"])
+    ax.legend()
+    fig.tight_layout()
+    plot = os.path.join(args.out_dir, "north_star_population.png")
+    fig.savefig(plot, dpi=110)
+    print(f"plot: {plot}")
+
+
+if __name__ == "__main__":
+    main()
