@@ -333,11 +333,12 @@ def rfba_lattice(
         # fill lattice defaults for the ones the small-network defaults
         # don't name, and give the float32 LP the conditioning recipe it
         # needs at this size (see FBAMetabolism.defaults["lp_leak"]).
-        # lp_iterations=45: measured (64 random environments, CPU+TPU
-        # freeze-floor semantics) that convergence fraction and converged
-        # objectives are IDENTICAL from 40 to 60 iterations — the freeze
-        # floor turns the tail into pure cost — so 45 buys ~25% LP
-        # throughput with margin over the measured 40 floor.
+        # lp_iterations=45 is a CAP (the while-loop solve exits once the
+        # whole batch is accepted at tolerance — typically ~10 iterations
+        # on these environments): measured (64 random environments,
+        # CPU+TPU) that convergence fraction and converged objectives are
+        # IDENTICAL from 40 to 60 iterations, so 45 keeps margin over the
+        # measured 40 floor at zero typical-case cost.
         c["metabolism"] = _cfg(
             {"lp_leak": 1.5e-3, "lp_tol": 1e-4, "lp_iterations": 45},
             c["metabolism"],

@@ -149,6 +149,9 @@ class FBAMetabolism(Process):
         # kinetic Growth process also targets.
         "mass_yield": 0.3,
         "regulation_threshold": 0.05,  # mM presence threshold for rules
+        # CAP on IPM iterations, not a fixed count: the solve exits as
+        # soon as the whole vmapped batch has frozen (typically ~10
+        # iterations; the cap covers regulation-degenerate corners).
         "lp_iterations": 30,
         "lp_tol": 1e-5,
         # Steady-state leak relaxation (ops.linprog.flux_balance): 0 keeps
@@ -292,6 +295,11 @@ class FBAMetabolism(Process):
                     "_updater": "set",
                     "_divider": "copy",
                 },
+                "lp_iterations": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "copy",
+                },
             },
         }
 
@@ -385,5 +393,10 @@ class FBAMetabolism(Process):
                 "reaction_fluxes": v,
                 "growth_rate": growth,
                 "lp_converged": ok.astype(jnp.float32),
+                # IPM iterations before this agent's solve froze (the
+                # while-loop cap is config "lp_iterations"): emitted so a
+                # creeping network/conditioning problem shows up as rising
+                # iteration counts long before convergence failures do.
+                "lp_iterations": sol.iterations.astype(jnp.float32),
             },
         }

@@ -116,6 +116,54 @@ class TestStructure:
             np.asarray(eager.x), np.asarray(jitted.x), atol=1e-5
         )
 
+    def test_early_exit_is_a_fixed_point(self):
+        """Raising the iteration CAP cannot change the answer.
+
+        The while-loop solve exits when every lane freezes; a frozen
+        iterate is a fixed point of the iteration, so n_iter=45 and
+        n_iter=200 must give bitwise-identical solutions (this is the
+        property that makes the adaptive exit semantically free).
+        """
+        rng = np.random.default_rng(17)
+        c, A, b, lb, ub = random_feasible_lp(rng, m=3, r=7)
+        args = tuple(jnp.asarray(v) for v in (c, A, b, lb, ub))
+        lo = linprog_box(*args, n_iter=45)
+        hi = linprog_box(*args, n_iter=200)
+        assert bool(lo.converged)
+        np.testing.assert_array_equal(np.asarray(lo.x), np.asarray(hi.x))
+        assert int(lo.iterations) == int(hi.iterations)
+        assert int(lo.iterations) < 45  # actually exited early
+
+    def test_batched_iteration_counts_are_per_lane(self):
+        """Under vmap each lane's `iterations` stops at its own freeze."""
+        rng = np.random.default_rng(23)
+        c, A, b, lb, ub = random_feasible_lp(rng, m=3, r=7)
+        # lane 0: the feasible problem; lane 1: an infeasible variant that
+        # must burn the whole cap (freeze never triggers)
+        bs = jnp.stack([jnp.asarray(b), jnp.asarray(b) + 100.0])
+        res = jax.vmap(
+            lambda bb: linprog_box(
+                jnp.asarray(c), jnp.asarray(A), bb,
+                jnp.asarray(lb), jnp.asarray(ub), n_iter=40,
+            )
+        )(bs)
+        assert bool(res.converged[0]) and not bool(res.converged[1])
+        assert int(res.iterations[0]) < 40
+        assert int(res.iterations[1]) == 40
+        # and the easy lane's answer matches its solo (un-batched) solve
+        # to solver tolerance (vmap changes fusion/reduction order, so the
+        # freeze can land an iteration apart; near-degenerate optima then
+        # move x more than the objective, which is what tol bounds)
+        solo = linprog_box(
+            jnp.asarray(c), jnp.asarray(A), jnp.asarray(b),
+            jnp.asarray(lb), jnp.asarray(ub), n_iter=40,
+        )
+        scale = 1.0 + abs(float(solo.objective))
+        assert (
+            abs(float(res.objective[0]) - float(solo.objective)) / scale
+            < 1e-3
+        )
+
     def test_infeasible_reports_not_converged(self):
         # x1 + x2 = 10 is unreachable inside [0, 1]^2.
         c = jnp.asarray([1.0, 1.0])
