@@ -8,6 +8,10 @@ experiment layer:
 - ``run``     start an experiment from a composite name + JSON config
 - ``resume``  continue the latest checkpoint of an experiment
 - ``list``    show registered composites, processes, emitters
+- ``demo``    step ONE process standalone and plot it (the reference's
+  per-process ``__main__`` dev harness)
+- ``analyze`` render the standard offline plots for an emitted log (the
+  reference's ``lens/analysis`` scripts)
 
 Examples::
 
@@ -18,6 +22,7 @@ Examples::
         --config '{"capacity": 1024, "shape": [64, 64]}'
     python -m lens_tpu resume --composite toggle_colony --time 400 \\
         --out-dir out/exp1
+    python -m lens_tpu analyze out/exp1 --animate
 """
 
 from __future__ import annotations
@@ -89,6 +94,27 @@ def _build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("list", help="list composites, processes, emitters")
 
+    ana = sub.add_parser(
+        "analyze",
+        help="render the standard plots for an emitted experiment log "
+        "(the reference's offline analysis scripts)",
+    )
+    ana.add_argument(
+        "log", help="emit log path (emit.lens) or the experiment out-dir"
+    )
+    ana.add_argument(
+        "--out-dir", default=None, help="default: <log dir>/analysis"
+    )
+    ana.add_argument(
+        "--molecule", type=int, default=0, help="field index for snapshots"
+    )
+    ana.add_argument(
+        "--dx", type=float, default=1.0, help="um per lattice bin (overlays)"
+    )
+    ana.add_argument(
+        "--animate", action="store_true", help="also write the field GIF"
+    )
+
     demo = sub.add_parser(
         "demo",
         help="run ONE process standalone and save its timeseries plot "
@@ -139,6 +165,32 @@ def main(argv=None) -> int:
         print("composites:", ", ".join(sorted(composite_registry)))
         print("processes: ", ", ".join(sorted(process_registry)))
         print("emitters:  ", ", ".join(sorted(EMITTERS)))
+        return 0
+
+    if args.command == "analyze":
+        import os
+
+        from lens_tpu.analysis import report
+
+        log = args.log
+        if os.path.isdir(log):
+            log = os.path.join(log, "emit.lens")
+        if not os.path.exists(log):
+            print(
+                f"no emit log at {log!r} (run with --emitter log "
+                f"--out-dir <dir> to produce one)",
+                file=sys.stderr,
+            )
+            return 2
+        written = report(
+            log,
+            out_dir=args.out_dir,
+            molecule_index=args.molecule,
+            dx=args.dx,
+            animate=args.animate,
+        )
+        for name, path in sorted(written.items()):
+            print(f"{name}: {path}")
         return 0
 
     if args.command == "demo":

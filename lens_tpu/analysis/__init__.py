@@ -92,7 +92,7 @@ def plot_timeseries(
         else [
             (path, arr)
             for path, arr in flatten_leaves(timeseries)
-            if path[0] not in ("alive", "fields", "__time__")
+            if path[0] not in ("alive", "fields", "lineage", "__time__")
         ]
     )
     if not leaves:
@@ -420,13 +420,16 @@ def plot_lineage(
     timeseries: Mapping,
     out_path: str = "out/lineage.png",
     max_founders: int = 16,
+    table: Optional[Dict[int, Dict[str, Any]]] = None,
 ) -> str:
     """The lineage tree: one horizontal life-line per cell (birth -> last
     seen), vertical connectors at divisions — the reference's
     multi-generation trace, reconstructed from ids instead of per-process
-    bookkeeping."""
+    bookkeeping. Pass a prebuilt ``lineage_table`` to skip rebuilding it.
+    """
     plt = _plt()
-    table = lineage_table(timeseries)
+    if table is None:
+        table = lineage_table(timeseries)
     founders = sorted(
         cid for cid, n in table.items()
         if n["parent"] == -1 or n["parent"] not in table
@@ -483,12 +486,15 @@ def plot_generation_trace(
     path: Sequence[str],
     cell: Optional[int] = None,
     out_path: str = "out/generation_trace.png",
+    table: Optional[Dict[int, Dict[str, Any]]] = None,
 ) -> str:
     """One variable followed through a cell's whole ancestry: each
     ancestor's segment plotted over its lifetime, division times marked.
-    ``cell`` defaults to a deepest-generation cell."""
+    ``cell`` defaults to a deepest-generation cell. Pass a prebuilt
+    ``lineage_table`` to skip rebuilding it."""
     plt = _plt()
-    table = lineage_table(timeseries)
+    if table is None:
+        table = lineage_table(timeseries)
     if cell is None:
         cell = max(table, key=lambda c: table[c]["generation"])
     chain = [c for c in ancestry(table, cell) if table[c]["observed"]]
@@ -565,8 +571,120 @@ def animate_fields(
     return out_path
 
 
+# -- the standard report ------------------------------------------------------
+
+
+def report(
+    log_path: str,
+    out_dir: str | None = None,
+    molecule_index: int = 0,
+    dx: float = 1.0,
+    animate: bool = False,
+) -> Dict[str, str]:
+    """Render every standard plot a trajectory supports, auto-detected.
+
+    The reference's analysis layer is a set of per-purpose scripts run
+    against an experiment id (reconstructed SURVEY.md §3.5:
+    ``python -m lens.analysis.<script> --experiment <id>``); this is the
+    rebuild's one-stop equivalent behind ``python -m lens_tpu analyze``.
+    Looks at the emitted tree's shape — single- vs multi-species, fields
+    present, lineage present — and writes the applicable plots into
+    ``out_dir`` (default: ``<log dir>/analysis``). Returns
+    ``{plot name: written path}``.
+    """
+    header, ts = load(log_path)
+    if out_dir is None:
+        out_dir = os.path.join(os.path.dirname(log_path) or ".", "analysis")
+    written: Dict[str, str] = {}
+
+    species = {
+        name: sub
+        for name, sub in ts.items()
+        if isinstance(sub, Mapping) and "alive" in sub
+    }
+    single = "alive" in ts
+
+    def locations_of(tree: Mapping):
+        try:
+            return get_path(tree, ("boundary", "location"))
+        except (KeyError, TypeError):
+            return None
+
+    if single:
+        written["colony_growth"] = plot_colony_growth(
+            ts, out_path=os.path.join(out_dir, "colony_growth.png")
+        )
+        written["timeseries"] = plot_timeseries(
+            ts, out_path=os.path.join(out_dir, "timeseries.png")
+        )
+    for name, sub in species.items():
+        written[f"{name}.colony_growth"] = plot_colony_growth(
+            sub, out_path=os.path.join(out_dir, f"{name}_colony_growth.png")
+        )
+        written[f"{name}.timeseries"] = plot_timeseries(
+            sub, out_path=os.path.join(out_dir, f"{name}_timeseries.png")
+        )
+
+    if "fields" in ts:
+        if single:
+            written["field_snapshots"] = plot_field_snapshots(
+                ts,
+                molecule_index=molecule_index,
+                locations=locations_of(ts),
+                dx=dx,
+                out_path=os.path.join(out_dir, "field_snapshots.png"),
+            )
+        if species:
+            written["species_snapshots"] = plot_species_snapshots(
+                ts,
+                molecule_index=molecule_index,
+                dx=dx,
+                out_path=os.path.join(out_dir, "species_snapshots.png"),
+            )
+        if animate and single:
+            written["fields_animation"] = animate_fields(
+                ts,
+                molecule_index=molecule_index,
+                locations=locations_of(ts),
+                dx=dx,
+                out_path=os.path.join(out_dir, "fields.gif"),
+            )
+
+    if single and "lineage" in ts:
+        table = lineage_table(ts)
+        if any(n["parent"] != -1 for n in table.values()):
+            written["lineage"] = plot_lineage(
+                ts, out_path=os.path.join(out_dir, "lineage.png"),
+                table=table,
+            )
+            trace_path: Optional[Tuple[str, ...]] = next(
+                (
+                    p
+                    for p, arr in flatten_leaves(ts)
+                    if p[0] not in ("alive", "fields", "lineage", "__time__")
+                    and arr.ndim == 2
+                    and np.issubdtype(arr.dtype, np.floating)
+                ),
+                None,
+            )
+            try:  # prefer the canonical growth variable when emitted
+                get_path(ts, ("global", "mass"))
+                trace_path = ("global", "mass")
+            except (KeyError, TypeError):
+                pass
+            if trace_path is not None:
+                written["generation_trace"] = plot_generation_trace(
+                    ts,
+                    trace_path,
+                    out_path=os.path.join(out_dir, "generation_trace.png"),
+                    table=table,
+                )
+    return written
+
+
 __all__ = [
     "load",
+    "report",
     "alive_counts",
     "masked_agent_series",
     "plot_timeseries",

@@ -337,3 +337,76 @@ class TestDomainPlots:
         )
         assert os.path.getsize(p1) > 1000
         assert os.path.getsize(p2) > 1000
+
+
+class TestReport:
+    """`analysis.report` + the `analyze` CLI: the one-stop offline
+    analysis pass (the reference's per-script analysis layer, SURVEY
+    §3.5), auto-detecting what the emitted tree supports."""
+
+    def spatial_log(self, tmp_path):
+        from lens_tpu.models import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {
+                "capacity": 64,
+                "shape": (16, 16),
+                "size": (16.0, 16.0),
+                "growth": {"rate": 0.05},
+            }
+        )
+        ss = spatial.initial_state(4, jax.random.PRNGKey(1))
+        _, traj = spatial.run(ss, 40.0, 1.0, emit_every=4)
+        path = str(tmp_path / "emit.lens")
+        with LogEmitter("report-exp", path=path) as em:
+            em.emit_trajectory(traj, times=np.arange(10) * 4.0)
+        return path
+
+    def test_report_writes_applicable_plots(self, tmp_path):
+        from lens_tpu.analysis import report
+
+        written = report(self.spatial_log(tmp_path))
+        # a divided spatial colony supports the full single-species set
+        for name in (
+            "colony_growth",
+            "timeseries",
+            "field_snapshots",
+            "lineage",
+            "generation_trace",
+        ):
+            assert name in written, (name, sorted(written))
+            assert os.path.getsize(written[name]) > 1000
+        assert os.path.dirname(written["colony_growth"]).endswith("analysis")
+
+    def test_report_multispecies(self, tmp_path):
+        from lens_tpu.analysis import report
+        from lens_tpu.models import mixed_species_lattice
+
+        multi, _ = mixed_species_lattice(
+            {"capacity": {"ecoli": 16, "scavenger": 16},
+             "shape": (16, 16), "size": (16.0, 16.0)}
+        )
+        ms = multi.initial_state(
+            {"ecoli": 8, "scavenger": 8}, jax.random.PRNGKey(0)
+        )
+        _, traj = multi.run(ms, 6.0, 1.0, emit_every=2)
+        path = str(tmp_path / "emit.lens")
+        with LogEmitter("ms-exp", path=path) as em:
+            em.emit_trajectory(traj, times=np.arange(3) * 2.0)
+        written = report(path, out_dir=str(tmp_path / "plots"))
+        for name in (
+            "ecoli.colony_growth",
+            "scavenger.timeseries",
+            "species_snapshots",
+        ):
+            assert name in written
+            assert os.path.getsize(written[name]) > 1000
+
+    def test_analyze_cli(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        path = self.spatial_log(tmp_path)
+        rc = main(["analyze", str(tmp_path)])  # dir form -> dir/emit.lens
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "colony_growth" in out and "analysis" in out
