@@ -164,8 +164,9 @@ def config_3b():
     rate, elapsed = _measure(build, n)
     return {
         "config": "3b",
-        "scenario": "1k agents, ecoli_core rFBA LP (24x35, 60-iter IPM) + "
-        "32-gene expression per agent per step, 64x64 lattice, division",
+        "scenario": "1k agents, ecoli_core rFBA LP (24x35, adaptive IPM, "
+        "45-iter cap) + 32-gene expression per agent per step, "
+        "64x64 lattice, division",
         "metric": "agent-steps/sec",
         "value": round(rate, 1),
     }

@@ -1,0 +1,39 @@
+"""On-device (real TPU) test session setup.
+
+These tests are OPT-IN: the default suite (`tests/`, pyproject
+``testpaths``) pins the CPU platform because this box's TPU relay can
+hang backend init (see lens_tpu.utils.platform). Run these explicitly
+when the chip is reachable::
+
+    LENS_TPU_DEVICE_TESTS=1 python -m pytest tests_tpu/ -q
+
+Collection itself never initializes a backend, so a down relay cannot
+wedge pytest — the guard skips before any jax device use.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("LENS_TPU_DEVICE_TESTS"):
+        return
+    skip = pytest.mark.skip(
+        reason="on-device TPU tests are opt-in: set LENS_TPU_DEVICE_TESTS=1"
+    )
+    for item in items:
+        item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    """The real TPU device, or skip if the backend came up as CPU."""
+    import jax
+
+    devices = jax.devices()
+    if devices[0].platform not in ("tpu", "axon"):
+        pytest.skip(f"default backend is {devices[0].platform}, not TPU")
+    return devices[0]

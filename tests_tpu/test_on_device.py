@@ -1,0 +1,88 @@
+"""On-device numerics: the compiled TPU kernels, not their CPU shadows.
+
+The default suite validates every kernel in interpret/CPU mode; these
+tests re-check the claims that only hold (or only break) on real TPU
+hardware (VERDICT r2 weak #3):
+
+- the compiled Pallas diffusion kernel matches the XLA stencil on-device;
+- the float32-pinned interior-point LP converges on the ecoli_core
+  network (the bf16 default silently breaks it — the regression this
+  guards is the one measured in ops/linprog.py);
+- one full config-2 window runs on-device and stays finite.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TestPallasStencil:
+    def test_pallas_matches_xla_on_device(self, tpu_device):
+        from lens_tpu.ops.diffusion import diffuse_pallas, diffuse_xla
+
+        key = jax.random.PRNGKey(0)
+        for size in (64, 256):
+            fields = jax.random.uniform(key, (2, size, size), jnp.float32)
+            coeff = jnp.asarray([0.02, 0.07], jnp.float32)
+            out_p = jax.jit(
+                lambda f, c: diffuse_pallas(f, c, n_substeps=27)
+            )(fields, coeff)
+            out_x = jax.jit(
+                lambda f, c: diffuse_xla(f, c, n_substeps=27)
+            )(fields, coeff)
+            np.testing.assert_allclose(
+                np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-6
+            )
+            # mass conservation on-device (no-flux boundaries)
+            np.testing.assert_allclose(
+                float(jnp.sum(out_p)), float(jnp.sum(fields)), rtol=1e-5
+            )
+
+
+class TestLinprogOnDevice:
+    def test_ecoli_core_batch_converges(self, tpu_device):
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+        from lens_tpu.ops.linprog import flux_balance
+
+        proc = FBAMetabolism(
+            {"network": "ecoli_core", "lp_leak": 1.5e-3, "lp_tol": 1e-4}
+        )
+        rng = np.random.default_rng(0)
+        ext = jnp.asarray(
+            rng.uniform(0.0, 20.0, size=(256, len(proc.external))).astype(
+                np.float32
+            )
+        )
+        lbs, ubs = jax.vmap(lambda e: proc.regulated_bounds(e, 1.0))(ext)
+        sol = jax.jit(
+            jax.vmap(
+                lambda l, u: flux_balance(
+                    proc.stoichiometry, proc.objective, l, u,
+                    n_iter=45, tol=1e-4, leak=1.5e-3,
+                )
+            )
+        )(lbs, ubs)
+        sol = jax.block_until_ready(sol)
+        assert float(jnp.mean(sol.converged.astype(jnp.float32))) == 1.0
+        # the adaptive exit must actually fire on-device too
+        assert int(jnp.max(sol.iterations)) < 45
+        assert bool(jnp.all(sol.objective >= -1e-6))
+
+
+class TestFlagshipWindow:
+    def test_config2_window_finite(self, tpu_device):
+        from lens_tpu.models import ecoli_lattice
+
+        spatial, _ = ecoli_lattice({"capacity": 1024, "shape": (64, 64)})
+        ss = spatial.initial_state(1024, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, 8.0, 1.0, emit_every=8)[0]
+        )
+        out = jax.block_until_ready(window(ss))
+        assert int(jnp.sum(out.colony.alive)) >= 1024
+        for leaf in jax.tree.leaves(out.colony.agents):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                assert bool(jnp.isfinite(leaf).all())
+        assert bool(jnp.isfinite(out.fields).all())
