@@ -1,0 +1,159 @@
+"""Colony-scale diauxie on the data-layer core-carbon network.
+
+The classic Covert–Palsson regulated-FBA experiment (the reference's
+metabolism lineage, SURVEY.md §2 "Metabolism"): cells on a glucose +
+lactose lattice eat glucose first (catabolite repression gates
+``lcts_uptake`` and the lac genes), overflow acetate while doing it,
+then derepress lactose uptake when glucose runs out and finally clean up
+the secreted acetate — three growth phases from one boolean-regulated
+LP, solved per cell per second on the device.
+
+Everything here is data-layer content: the network and its regulation
+rules come from ``lens_tpu/data/ecoli_core_{species,reactions}.tsv``.
+
+    python examples/diauxie.py            # chip-sized (4k cells)
+    python examples/diauxie.py --small    # 2-minute CPU-sized check
+
+Writes DIAUXIE.json (DIAUXIE_SMALL.json for --small) + out/diauxie_*.png.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="tiny CPU-sized variant (cells/lattice/time scaled)")
+    ap.add_argument("--out-dir", default="out")
+    args = ap.parse_args()
+
+    if args.small:
+        from lens_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform(1)
+
+    import jax
+    import numpy as np
+
+    from lens_tpu.models.composites import rfba_lattice
+
+    if args.small:
+        cap, n0, shape, total, emit_every = 128, 48, (16, 16), 240.0, 8
+    else:
+        cap, n0, shape, total, emit_every = 4096, 2048, (128, 128), 900.0, 10
+
+    spatial, comp = rfba_lattice(
+        {
+            "capacity": cap,
+            "shape": shape,
+            "size": (float(shape[0]), float(shape[1])),  # 1 um bins
+            "metabolism": {"network": "ecoli_core"},
+            "expression": {"genes": "ecoli_core"},
+            # glucose AND lactose from t=0; the phases come from the
+            # regulation rules, not from a media timeline
+            "initial": {"glc": 6.0, "lcts": 6.0, "o2": 8.0, "nh4": 8.0},
+        }
+    )
+    metab = comp.processes["metabolism"]
+    mol_index = {m: i for i, m in enumerate(metab.external)}
+    rxn_index = {r: j for j, r in enumerate(metab.reactions)}
+
+    ss = spatial.initial_state(n0, jax.random.PRNGKey(0))
+    run = jax.jit(lambda s: spatial.run(s, total, 1.0, emit_every=emit_every))
+
+    t0 = time.perf_counter()
+    final, traj = jax.block_until_ready(run(ss))
+    wall = time.perf_counter() - t0
+
+    # -- phase bookkeeping ---------------------------------------------------
+    fields = np.asarray(traj["fields"])                  # [T, M, H, W]
+    alive = np.asarray(traj["alive"]).astype(bool)       # [T, N]
+    fluxes = np.asarray(traj["fluxes"]["reaction_fluxes"])  # [T, N, R]
+    # scan_schedule emits AFTER each emit_every block (no t=0 frame), so
+    # frame k is sim time (k+1)*emit_every
+    t = np.arange(1, fields.shape[0] + 1) * emit_every
+
+    totals = {m: fields[:, mol_index[m]].sum(axis=(1, 2)) for m in ("glc", "lcts", "ace")}
+    f0 = np.asarray(jax.device_get(ss.fields))           # true t=0 fields
+    initial = {m: f0[mol_index[m]].sum() for m in ("glc", "lcts", "ace")}
+    mean_flux = {}
+    for r in ("glc_pts", "lcts_uptake", "pta_ack", "ace_uptake"):
+        if r in rxn_index:
+            v = fluxes[:, :, rxn_index[r]]
+            mean_flux[r] = np.ma.masked_array(v, mask=~alive).mean(axis=1).filled(0.0)
+
+    glc_gone = next(
+        (float(t[k]) for k in range(len(t)) if totals["glc"][k] < 0.05 * initial["glc"]),
+        None,
+    )
+    lcts_flux = mean_flux.get("lcts_uptake")
+    lcts_started = None
+    if lcts_flux is not None:
+        lcts_started = next(
+            (float(t[k]) for k in range(len(t)) if lcts_flux[k] > 1e-3), None
+        )
+    summary = {
+        "scenario": "colony diauxie (ecoli_core rFBA + 32-gene expression)",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "cells_initial": int(n0),
+        "cells_final": int(np.asarray(jax.device_get(final.colony.alive)).sum()),
+        "sim_seconds": total,
+        "wall_seconds": round(wall, 1),
+        "glc_total": [round(float(x), 2) for x in totals["glc"][:: max(1, len(t) // 8)]],
+        "lcts_total": [round(float(x), 2) for x in totals["lcts"][:: max(1, len(t) // 8)]],
+        "ace_total": [round(float(x), 2) for x in totals["ace"][:: max(1, len(t) // 8)]],
+        "t_glucose_exhausted": glc_gone,
+        "t_lactose_uptake_on": lcts_started,
+        "diauxie_order_ok": (
+            glc_gone is not None
+            and lcts_started is not None
+            and lcts_started >= glc_gone - emit_every
+        ),
+    }
+    record = "DIAUXIE_SMALL.json" if args.small else "DIAUXIE.json"
+    with open(record, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+
+    # -- plots ---------------------------------------------------------------
+    os.makedirs(args.out_dir, exist_ok=True)
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(2, 1, figsize=(8, 7), sharex=True)
+    for m, color in (("glc", "tab:blue"), ("lcts", "tab:orange"), ("ace", "tab:green")):
+        ax1.plot(t, totals[m], label=m, color=color)
+    ax1b = ax1.twinx()
+    ax1b.plot(t, alive.sum(axis=1), color="gray", linestyle="--", label="live cells")
+    ax1.set_ylabel("field total")
+    ax1b.set_ylabel("live cells")
+    ax1.legend(loc="center right", fontsize=8)
+    ax1.set_title("diauxie: glucose, then lactose, then the acetate it spilled")
+
+    for r, series in mean_flux.items():
+        ax2.plot(t, series, label=r)
+    if glc_gone is not None:
+        ax2.axvline(glc_gone, color="gray", linewidth=0.8, linestyle=":")
+    ax2.set_xlabel("time (s)")
+    ax2.set_ylabel("mean flux (live cells)")
+    ax2.legend(fontsize=8)
+    fig.tight_layout()
+    path = os.path.join(args.out_dir, "diauxie_phases.png")
+    fig.savefig(path, dpi=110)
+    print(f"plot: {path}")
+
+
+if __name__ == "__main__":
+    main()
