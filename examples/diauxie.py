@@ -84,7 +84,7 @@ def main() -> None:
 
     totals = {m: fields[:, mol_index[m]].sum(axis=(1, 2)) for m in ("glc", "lcts", "ace")}
     f0 = np.asarray(jax.device_get(ss.fields))           # true t=0 fields
-    initial = {m: f0[mol_index[m]].sum() for m in ("glc", "lcts", "ace")}
+    initial_glc = f0[mol_index["glc"]].sum()
     mean_flux = {}
     for r in ("glc_pts", "lcts_uptake", "pta_ack", "ace_uptake"):
         if r in rxn_index:
@@ -92,7 +92,7 @@ def main() -> None:
             mean_flux[r] = np.ma.masked_array(v, mask=~alive).mean(axis=1).filled(0.0)
 
     glc_gone = next(
-        (float(t[k]) for k in range(len(t)) if totals["glc"][k] < 0.05 * initial["glc"]),
+        (float(t[k]) for k in range(len(t)) if totals["glc"][k] < 0.05 * initial_glc),
         None,
     )
     lcts_flux = mean_flux.get("lcts_uptake")
@@ -139,7 +139,9 @@ def main() -> None:
     ax1b.plot(t, alive.sum(axis=1), color="gray", linestyle="--", label="live cells")
     ax1.set_ylabel("field total")
     ax1b.set_ylabel("live cells")
-    ax1.legend(loc="center right", fontsize=8)
+    h1, l1 = ax1.get_legend_handles_labels()
+    h2, l2 = ax1b.get_legend_handles_labels()
+    ax1.legend(h1 + h2, l1 + l2, loc="center right", fontsize=8)
     ax1.set_title("diauxie: glucose, then lactose, then the acetate it spilled")
 
     for r, series in mean_flux.items():

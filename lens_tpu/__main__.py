@@ -91,6 +91,13 @@ def _build_parser() -> argparse.ArgumentParser:
             help="shard over a device mesh, e.g. 4x2 (spatial models)",
         )
         sp.add_argument("--quiet", action="store_true")
+        sp.add_argument(
+            "--trace",
+            default=None,
+            metavar="DIR",
+            help="capture an XLA profiler trace of the run into DIR "
+            "(view with TensorBoard's profile plugin or perfetto)",
+        )
 
     sub.add_parser("list", help="list composites, processes, emitters")
 
@@ -207,9 +214,18 @@ def main(argv=None) -> int:
         print(f"plot: {out['plot']}")
         return 0
 
+    import contextlib
+
     from lens_tpu.experiment import Experiment
 
-    with Experiment(_experiment_config(args)) as exp:
+    trace_dir = args.trace
+    trace_ctx = contextlib.nullcontext()
+    if trace_dir:
+        from lens_tpu.utils.timers import xla_trace
+
+        trace_ctx = xla_trace(trace_dir)
+
+    with Experiment(_experiment_config(args)) as exp, trace_ctx:
         if args.command == "run":
             state = exp.run(verbose=not args.quiet)
         else:
@@ -219,6 +235,8 @@ def main(argv=None) -> int:
 
         alive = int(np.asarray(jax.device_get(exp.n_alive(state))))
         print(f"done: {alive} live cells")
+    if trace_dir:
+        print(f"trace: {trace_dir}")
     return 0
 
 
