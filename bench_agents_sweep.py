@@ -7,7 +7,9 @@ the lattice flagship (config-2 model) and the no-lattice toggle colony
 (config-1 model), so the knee is recorded instead of guessed.
 
 Run on the TPU:  python bench_agents_sweep.py
-Writes BENCH_AGENTS_SWEEP.json.
+CPU half:        BENCH_FORCE_CPU=1 python bench_agents_sweep.py
+Writes BENCH_AGENTS_SWEEP.json (BENCH_AGENTS_SWEEP_CPU.json when forced
+to CPU) — both halves together locate the backend crossover.
 """
 
 from __future__ import annotations
@@ -17,6 +19,15 @@ import os
 import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+
+if os.environ.get("BENCH_FORCE_CPU"):
+    # CPU pass: small colonies with tiny per-agent state are LATENCY-bound
+    # on the accelerator (measured: config-1 1k agents runs ~50x faster on
+    # host CPU than on the chip) — the sweep's job is to record the
+    # crossover, so it must be runnable on both backends.
+    from lens_tpu.utils.platform import force_cpu_platform
+
+    force_cpu_platform(1)
 
 import jax
 
@@ -82,7 +93,12 @@ def main() -> None:
                 row = {"model": name, "agents": n, "error": str(e)[:200]}
             report["results"].append(row)
             print(json.dumps(row), flush=True)
-    with open("BENCH_AGENTS_SWEEP.json", "w") as f:
+    out = (
+        "BENCH_AGENTS_SWEEP_CPU.json"
+        if os.environ.get("BENCH_FORCE_CPU")
+        else "BENCH_AGENTS_SWEEP.json"
+    )
+    with open(out, "w") as f:
         json.dump(report, f, indent=2)
 
 
