@@ -4,10 +4,13 @@ SURVEY.md §7 step 5: "benchmark kernel vs pure-XLA baseline (keep
 whichever wins at v1)". This script produces the recorded decision for
 ``ops.diffusion.diffuse(impl="auto")``:
 
-- times both implementations at 64^2 / 256^2 / 1024^2 (3 molecules,
-  a realistic exchange-window substep count per size);
-- asserts the two paths agree numerically ON DEVICE (same adds, same
-  order — tests only checked interpret mode before);
+- times the implementations at 64^2 / 256^2 / 1024^2 / 2048^2 (3
+  molecules, a realistic exchange-window substep count per size): the
+  whole-slab kernel while it fits VMEM, plus the halo-overlap tiled
+  kernel (``diffuse_pallas_tiled``) at every size it supports — the
+  beyond-VMEM contender;
+- asserts every path agrees with XLA numerically ON DEVICE (same adds,
+  same order — tests only checked interpret mode before);
 - writes ``BENCH_DIFFUSION_AB.json`` with the winner per size.
 
 Run on the TPU:  python bench_diffusion_ab.py
@@ -27,12 +30,14 @@ import numpy as np
 
 from lens_tpu.ops.diffusion import (
     _fits_vmem,
+    _tile_rows,
     diffuse_pallas,
+    diffuse_pallas_tiled,
     diffuse_xla,
     stable_substeps,
 )
 
-SIZES = (64, 256, 1024)
+SIZES = (64, 256, 1024, 2048)
 M = 3
 REPEATS = 5
 #: windows chained INSIDE one jit call: the tunneled chip has ~3 ms of
@@ -85,6 +90,7 @@ def main() -> None:
         }
         t_xla = time_fn(xla, fields)
         row["xla_ms"] = round(t_xla * 1e3, 4)
+        best = ("xla", t_xla)
         if row["fits_vmem"]:
             t_pallas = time_fn(pallas, fields)
             row["pallas_ms"] = round(t_pallas * 1e3, 4)
@@ -96,10 +102,28 @@ def main() -> None:
                 atol=1e-6,
             )
             row["numerics_match"] = True
-            row["winner"] = "pallas" if t_pallas < t_xla else "xla"
+            if t_pallas < best[1]:
+                best = ("pallas", t_pallas)
             row["speedup_pallas_over_xla"] = round(t_xla / t_pallas, 3)
-        else:
-            row["winner"] = "xla (pallas slab exceeds VMEM budget)"
+        # beyond-VMEM contender: halo-overlap row tiling
+        if _tile_rows(n, n, n_sub, 4) is not None and n_sub + 8 <= n:
+            tiled = chain(lambda f: diffuse_pallas_tiled(f, alpha, n_sub))
+            tiled_once = jax.jit(
+                lambda f: diffuse_pallas_tiled(f, alpha, n_sub)
+            )
+            t_tiled = time_fn(tiled, fields)
+            row["pallas_tiled_ms"] = round(t_tiled * 1e3, 4)
+            np.testing.assert_allclose(
+                np.asarray(tiled_once(fields)),
+                np.asarray(xla_once(fields)),
+                rtol=1e-6,
+                atol=1e-6,
+            )
+            row["tiled_numerics_match"] = True
+            if t_tiled < best[1]:
+                best = ("pallas_tiled", t_tiled)
+            row["speedup_tiled_over_xla"] = round(t_xla / t_tiled, 3)
+        row["winner"] = best[0]
         report["results"].append(row)
         print(json.dumps(row), flush=True)
 
