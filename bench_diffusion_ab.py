@@ -135,7 +135,7 @@ def main() -> None:
     from lens_tpu.models.composites import ecoli_lattice
 
     in_context = {}
-    for impl in ("pallas", "xla"):
+    for impl in ("pallas", "xla", "adi"):
         n_agents = 10240
         spatial, _ = ecoli_lattice({"capacity": n_agents})
         spatial.lattice.impl = impl
@@ -150,8 +150,13 @@ def main() -> None:
         in_context[impl] = round(n_agents * 32.0 / dt, 1)
         print(json.dumps({"in_context_config2": impl, "agent_steps_per_sec": in_context[impl]}), flush=True)
     report["in_context_config2_agent_steps_per_sec"] = in_context
+    winner = max(in_context, key=in_context.get)
+    report["in_context_winner"] = winner
     report["auto_decision"] = (
-        "pallas when the slab fits VMEM (in-context winner), xla otherwise"
+        f"measured in-context winner: {winner}. `auto` currently routes "
+        f"pallas-when-fits-VMEM / xla otherwise (adi is opt-in via "
+        f"lattice impl='adi'); promote the winner to `auto` only with "
+        f"this record as evidence."
     )
 
     with open("BENCH_DIFFUSION_AB.json", "w") as f:
