@@ -95,6 +95,11 @@ class Lattice:
         positivity-preserving under secretion spikes, at a first-order
         splitting-accuracy cost the nutrient fields don't notice (tests
         pin it against the dense-substep oracle).
+
+        Sharded runs (parallel.runner) diffuse through their own
+        ppermute-halo FTCS path and do not consult ``impl`` — ADI's
+        tridiagonal solves span the full axis and have no halo
+        formulation here.
         """
         if self.impl == "adi":
             if self._adi is None:

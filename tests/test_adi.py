@@ -11,6 +11,8 @@ lattice's ``impl="adi"`` wiring end to end.
 import jax
 import jax.numpy as jnp
 import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from lens_tpu.ops.adi import (
     adi_plan,
@@ -80,6 +82,29 @@ class TestTridiagSolver:
         dense = tridiag_dense(50.0, 256)
         ref = np.linalg.solve(dense, np.asarray(d[0], np.float64))
         np.testing.assert_allclose(np.asarray(x[0]), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestTridiagProperty:
+    """Property-based: the affine-scan Thomas solver equals numpy's dense
+    solve for arbitrary (r, n, rhs) within float32 tolerance."""
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        r=st.floats(min_value=0.01, max_value=20.0),
+        n=st.integers(min_value=1, max_value=40),
+        seed=st.integers(min_value=0, max_value=2**16),
+    )
+    def test_solver_matches_dense(self, r, n, seed):
+        rng = np.random.default_rng(seed)
+        d = jnp.asarray(rng.normal(size=(1, n, 3)).astype(np.float32))
+        x = solve_tridiag(thomas_factors(np.asarray([r]), n), d, axis=1)
+        if n == 1:
+            ref = np.asarray(d[0], np.float64)  # zero operator
+        else:
+            ref = np.linalg.solve(
+                tridiag_dense(r, n), np.asarray(d[0], np.float64)
+            )
+        np.testing.assert_allclose(np.asarray(x[0]), ref, rtol=2e-4, atol=2e-4)
 
 
 class TestScheme:
