@@ -49,7 +49,7 @@ def main() -> None:
         cap, n0, shape, total, emit_every = 2048, 2048, (64, 128), 600.0, 10
 
     h_um, w_um = 10.0 * shape[0], 10.0 * shape[1]
-    spatial, comp = chemotaxis_lattice(
+    spatial, _ = chemotaxis_lattice(
         {
             "capacity": cap,
             "shape": shape,
@@ -58,14 +58,7 @@ def main() -> None:
             # measures taxis, not growth
         }
     )
-    receptor = comp.processes["receptor"]
-
-    ss = spatial.initial_state(n0, jax.random.PRNGKey(0))
-    # attractant ramp rising to the right, spanning the receptor's
-    # sensitive range; cells start in the left quarter
-    w = shape[1]
-    ramp = jnp.linspace(0.02, 1.0, w)[None, None, :]
-    ss = ss._replace(fields=jnp.broadcast_to(ramp, ss.fields.shape) * 1.0)
+    # cells start in the left quarter of the domain
     rng = np.random.default_rng(1)
     locs = np.stack(
         [
@@ -74,11 +67,13 @@ def main() -> None:
         ],
         axis=1,
     ).astype(np.float32)
-    agents = dict(ss.colony.agents)
-    boundary = dict(agents["boundary"])
-    boundary["location"] = jnp.asarray(locs)
-    agents["boundary"] = boundary
-    ss = ss._replace(colony=ss.colony._replace(agents=agents))
+    ss = spatial.initial_state(
+        n0, jax.random.PRNGKey(0), locations=jnp.asarray(locs)
+    )
+    # attractant ramp rising to the right, spanning the receptor's
+    # sensitive range
+    ramp = jnp.linspace(0.02, 1.0, shape[1])[None, None, :]
+    ss = ss._replace(fields=jnp.broadcast_to(ramp, ss.fields.shape) * 1.0)
 
     run = jax.jit(lambda s: spatial.run(s, total, 1.0, emit_every=emit_every))
     t0 = time.perf_counter()

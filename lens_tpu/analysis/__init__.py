@@ -597,8 +597,13 @@ def report(
         out_dir = os.path.join(os.path.dirname(log_path) or ".", "analysis")
     written: Dict[str, str] = {}
 
+    # Species subtrees do not carry the top-level __time__ leaf; inject it
+    # so per-species plots (growth, timeseries, lineage) share the real
+    # time axis instead of falling back to emit indices.
     species = {
-        name: sub
+        name: (
+            dict(sub, __time__=ts["__time__"]) if "__time__" in ts else sub
+        )
         for name, sub in ts.items()
         if isinstance(sub, Mapping) and "alive" in sub
     }
@@ -648,6 +653,17 @@ def report(
                 locations=locations_of(ts),
                 dx=dx,
                 out_path=os.path.join(out_dir, "fields.gif"),
+            )
+
+    for name, sub in species.items():
+        if "lineage" not in sub:
+            continue
+        sp_table = lineage_table(sub)
+        if any(n["parent"] != -1 for n in sp_table.values()):
+            written[f"{name}.lineage"] = plot_lineage(
+                sub,
+                out_path=os.path.join(out_dir, f"{name}_lineage.png"),
+                table=sp_table,
             )
 
     if single and "lineage" in ts:
