@@ -75,6 +75,19 @@ class Lattice:
 
     # -- construction --------------------------------------------------------
 
+    @property
+    def alpha_window(self):
+        """Whole-window ``D*dt/dx^2`` per molecule (float64 numpy) — the
+        ONE derivation both the local ADI plan and the sharded SPIKE plan
+        factor from (so they describe the identical matrix)."""
+        import numpy as np
+
+        return (
+            np.asarray(self.diffusion, np.float64)
+            * self.timestep
+            / (self.dx * self.dx)
+        )
+
     def initial_fields(self) -> jnp.ndarray:
         h, w = self.shape
         return jnp.stack(
@@ -96,23 +109,16 @@ class Lattice:
         splitting-accuracy cost the nutrient fields don't notice (tests
         pin it against the dense-substep oracle).
 
-        Sharded runs (parallel.runner) diffuse through their own
-        ppermute-halo FTCS path and do not consult ``impl`` — ADI's
-        tridiagonal solves span the full axis and have no halo
-        formulation here.
+        Sharded runs (parallel.runner) honor ``impl="adi"`` through the
+        SPIKE distributed tridiagonal solve (parallel.adi_spike — one
+        boundary exchange per window); every other ``impl`` value routes
+        the sharded path to its own ppermute-halo FTCS.
         """
         if self.impl == "adi":
             if self._adi is None:
                 from lens_tpu.ops.adi import adi_plan
 
-                import numpy as np
-
-                alpha_window = (
-                    np.asarray(self.diffusion)
-                    * self.timestep
-                    / (self.dx * self.dx)
-                )
-                self._adi = adi_plan(alpha_window, *self.shape)
+                self._adi = adi_plan(self.alpha_window, *self.shape)
             from lens_tpu.ops.adi import diffuse_adi
 
             return diffuse_adi(fields, self._adi)

@@ -65,17 +65,60 @@ class ThomasFactors(NamedTuple):
     back_c: jnp.ndarray
 
 
-def thomas_factors(r: np.ndarray, n: int) -> ThomasFactors:
+def _tridiag_diagonal(
+    r: np.ndarray, n: int, clamp_top: bool, clamp_bottom: bool
+) -> np.ndarray:
+    """The ONE definition of ``(I - r L)``'s diagonal ([m, n], float64).
+
+    Shared by the banded Thomas factorization below and the dense
+    assembler (:func:`dense_tridiag`) the SPIKE plan solves against —
+    keeping the two descriptions of the same matrix bit-identical.
+    """
+    r = np.asarray(r, np.float64).reshape(-1)
+    diag = np.full((r.shape[0], n), 1.0, np.float64) + 2.0 * r[:, None]
+    if clamp_top:
+        diag[:, 0] = 1.0 + r
+    if clamp_bottom:
+        diag[:, -1] = 1.0 + r
+    if n == 1 and clamp_top and clamp_bottom:
+        # clamped Laplacian of a length-1 axis is the zero operator (a
+        # length-1 SLICE of a distributed axis keeps 1+r / 1+2r from the
+        # writes above — its neighbors exist, they're just remote)
+        diag[:, 0] = 1.0
+    return diag
+
+
+def dense_tridiag(
+    r: float, n: int, clamp_top: bool = True, clamp_bottom: bool = True
+) -> np.ndarray:
+    """Dense ``I - r L`` for ONE molecule (float64, host) — the oracle
+    form of the matrix :func:`thomas_factors` factorizes."""
+    diag = _tridiag_diagonal(np.asarray([r]), n, clamp_top, clamp_bottom)[0]
+    a = np.diag(diag)
+    for i in range(1, n):
+        a[i, i - 1] = -r
+        a[i - 1, i] = -r
+    return a
+
+
+def thomas_factors(
+    r: np.ndarray,
+    n: int,
+    clamp_top: bool = True,
+    clamp_bottom: bool = True,
+) -> ThomasFactors:
     """Factor ``(I - r L)`` for each molecule's ``r`` (L = clamped 1D
-    Laplacian of length ``n``). Host-side, float64."""
+    Laplacian of length ``n``). Host-side, float64.
+
+    ``clamp_top``/``clamp_bottom`` mark which ends carry the Neumann
+    clamp (diag ``1 + r``). A shard that owns an INTERIOR slice of a
+    distributed axis has ordinary ``1 + 2r`` end rows instead — its
+    neighbors' coupling is handled by the SPIKE interface correction
+    (parallel.adi_spike), not by the local matrix.
+    """
     r = np.asarray(r, np.float64).reshape(-1)
     m = r.shape[0]
-    diag = np.full((m, n), 1.0, np.float64) + 2.0 * r[:, None]
-    diag[:, 0] = 1.0 + r
-    diag[:, -1] = 1.0 + r
-    if n == 1:
-        # clamped Laplacian of a length-1 axis is the zero operator
-        diag[:, 0] = 1.0
+    diag = _tridiag_diagonal(r, n, clamp_top, clamp_bottom)
     lower = -r[:, None] * np.ones((m, n), np.float64)  # a_i (i>0)
     upper = -r[:, None] * np.ones((m, n), np.float64)  # c_i (i<n-1)
 
