@@ -50,6 +50,32 @@ class ShardedRunnerBase:
 
     # shared machinery -------------------------------------------------------
 
+    def _diffuse_strip(self, strip, axis_name: str, n_shards: int):
+        """Diffuse a sharded field strip per the lattice's ``impl``:
+        ppermute-halo FTCS by default, SPIKE distributed tridiagonal ADI
+        when the lattice opted into ``impl="adi"`` (one boundary exchange
+        per window instead of a ppermute pair per substep; equals the
+        unsharded ADI step to float rounding). Runs inside shard_map.
+        """
+        lattice = self._lattice()
+        if lattice.impl == "adi":
+            from lens_tpu.parallel.adi_spike import diffuse_adi_sharded
+
+            plan = getattr(self, "_spike_plan_cache", None)
+            if plan is None:
+                from lens_tpu.parallel.adi_spike import spike_plan
+
+                plan = spike_plan(
+                    lattice.alpha_window, *lattice.shape, n_shards=n_shards
+                )
+                self._spike_plan_cache = plan
+            return diffuse_adi_sharded(strip, plan, axis_name)
+        from lens_tpu.parallel.halo import diffuse_halo
+
+        return diffuse_halo(
+            strip, lattice.alpha, lattice.n_substeps, axis_name, n_shards
+        )
+
     def step_fn(self, example, timestep: float):
         """Build the jitted shard_map step for states shaped like
         ``example``."""

@@ -243,12 +243,9 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
                 step=cs.step + 1,
             )
 
-        # 5. diffusion on the strip with ppermute halos, once
-        from lens_tpu.parallel.halo import diffuse_halo
-
-        strip = diffuse_halo(
-            strip, lattice.alpha, lattice.n_substeps, SPACE_AXIS, self.n_space
-        )
+        # 5. diffusion on the strip, once (halo FTCS, or SPIKE ADI when
+        # the lattice opted in — see ShardedRunnerBase._diffuse_strip)
+        strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
         return MultiSpeciesState(species=stepped, fields=strip)
 
     # -- ShardedRunnerBase hooks --------------------------------------------

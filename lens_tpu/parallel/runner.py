@@ -186,34 +186,10 @@ class ShardedSpatialColony(ShardedRunnerBase):
             step=cs.step + 1,
         )
 
-        # 5. diffusion on the strip: ppermute-halo FTCS, or — when the
-        # lattice opted into ADI — the SPIKE distributed tridiagonal step
-        # (one boundary exchange per window instead of a ppermute pair
-        # per substep; equals unsharded ADI up to float rounding)
-        if lattice.impl == "adi":
-            from lens_tpu.parallel.adi_spike import diffuse_adi_sharded
-
-            strip = diffuse_adi_sharded(strip, self._spike_plan(), SPACE_AXIS)
-        else:
-            from lens_tpu.parallel.halo import diffuse_halo
-
-            strip = diffuse_halo(
-                strip, lattice.alpha, lattice.n_substeps, SPACE_AXIS, self.n_space
-            )
+        # 5. diffusion on the strip (halo FTCS, or SPIKE ADI when the
+        # lattice opted in — see ShardedRunnerBase._diffuse_strip)
+        strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
         return SpatialState(colony=cs, fields=strip)
-
-    def _spike_plan(self):
-        """Cached distributed-ADI plan (host-built, trace-time constant)."""
-        plan = getattr(self, "_spike_plan_cache", None)
-        if plan is None:
-            from lens_tpu.parallel.adi_spike import spike_plan
-
-            lattice = self.spatial.lattice
-            plan = spike_plan(
-                lattice.alpha_window, *lattice.shape, n_shards=self.n_space
-            )
-            self._spike_plan_cache = plan
-        return plan
 
     # -- ShardedRunnerBase hooks --------------------------------------------
 

@@ -305,6 +305,46 @@ class TestSpikeDistributed:
         )
 
 
+    def test_sharded_multispecies_with_adi(self):
+        """The mixed-species runner shares the same _diffuse_strip
+        dispatch: deterministic config, sharded ADI == unsharded ADI."""
+        from lens_tpu.models import mixed_species_lattice
+        from lens_tpu.parallel import ShardedMultiSpeciesColony, make_mesh
+        from lens_tpu.parallel.mesh import mesh_shardings, multispecies_pspecs
+
+        def build():
+            multi, _ = mixed_species_lattice(
+                {
+                    "capacity": {"ecoli": 16, "scavenger": 16},
+                    "shape": (16, 16),
+                    "size": (16.0, 16.0),
+                    "division": False,
+                    "ecoli": {"motility": {"sigma": 0.0}},
+                    "scavenger": {"motility": {"sigma": 0.0},
+                                  "expression": None},
+                }
+            )
+            multi.lattice.impl = "adi"
+            return multi
+
+        multi = build()
+        ms0 = multi.initial_state(
+            {"ecoli": 16, "scavenger": 16}, jax.random.PRNGKey(1)
+        )
+        ref = multi.step(ms0, 1.0)
+
+        mesh = make_mesh(n_agents=4, n_space=2)
+        sharded = ShardedMultiSpeciesColony(build(), mesh)
+        ms0_sharded = jax.device_put(
+            ms0, mesh_shardings(mesh, multispecies_pspecs(ms0))
+        )
+        out = sharded.step(ms0_sharded, 1.0)
+        np.testing.assert_allclose(
+            np.asarray(out.fields), np.asarray(ref.fields),
+            rtol=5e-4, atol=5e-4,
+        )
+
+
 def get_loc(ss):
     from lens_tpu.utils.dicts import get_path
 
