@@ -73,6 +73,10 @@ def _spatial_colony(
         diffusion=diffusion,
         initial=initial,
         timestep=c["timestep"],
+        # diffusion scheme: "auto" (pallas/xla by backend), "xla",
+        # "pallas", "adi" (unconditionally stable backward-Euler split)
+        # — reaches the CLI as e.g. --config '{"impl": "adi"}'
+        impl=c.get("impl", "auto"),
     )
     spatial = SpatialColony(
         colony,
@@ -464,6 +468,7 @@ def mixed_species_lattice(
         diffusion=c["diffusion"],
         initial=c["initial"],
         timestep=c["timestep"],
+        impl=c.get("impl", "auto"),
     )
 
     def _species(compartment: Compartment, capacity: int, mols):
