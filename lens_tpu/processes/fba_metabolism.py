@@ -139,10 +139,12 @@ class FBAMetabolism(Process):
 
     defaults = {
         # A network dict (CORE_RFBA_NETWORK's shape) or the name of a
-        # packaged network, loaded via data.load_rfba_network (e.g.
-        # "ecoli_core" — the 24-metabolite x 35-reaction Covert–Palsson
-        # -style network in lens_tpu/data/ecoli_core_reactions.tsv).
-        "network": CORE_RFBA_NETWORK,
+        # packaged network loaded via data.load_rfba_network: the default
+        # "core_skeleton" is the data-layer form of CORE_RFBA_NETWORK
+        # (equivalence pinned by tests); "ecoli_core" is the
+        # 24-metabolite x 35-reaction Covert–Palsson-style network in
+        # lens_tpu/data/ecoli_core_reactions.tsv.
+        "network": "core_skeleton",
         # fg mass per unit biomass flux·s. Calibration: aerobic glucose
         # growth solves at v_bio ~ 0.8, so dm/dt ~ 0.24 fg/s doubles a
         # 330 fg cell in ~1400 s — the E. coli-ish ~23 min doubling the

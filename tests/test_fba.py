@@ -257,6 +257,36 @@ def core_states(p, env):
     return s
 
 
+class TestSkeletonNetworkIsData:
+    """The DEFAULT network is data too: core_skeleton_{species,reactions}
+    .tsv must reconstruct the inline CORE_RFBA_NETWORK dict exactly (the
+    dict stays as the documented in-code form and this equivalence pin)."""
+
+    def test_tsv_equals_inline_dict(self):
+        from lens_tpu.processes.fba_metabolism import (
+            CORE_RFBA_NETWORK,
+            FBAMetabolism,
+        )
+
+        a = FBAMetabolism()  # defaults -> "core_skeleton" via the loader
+        b = FBAMetabolism({"network": CORE_RFBA_NETWORK})
+        assert a.internal == b.internal
+        assert a.external == b.external
+        assert a.reactions == b.reactions
+        for attr in (
+            "stoichiometry", "lb", "ub", "objective",
+            "exchange_matrix", "kms", "uptake_mask",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, attr)),
+                np.asarray(getattr(b, attr)),
+                err_msg=attr,
+            )
+        assert {j: r.source for j, r in a._rules.items()} == {
+            j: r.source for j, r in b._rules.items()
+        }
+
+
 class TestEcoliCoreNetwork:
     """The 24-metabolite x 35-reaction Covert–Palsson-style network shipped
     as data (lens_tpu/data/ecoli_core_*.tsv) through data.load_rfba_network."""
