@@ -9,8 +9,8 @@ passes for glucose-like diffusivities on 10 um bins. The ADI step here
 removes the stability limit entirely: one window advances as two
 axis-split IMPLICIT solves,
 
-    (I - r_x) u*      = u_n          r = alpha * (1D second diff)
-    (I - r_y) u_{n+1} = u*
+    (I - r L_x) u*      = u_n        L_a = clamped 1D second difference
+    (I - r L_y) u_{n+1} = u*         r   = alpha = D*dt/dx^2
 
 so the cost is two tridiagonal solves instead of ~27 stencil sweeps.
 

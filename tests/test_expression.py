@@ -264,16 +264,20 @@ class TestGenomeExpression:
         s["external"]["lcts"] = jnp.asarray(10.0)  # lactose, no glucose
         key = jax.random.PRNGKey(1)
         lacz = p.genes.index("lacZ")
-        total = 0.0
-        for i in range(50):
+
+        @jax.jit
+        def step(state, i):
             upd = p.next_update(
-                1.0, s, key=jax.random.fold_in(key, i)
+                1.0, state, key=jax.random.fold_in(key, i)
             )
             counts = {
-                mol: jnp.maximum(s["counts"][mol] + d, 0.0)
+                mol: jnp.maximum(state["counts"][mol] + d, 0.0)
                 for mol, d in upd["counts"].items()
             }
-            s = dict(s, counts=counts)
+            return dict(state, counts=counts)
+
+        for i in range(50):
+            s = step(s, jnp.asarray(i))
         assert float(s["counts"]["mrna"][lacz]) >= 0.0
         assert float(jnp.sum(s["counts"]["mrna"])) > 0
         # induced: lacZ transcribed
