@@ -207,29 +207,37 @@ class TestSpikeDistributed:
         return Mesh(devices, ("space",))
 
     def test_sharded_solve_matches_unsharded(self):
+        """Random fields AND a secretion spike adjacent to a shard
+        boundary, through ONE compiled sharded solver (8 shards,
+        h_local=4): equality with the unsharded solve, conservation,
+        positivity, and interface mass transfer."""
         from jax.sharding import PartitionSpec as P
 
         from lens_tpu.parallel.adi_spike import diffuse_adi_sharded, spike_plan
         from lens_tpu.ops.adi import adi_plan, diffuse_adi
 
-        n_shards = 4
+        n_shards = 8
         m, h, w = 2, 32, 16
         alpha = np.asarray([6.0, 1.3])
+        plan = spike_plan(alpha, h, w, n_shards)
+        local_plan = adi_plan(alpha, h, w)
+        solver = jax.jit(
+            jax.shard_map(
+                lambda f: diffuse_adi_sharded(f, plan, "space"),
+                mesh=self._mesh(n_shards),
+                in_specs=P(None, "space", None),
+                out_specs=P(None, "space", None),
+            )
+        )
+
         fields = jax.random.uniform(
             jax.random.PRNGKey(0), (m, h, w), minval=0.0, maxval=10.0
         )
-        ref = diffuse_adi(fields, adi_plan(alpha, h, w))
-
-        plan = spike_plan(alpha, h, w, n_shards)
-        mesh = self._mesh(n_shards)
-        sharded = jax.shard_map(
-            lambda f: diffuse_adi_sharded(f, plan, "space"),
-            mesh=mesh,
-            in_specs=P(None, "space", None),
-            out_specs=P(None, "space", None),
-        )(fields)
+        sharded = solver(fields)
         np.testing.assert_allclose(
-            np.asarray(sharded), np.asarray(ref), rtol=2e-4, atol=2e-4
+            np.asarray(sharded),
+            np.asarray(diffuse_adi(fields, local_plan)),
+            rtol=2e-4, atol=2e-4,
         )
         # conservation + positivity survive the decomposition
         np.testing.assert_allclose(
@@ -238,33 +246,17 @@ class TestSpikeDistributed:
             rtol=1e-5,
         )
 
-    def test_sharded_spike_on_point_spike(self):
-        """A secretion spike NEXT TO a shard boundary: the interface
-        correction must carry it across; positivity must hold."""
-        from jax.sharding import PartitionSpec as P
-
-        from lens_tpu.parallel.adi_spike import diffuse_adi_sharded, spike_plan
-        from lens_tpu.ops.adi import adi_plan, diffuse_adi
-
-        n_shards = 8
-        m, h, w = 1, 32, 16
-        alpha = np.asarray([6.0])
-        fields = jnp.zeros((m, h, w)).at[0, 3, 8].set(100.0)  # row 3:
-        # last row of shard 0 (h_local = 4)
-        ref = diffuse_adi(fields, adi_plan(alpha, h, w))
-        plan = spike_plan(alpha, h, w, n_shards)
-        sharded = jax.shard_map(
-            lambda f: diffuse_adi_sharded(f, plan, "space"),
-            mesh=self._mesh(n_shards),
-            in_specs=P(None, "space", None),
-            out_specs=P(None, "space", None),
-        )(fields)
+        # a point spike on row 3 — the LAST row of shard 0 (h_local=4):
+        # the interface correction must carry mass across the boundary
+        spike = jnp.zeros((m, h, w)).at[0, 3, 8].set(100.0)
+        out = solver(spike)  # same compiled program, second input
         np.testing.assert_allclose(
-            np.asarray(sharded), np.asarray(ref), rtol=2e-4, atol=2e-4
+            np.asarray(out),
+            np.asarray(diffuse_adi(spike, local_plan)),
+            rtol=2e-4, atol=2e-4,
         )
-        assert float(jnp.min(sharded)) >= -1e-6
-        # mass crossed the shard-0/1 boundary (rows 4+ got some)
-        assert float(jnp.sum(sharded[:, 4:, :])) > 1.0
+        assert float(jnp.min(out)) >= -1e-6
+        assert float(jnp.sum(out[:, 4:, :])) > 1.0  # crossed the boundary
 
     def test_sharded_colony_with_adi(self):
         """ShardedSpatialColony honors lattice.impl='adi' end to end and
