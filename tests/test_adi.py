@@ -297,6 +297,32 @@ class TestSpikeDistributed:
         )
 
 
+    def test_small_four_shard_single_channel(self):
+        """The geometries the merged test no longer covers: 4 shards,
+        m=1, eager (un-jitted) shard_map, tiny field — cheap compile."""
+        from jax.sharding import PartitionSpec as P
+
+        from lens_tpu.parallel.adi_spike import diffuse_adi_sharded, spike_plan
+        from lens_tpu.ops.adi import adi_plan, diffuse_adi
+
+        n_shards, h, w = 4, 16, 8
+        alpha = np.asarray([4.0])
+        fields = jax.random.uniform(
+            jax.random.PRNGKey(5), (1, h, w), minval=0.0, maxval=5.0
+        )
+        plan = spike_plan(alpha, h, w, n_shards)
+        out = jax.shard_map(
+            lambda f: diffuse_adi_sharded(f, plan, "space"),
+            mesh=self._mesh(n_shards),
+            in_specs=P(None, "space", None),
+            out_specs=P(None, "space", None),
+        )(fields)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(diffuse_adi(fields, adi_plan(alpha, h, w))),
+            rtol=2e-4, atol=2e-4,
+        )
+
     def test_sharded_multispecies_with_adi(self):
         """The mixed-species runner shares the same _diffuse_strip
         dispatch: deterministic config, sharded ADI == unsharded ADI."""
