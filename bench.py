@@ -89,27 +89,6 @@ def _measure() -> None:
     )
 
 
-def _backend_probe_hangs(timeout: float) -> bool:
-    """Does accelerator backend init HANG (dead relay retry loop)?
-
-    Only a hang short-circuits to the CPU fallback: a probe that fails
-    FAST costs nothing to re-run in the real accel child, which then
-    captures the genuine error text for ``accel_error``. The probe adds
-    one extra backend init (~tens of seconds) to healthy runs — paid
-    once per round-end bench, to turn a 15-minute dead-relay hang into a
-    ~1-minute detour.
-    """
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True,
-            timeout=timeout,
-        )
-        return False
-    except subprocess.TimeoutExpired:
-        return True
-
-
 def _run_child(force_cpu: bool, timeout: float) -> dict:
     """Run ``bench.py --measure`` in a subprocess; parse its JSON line."""
     env = dict(os.environ)
@@ -137,7 +116,12 @@ def _run_child(force_cpu: bool, timeout: float) -> dict:
 
 
 def main() -> None:
-    if _backend_probe_hangs(_env_float("BENCH_PROBE_TIMEOUT", 90.0)):
+    # Only a HANG short-circuits to the CPU fallback: a probe that fails
+    # fast costs nothing to re-run in the real accel child, which then
+    # captures the genuine error text for `accel_error`.
+    from lens_tpu.utils.platform import backend_probe_hangs
+
+    if backend_probe_hangs(_env_float("BENCH_PROBE_TIMEOUT", 90.0)):
         row = {"error": "accelerator backend init hung (relay down?)"}
     else:
         row = _run_child(

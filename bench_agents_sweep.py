@@ -75,6 +75,9 @@ def lattice(n):
 
 
 def main() -> None:
+    from lens_tpu.utils.platform import guard_accelerator_or_exit
+
+    guard_accelerator_or_exit()
     report = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),

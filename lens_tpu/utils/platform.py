@@ -17,6 +17,53 @@ from __future__ import annotations
 import os
 
 
+def backend_probe_hangs(timeout: float = 90.0) -> bool:
+    """Does accelerator backend init HANG in this environment?
+
+    Runs ``jax.devices()`` in a throwaway child process with a timeout —
+    a dead relay blocks init in a retry loop, which is indistinguishable
+    from slow init except by waiting. Only a hang returns True; fast
+    failures return False so callers can surface the real error text.
+    Costs one extra backend init when healthy; use at the top of
+    long-running bench scripts, not in the library.
+    """
+    import subprocess
+    import sys
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=timeout,
+        )
+        return False
+    except subprocess.TimeoutExpired:
+        return True
+
+
+def guard_accelerator_or_exit() -> None:
+    """Bench-script preamble: refuse to start against a hung relay.
+
+    - ``BENCH_FORCE_CPU=1``: pin the CPU platform and return (no probe)
+      — the documented escape hatch actually forces CPU everywhere.
+    - Otherwise, if backend init hangs (``BENCH_PROBE_TIMEOUT`` seconds,
+      default 90), exit with an explanation instead of wedging; a probe
+      that fails FAST falls through so the run surfaces the real error.
+    """
+    if os.environ.get("BENCH_FORCE_CPU"):
+        force_cpu_platform(1)
+        return
+    try:
+        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90.0))
+    except ValueError:
+        timeout = 90.0
+    if backend_probe_hangs(timeout):
+        raise SystemExit(
+            "accelerator backend init hung (relay down?) — rerun when the "
+            "chip is reachable, or set BENCH_FORCE_CPU=1"
+        )
+
+
 def force_cpu_platform(n_devices: int = 1) -> bool:
     """Pin jax to the CPU platform with ``n_devices`` virtual host devices.
 
