@@ -1,3 +1,4 @@
 from lens_tpu.colony.colony import Colony, ColonyState
+from lens_tpu.colony.ensemble import Ensemble
 
-__all__ = ["Colony", "ColonyState"]
+__all__ = ["Colony", "ColonyState", "Ensemble"]

@@ -371,5 +371,9 @@ class Colony:
             out["free_rows"] = jnp.sum(~alive)
         return out
 
+    #: Uniform emit-slice name across colony forms (SpatialColony and
+    #: MultiSpeciesColony define emit_state too) — what Ensemble vmaps.
+    emit_state = emit
+
     def n_alive(self, cs: ColonyState) -> jax.Array:
         return jnp.sum(cs.alive)

@@ -258,6 +258,15 @@ class MultiSpeciesColony:
         fields = self.lattice.step_fields(fields)
         return MultiSpeciesState(species=stepped, fields=fields)
 
+    def emit_state(self, ms: MultiSpeciesState) -> dict:
+        """The emit slice for one state (per-species slices + fields)."""
+        emit = {
+            name: sp.colony.emit(ms.species[name])
+            for name, sp in self.species.items()
+        }
+        emit["fields"] = ms.fields
+        return emit
+
     def run(
         self,
         ms: MultiSpeciesState,
@@ -265,16 +274,8 @@ class MultiSpeciesColony:
         timestep: float,
         emit_every: int = 1,
     ) -> Tuple[MultiSpeciesState, dict]:
-        def emit_fn(carry):
-            emit = {
-                name: sp.colony.emit(carry.species[name])
-                for name, sp in self.species.items()
-            }
-            emit["fields"] = carry.fields
-            return emit
-
         return scan_schedule(
-            lambda c: self.step(c, timestep), emit_fn, ms,
+            lambda c: self.step(c, timestep), self.emit_state, ms,
             total_time, timestep, emit_every,
         )
 

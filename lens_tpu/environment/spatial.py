@@ -214,6 +214,12 @@ class SpatialColony:
         fields = self.lattice.step_fields(fields)
         return SpatialState(colony=cs, fields=fields)
 
+    def emit_state(self, ss: SpatialState) -> dict:
+        """The emit slice for one state (colony slice + fields)."""
+        emit = self.colony.emit(ss.colony)
+        emit["fields"] = ss.fields
+        return emit
+
     def run(
         self,
         ss: SpatialState,
@@ -221,13 +227,8 @@ class SpatialColony:
         timestep: float,
         emit_every: int = 1,
     ) -> Tuple[SpatialState, dict]:
-        def emit_fn(carry):
-            emit = self.colony.emit(carry.colony)
-            emit["fields"] = carry.fields
-            return emit
-
         return scan_schedule(
-            lambda c: self.step(c, timestep), emit_fn, ss,
+            lambda c: self.step(c, timestep), self.emit_state, ss,
             total_time, timestep, emit_every,
         )
 

@@ -257,9 +257,4 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
         return multispecies_pspecs(example)
 
     def _emit_fn(self, carry: MultiSpeciesState) -> dict:
-        emit = {
-            name: sp.colony.emit(carry.species[name])
-            for name, sp in self.multi.species.items()
-        }
-        emit["fields"] = carry.fields
-        return emit
+        return self.multi.emit_state(carry)

@@ -200,6 +200,4 @@ class ShardedSpatialColony(ShardedRunnerBase):
         return spatial_pspecs(example)
 
     def _emit_fn(self, carry: SpatialState) -> dict:
-        emit = self.spatial.colony.emit(carry.colony)
-        emit["fields"] = carry.fields
-        return emit
+        return self.spatial.emit_state(carry)

@@ -1,0 +1,145 @@
+"""Replicate ensembles: N independent colonies in one program.
+
+The replicate axis must behave like N separate runs: independent PRNG
+streams, no cross-replicate coupling, deterministic for a fixed seed —
+and the emitted trajectory gains a [T, R, ...] layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.colony import Colony, Ensemble
+from lens_tpu.models.composites import toggle_colony
+
+
+def toggle_ensemble(r=4, n=16):
+    colony = Colony(toggle_colony({}), capacity=n)
+    return Ensemble(colony, r), colony
+
+
+class TestEnsembleColony:
+    def test_shapes_and_replicate_axis(self):
+        ens, colony = toggle_ensemble()
+        states = ens.initial_state(16, key=jax.random.PRNGKey(0))
+        assert states.alive.shape == (4, 16)
+        final, traj = jax.jit(
+            lambda s: ens.run(s, 20.0, 1.0, emit_every=5)
+        )(states)
+        assert final.alive.shape == (4, 16)
+        assert traj["alive"].shape == (4, 4, 16)  # [T, R, N]
+
+    def test_replicates_diverge_stochastically(self):
+        """Different replicate keys -> different stochastic trajectories
+        (hybrid Gillespie cell; the deterministic toggle composite
+        rightly produces IDENTICAL replicates, tested elsewhere)."""
+        from lens_tpu.models.composites import hybrid_cell
+
+        colony = Colony(hybrid_cell({}), capacity=16)
+        ens = Ensemble(colony, 6)
+        states = ens.initial_state(16, key=jax.random.PRNGKey(1))
+        final, _ = jax.jit(lambda s: ens.run(s, 20.0, 1.0, emit_every=20))(
+            states
+        )
+        # molecule counts across replicates should not be identical
+        leaves = jax.tree.leaves(final.agents)
+        assert any(
+            len({np.asarray(leaf[i]).tobytes() for i in range(6)}) > 1
+            for leaf in leaves
+        )
+
+    def test_deterministic_sim_replicates_coincide(self):
+        """A deterministic composite's replicates are bitwise equal —
+        the replicate axis itself adds no spurious randomness."""
+        ens, _ = toggle_ensemble(r=3, n=8)
+        final, _ = ens.run(
+            ens.initial_state(8, key=jax.random.PRNGKey(2)), 10.0, 1.0,
+            emit_every=10,
+        )
+        for leaf in jax.tree.leaves(final.agents):
+            arr = np.asarray(leaf)
+            for r in range(1, 3):
+                np.testing.assert_array_equal(arr[r], arr[0])
+
+    def test_deterministic_for_fixed_seed(self):
+        ens, _ = toggle_ensemble()
+        run = jax.jit(lambda s: ens.run(s, 10.0, 1.0, emit_every=10)[0])
+        a = run(ens.initial_state(16, key=jax.random.PRNGKey(7)))
+        b = run(ens.initial_state(16, key=jax.random.PRNGKey(7)))
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_matches_individual_runs(self):
+        """Replicate r of the ensemble == a solo run with that replicate's
+        key: vmap adds no coupling."""
+        ens, colony = toggle_ensemble(r=3, n=8)
+        key = jax.random.PRNGKey(3)
+        states = ens.initial_state(8, key=key)
+        final, _ = ens.run(states, 8.0, 1.0, emit_every=8)
+        keys = jax.random.split(key, 3)
+        for r in range(3):
+            solo0 = colony.initial_state(8, key=keys[r])
+            solo, _ = colony.run(solo0, 8.0, 1.0, emit_every=8)
+            for le, ls in zip(
+                jax.tree.leaves(jax.tree.map(lambda x: x[r], final)),
+                jax.tree.leaves(solo),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(le), np.asarray(ls), rtol=1e-6, atol=1e-6
+                )
+
+
+class TestEnsembleSpatial:
+    def test_spatial_ensemble_with_division(self):
+        from lens_tpu.models import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {
+                "capacity": 32,
+                "shape": (16, 16),
+                "size": (16.0, 16.0),
+                "growth": {"rate": 0.05},
+            }
+        )
+        ens = Ensemble(spatial, 4)
+        states = ens.initial_state(4, key=jax.random.PRNGKey(0))
+        assert states.fields.shape == (4, 1, 16, 16)
+        final, traj = jax.jit(
+            lambda s: ens.run(s, 30.0, 1.0, emit_every=10)
+        )(states)
+        counts = np.asarray(final.colony.alive).sum(axis=1)
+        assert (counts > 4).all()  # every replicate divided
+        assert traj["fields"].shape == (3, 4, 1, 16, 16)
+        assert np.isfinite(np.asarray(traj["fields"])).all()
+        # growth statistics across the replicate axis are the point:
+        mean_pop = np.asarray(traj["alive"]).sum(axis=-1).mean(axis=1)
+        assert mean_pop[-1] > mean_pop[0]
+
+    def test_multispecies_ensemble(self):
+        """The third colony form honors the protocol too."""
+        from lens_tpu.models import mixed_species_lattice
+
+        multi, _ = mixed_species_lattice(
+            {"capacity": {"ecoli": 8, "scavenger": 8},
+             "shape": (8, 8), "size": (8.0, 8.0)}
+        )
+        ens = Ensemble(multi, 3)
+        states = ens.initial_state(
+            {"ecoli": 4, "scavenger": 4}, key=jax.random.PRNGKey(0)
+        )
+        final, traj = jax.jit(
+            lambda s: ens.run(s, 4.0, 1.0, emit_every=2)
+        )(states)
+        assert traj["fields"].shape[:2] == (2, 3)  # [T, R, ...]
+        for name in ("ecoli", "scavenger"):
+            assert np.asarray(final.species[name].alive).sum(axis=1).min() >= 4
+
+    def test_protocol_guard(self):
+        import pytest
+
+        with pytest.raises(TypeError, match="colony-form protocol"):
+            Ensemble(object(), 2)
+        with pytest.raises(ValueError, match="n_replicates"):
+            from lens_tpu.models import ecoli_lattice
+
+            Ensemble(ecoli_lattice({"capacity": 8, "shape": (8, 8)})[0], 0)
