@@ -33,6 +33,7 @@ import jax
 
 WINDOW_S = 32.0
 SIZES = (256, 1024, 4096, 16384, 65536)
+ENSEMBLE_COLONY = 1024  # agents per replicate in the ensemble rows
 
 
 def measure(build, n) -> float:
@@ -74,6 +75,26 @@ def lattice(n):
     return build
 
 
+def toggle_ensemble(n):
+    """n total agents as REPLICATES of a 1k colony: the ensemble answer
+    to the small-colony latency knee (same agent count as `toggle_colony`
+    at size n, split into n/1024 independent 1k replicates)."""
+    from lens_tpu.colony import Colony, Ensemble
+    from lens_tpu.models.composites import toggle_colony
+
+    per = ENSEMBLE_COLONY
+    ens = Ensemble(Colony(toggle_colony({}), capacity=per), n // per)
+
+    def build():
+        states = ens.initial_state(per, key=jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: ens.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return states, window
+
+    return build
+
+
 def main() -> None:
     from lens_tpu.utils.platform import guard_accelerator_or_exit
 
@@ -83,8 +104,15 @@ def main() -> None:
         "device": str(jax.devices()[0]),
         "results": [],
     }
-    for name, factory in (("toggle_colony", toggle), ("ecoli_lattice", lattice)):
+    models = (
+        ("toggle_colony", toggle),
+        ("ecoli_lattice", lattice),
+        ("toggle_ensemble_1k", toggle_ensemble),
+    )
+    for name, factory in models:
         for n in SIZES:
+            if name == "toggle_ensemble_1k" and n < ENSEMBLE_COLONY:
+                continue
             try:
                 rate = measure(factory(n), n)
                 row = {
