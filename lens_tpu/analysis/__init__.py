@@ -571,6 +571,66 @@ def animate_fields(
     return out_path
 
 
+# -- ensembles ----------------------------------------------------------------
+
+
+def ensemble_series(
+    timeseries: Mapping,
+    path: Sequence[str] | None = None,
+) -> np.ndarray:
+    """A per-replicate scalar series [T, R] from an ensemble trajectory.
+
+    Ensemble trajectories (colony.Ensemble) carry leaves shaped
+    [T, R, ...]. With ``path=None`` (default) live cells are counted per
+    replicate; otherwise ``path`` selects a [T, R, N] per-agent leaf and
+    the live-masked per-replicate mean is returned.
+    """
+    alive = np.asarray(timeseries["alive"])
+    if alive.ndim != 3:
+        raise ValueError(
+            f"expected an ensemble trajectory ([T, R, N] alive), got "
+            f"shape {alive.shape} — run via colony.Ensemble"
+        )
+    if path is None:
+        return alive_counts(timeseries)
+    return masked_agent_series(timeseries, path).mean(axis=-1).filled(np.nan)
+
+
+def plot_ensemble_fan(
+    timeseries: Mapping,
+    path: Sequence[str] | None = None,
+    out_path: str = "out/ensemble_fan.png",
+    quantiles: Tuple[float, float] = (0.1, 0.9),
+) -> str:
+    """Fan chart across the replicate axis: median, inter-quantile band,
+    and per-replicate traces — the one-compile answer to "what is the
+    distribution of growth curves?"."""
+    plt = _plt()
+    series = ensemble_series(timeseries, path)  # [T, R]
+    t = _times(timeseries, series.shape[0])
+    lo = np.nanquantile(series, quantiles[0], axis=1)
+    hi = np.nanquantile(series, quantiles[1], axis=1)
+    med = np.nanmedian(series, axis=1)
+
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    ax.plot(t, series, color="gray", alpha=0.25, linewidth=0.7)
+    ax.fill_between(t, lo, hi, alpha=0.25, label=f"q{quantiles[0]}–q{quantiles[1]}")
+    ax.plot(t, med, linewidth=1.6, label="median")
+    # trajectories straight from Ensemble.run carry no __time__ leaf —
+    # then the x axis is the emit index, and saying otherwise would
+    # compress time by emit_every*dt
+    ax.set_xlabel("time (s)" if "__time__" in timeseries else "emit index")
+    label = "live cells" if path is None else SEP_TITLE.join(path)
+    ax.set_ylabel(label)
+    ax.set_title(f"{label} across {series.shape[1]} replicates")
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
 # -- the standard report ------------------------------------------------------
 
 
@@ -701,6 +761,8 @@ def report(
 __all__ = [
     "load",
     "report",
+    "ensemble_series",
+    "plot_ensemble_fan",
     "alive_counts",
     "masked_agent_series",
     "plot_timeseries",

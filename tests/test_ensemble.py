@@ -115,6 +115,42 @@ class TestEnsembleSpatial:
         mean_pop = np.asarray(traj["alive"]).sum(axis=-1).mean(axis=1)
         assert mean_pop[-1] > mean_pop[0]
 
+    def test_ensemble_analysis_fan(self, tmp_path):
+        """analysis.ensemble_series + the fan chart consume [T, R, ...]
+        trajectories straight from Ensemble.run."""
+        import os
+
+        from lens_tpu.analysis import ensemble_series, plot_ensemble_fan
+        from lens_tpu.models import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 32, "shape": (16, 16), "size": (16.0, 16.0),
+             "growth": {"rate": 0.05}}
+        )
+        ens = Ensemble(spatial, 5)
+        states = ens.initial_state(4, key=jax.random.PRNGKey(0))
+        _, traj = jax.jit(lambda s: ens.run(s, 30.0, 1.0, emit_every=5))(
+            states
+        )
+        counts = ensemble_series(traj)
+        assert counts.shape == (6, 5)
+        assert (counts[-1] >= counts[0]).all()
+        vol = ensemble_series(traj, ("global", "volume"))
+        assert vol.shape == (6, 5) and np.isfinite(vol).all()
+        p = plot_ensemble_fan(
+            traj, out_path=str(tmp_path / "fan.png")
+        )
+        assert os.path.getsize(p) > 1000
+        # a flat [T, N] trajectory is rejected with guidance
+        import pytest
+
+        solo, straj = spatial.run(
+            spatial.initial_state(4, jax.random.PRNGKey(1)), 5.0, 1.0,
+            emit_every=5,
+        )
+        with pytest.raises(ValueError, match="Ensemble"):
+            ensemble_series(straj)
+
     def test_multispecies_ensemble(self):
         """The third colony form honors the protocol too."""
         from lens_tpu.models import mixed_species_lattice
