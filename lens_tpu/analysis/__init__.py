@@ -97,7 +97,12 @@ def plot_timeseries(
     )
     if not leaves:
         raise ValueError("nothing to plot")
-    alive = np.asarray(timeseries.get("alive", None))
+    alive = timeseries.get("alive")
+    alive_flat = (
+        np.asarray(alive).reshape(np.asarray(alive).shape[0], -1)
+        if alive is not None
+        else None
+    )
     n = len(leaves)
     cols = min(3, n)
     rows = (n + cols - 1) // cols
@@ -113,8 +118,12 @@ def plot_timeseries(
             flat = arr.reshape(arr.shape[0], -1)
             take = min(flat.shape[1], max_agents)
             data = flat[:, :take]
-            if alive is not None and alive.shape == flat.shape:
-                data = np.ma.masked_array(data, mask=~alive[:, :take].astype(bool))
+            # mask dead rows whenever the leaf flattens to the alive
+            # layout (covers both [T, N] and ensemble [T, R, N] leaves)
+            if alive_flat is not None and alive_flat.shape == flat.shape:
+                data = np.ma.masked_array(
+                    data, mask=~alive_flat[:, :take].astype(bool)
+                )
             ax.plot(t, data, alpha=0.6, linewidth=0.8)
         ax.set_title(SEP_TITLE.join(path), fontsize=9)
         ax.set_xlabel("time (s)", fontsize=8)
@@ -660,6 +669,31 @@ def report(
     # Species subtrees do not carry the top-level __time__ leaf; inject it
     # so per-species plots (growth, timeseries, lineage) share the real
     # time axis instead of falling back to emit indices.
+    # Ensemble logs (colony.Ensemble: [T, R, ...] leaves) get fan charts;
+    # the per-agent/field plots below assume [T, N] layouts. Detect both
+    # the single-colony form (top-level alive) and the multi-species form
+    # (per-species subtrees, each with its own 3-D alive).
+    def _alive_ndim(tree) -> int:
+        return np.asarray(tree["alive"]).ndim if "alive" in tree else 0
+
+    ens_species = {
+        name: sub
+        for name, sub in ts.items()
+        if isinstance(sub, Mapping) and _alive_ndim(sub) == 3
+    }
+    if _alive_ndim(ts) == 3 or ens_species:
+        targets = {"": ts} if _alive_ndim(ts) == 3 else ens_species
+        for name, sub in targets.items():
+            prefix = f"{name}_" if name else ""
+            dot = f"{name}." if name else ""
+            written[f"{dot}ensemble_fan"] = plot_ensemble_fan(
+                sub, out_path=os.path.join(out_dir, f"{prefix}ensemble_fan.png")
+            )
+            written[f"{dot}timeseries"] = plot_timeseries(
+                sub, out_path=os.path.join(out_dir, f"{prefix}timeseries.png")
+            )
+        return written
+
     species = {
         name: (
             dict(sub, __time__=ts["__time__"]) if "__time__" in ts else sub

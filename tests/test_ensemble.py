@@ -151,6 +151,54 @@ class TestEnsembleSpatial:
         with pytest.raises(ValueError, match="Ensemble"):
             ensemble_series(straj)
 
+    def test_report_handles_ensemble_logs(self, tmp_path):
+        """`analyze` on an ensemble log renders the fan chart instead of
+        crashing in the per-agent lineage/fields paths."""
+        import os
+
+        import numpy as _np
+
+        from lens_tpu.analysis import report
+        from lens_tpu.emit import LogEmitter
+        from lens_tpu.models import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 16, "shape": (8, 8), "size": (8.0, 8.0)}
+        )
+        ens = Ensemble(spatial, 3)
+        _, traj = ens.run(
+            ens.initial_state(4, key=jax.random.PRNGKey(0)), 6.0, 1.0,
+            emit_every=2,
+        )
+        path = str(tmp_path / "emit.lens")
+        with LogEmitter("ens-exp", path=path) as em:
+            em.emit_trajectory(traj, times=_np.arange(1, 4) * 2.0)
+        written = report(path, out_dir=str(tmp_path / "plots"))
+        assert "ensemble_fan" in written
+        assert os.path.getsize(written["ensemble_fan"]) > 1000
+        assert "lineage" not in written and "field_snapshots" not in written
+
+        # multi-species ensemble logs route per species, not crash
+        from lens_tpu.models import mixed_species_lattice
+
+        multi, _ = mixed_species_lattice(
+            {"capacity": {"ecoli": 8, "scavenger": 8},
+             "shape": (8, 8), "size": (8.0, 8.0)}
+        )
+        mens = Ensemble(multi, 3)
+        _, mtraj = mens.run(
+            mens.initial_state(
+                {"ecoli": 4, "scavenger": 4}, key=jax.random.PRNGKey(1)
+            ),
+            4.0, 1.0, emit_every=2,
+        )
+        mpath = str(tmp_path / "m_emit.lens")
+        with LogEmitter("mens-exp", path=mpath) as em:
+            em.emit_trajectory(mtraj, times=_np.arange(1, 3) * 2.0)
+        mw = report(mpath, out_dir=str(tmp_path / "mplots"))
+        assert "ecoli.ensemble_fan" in mw and "scavenger.ensemble_fan" in mw
+        assert "species_snapshots" not in mw
+
     def test_multispecies_ensemble(self):
         """The third colony form honors the protocol too."""
         from lens_tpu.models import mixed_species_lattice
