@@ -676,8 +676,11 @@ def report(
     def _alive_ndim(tree) -> int:
         return np.asarray(tree["alive"]).ndim if "alive" in tree else 0
 
+    def _with_time(sub):
+        return dict(sub, __time__=ts["__time__"]) if "__time__" in ts else sub
+
     ens_species = {
-        name: sub
+        name: _with_time(sub)
         for name, sub in ts.items()
         if isinstance(sub, Mapping) and _alive_ndim(sub) == 3
     }
@@ -695,9 +698,7 @@ def report(
         return written
 
     species = {
-        name: (
-            dict(sub, __time__=ts["__time__"]) if "__time__" in ts else sub
-        )
+        name: _with_time(sub)
         for name, sub in ts.items()
         if isinstance(sub, Mapping) and "alive" in sub
     }

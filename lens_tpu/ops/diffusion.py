@@ -186,8 +186,16 @@ def diffuse_pallas_tiled(
                 f"no row tile of [{h}, {w}] fields fits the VMEM budget "
                 f"with halo={halo}"
             )
-    if halo + 8 > h:  # +8: tile_h rounds up to a multiple of 8, so the
-        # last tile can overhang by up to 7 rows before its mirror halo
+    if halo + 8 > h:
+        # Mirror-index safety: a gathered index is clipped (instead of
+        # double-reflected) only when it lies >= 2h before reflection.
+        # Retained output rows have index <= h-1, and the gather is
+        # contiguous in original index space, so every clipped row sits
+        # >= h+1 rows from every retained row. Staleness from a wrong
+        # halo row travels one row per substep, so it can never reach a
+        # retained row while halo <= h - 8 < h + 1. (The last tile's
+        # round-up overhang — up to tile_h-1 rows — is discarded at
+        # scatter and already absorbed by the distance bound.)
         raise ValueError(
             f"halo {halo} too large for field height {h}: use diffuse_pallas"
         )

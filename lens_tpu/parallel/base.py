@@ -32,6 +32,7 @@ class ShardedRunnerBase:
         self.mesh = mesh
         self._step = None
         self._step_dt = None
+        self._step_key = None
         self._run_cache = {}
 
     # subclass hooks ---------------------------------------------------------
@@ -50,6 +51,22 @@ class ShardedRunnerBase:
 
     # shared machinery -------------------------------------------------------
 
+    def _lattice_key(self):
+        """Trace-relevant lattice parameters baked into compiled programs.
+
+        Tests mutate the lattice post-construction (``lattice.impl = "adi"``
+        etc.), so every compiled-program cache must be keyed on what the
+        trace closes over: the diffusion matrix (``alpha_window`` encodes
+        diffusion/timestep/dx), the scheme, the grid, and the exchange
+        scaling."""
+        lattice = self._lattice()
+        return (
+            lattice.impl,
+            lattice.alpha_window.tobytes(),
+            lattice.shape,
+            lattice.exchange_scale,
+        )
+
     def _diffuse_strip(self, strip, axis_name: str, n_shards: int):
         """Diffuse a sharded field strip per the lattice's ``impl``:
         ppermute-halo FTCS by default, SPIKE distributed tridiagonal ADI
@@ -61,14 +78,24 @@ class ShardedRunnerBase:
         if lattice.impl == "adi":
             from lens_tpu.parallel.adi_spike import diffuse_adi_sharded
 
-            plan = getattr(self, "_spike_plan_cache", None)
-            if plan is None:
+            # Cache keyed on the matrix the plan factors: tests mutate
+            # ``lattice.impl``/parameters after construction, so a bare
+            # memo would silently reuse a plan for a stale matrix.
+            key = (
+                lattice.alpha_window.tobytes(),
+                lattice.shape,
+                n_shards,
+            )
+            cached = getattr(self, "_spike_plan_cache", None)
+            if cached is None or cached[0] != key:
                 from lens_tpu.parallel.adi_spike import spike_plan
 
                 plan = spike_plan(
                     lattice.alpha_window, *lattice.shape, n_shards=n_shards
                 )
-                self._spike_plan_cache = plan
+                self._spike_plan_cache = (key, plan)
+            else:
+                plan = cached[1]
             return diffuse_adi_sharded(strip, plan, axis_name)
         from lens_tpu.parallel.halo import diffuse_halo
 
@@ -96,9 +123,17 @@ class ShardedRunnerBase:
         return jax.jit(body)
 
     def _cached_step(self, example, timestep: float):
+        key = self._lattice_key()
+        if self._step is not None and key != self._step_key:
+            # The lattice was mutated after compile: the old programs bake
+            # the old diffusion matrix/scheme into their graphs. Drop them
+            # (run programs close over the step, so they go too).
+            self._step = None
+            self._run_cache.clear()
         if self._step is None:
             self._step = self.step_fn(example, timestep)
             self._step_dt = timestep
+            self._step_key = key
         elif self._step_dt != timestep:
             raise ValueError(
                 "timestep changed between calls; rebuild via step_fn"
