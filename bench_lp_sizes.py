@@ -1,0 +1,100 @@
+"""Throughput vs network size for the batched rFBA LP (VERDICT r4 item 5).
+
+Solves one batched flux_balance step for each packaged network at several
+colony sizes and records agent-solves/s, per-agent FLOP estimates, and
+the implied utilization. The LP is O(M^3 + M^2 R) per iteration per
+agent, so the MXU payoff concentrates at reference scale — this records
+where.
+
+Writes BENCH_LP_SIZES.json {backend, rows: [{network, m, r, batch,
+solves_per_s, iters, flops_per_solve, flops_per_s}...]} and prints one
+JSON line per row. CPU-safe; runs on TPU when the relay is up
+(bench-script preamble: utils.platform.guard_accelerator_or_exit).
+"""
+
+import json
+import time
+
+import numpy as np
+
+from lens_tpu.utils.platform import guard_accelerator_or_exit
+
+
+def lp_flops(m: int, r: int, iters: float) -> float:
+    """Per-solve FLOP model: each IPM iteration forms A·D·Aᵀ (2·m²·r),
+    factors (m³/3), and runs 4 triangular solve pairs with refinement
+    (~8·m²), plus the matvec soup (~10·m·r). Two polish solves at exit."""
+    per_iter = 2.0 * m * m * r + m**3 / 3.0 + 8.0 * m * m + 10.0 * m * r
+    return per_iter * (iters + 2.0)
+
+
+def main():
+    guard_accelerator_or_exit()
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+
+    from lens_tpu.ops.linprog import flux_balance
+    from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+    rows = []
+    configs = [
+        ("core_skeleton", {"lp_tol": 1e-5, "lp_leak": 0.0, "lp_iterations": 35}),
+        ("ecoli_core", {"lp_tol": 1e-4, "lp_leak": 1.5e-3, "lp_iterations": 45}),
+        ("ecoli_core_full", {"lp_tol": 1e-5, "lp_leak": 1.5e-3, "lp_iterations": 45}),
+    ]
+    rng = np.random.default_rng(0)
+    for name, lp_cfg in configs:
+        p = FBAMetabolism({"network": name, **lp_cfg})
+        m_rows = len(p.internal)
+        n_cols = len(p.reactions) + (m_rows if lp_cfg["lp_leak"] > 0 else 0)
+        base = {"glc": 10.0, "o2": 50.0, "nh4": 50.0, "ace": 2.0}
+        for batch in (256, 1024, 4096):
+            ext = np.zeros((batch, len(p.external)), np.float32)
+            for e, mol in enumerate(p.external):
+                ext[:, e] = base.get(mol, 0.0) * rng.uniform(0.7, 1.3, batch)
+
+            def solve(e):
+                lb, ub = p.regulated_bounds(e, 1.0)
+                return flux_balance(
+                    p.stoichiometry, p.objective, lb, ub,
+                    n_iter=lp_cfg["lp_iterations"], tol=lp_cfg["lp_tol"],
+                    leak=lp_cfg["lp_leak"],
+                )
+
+            step = jax.jit(jax.vmap(solve))
+            ext_j = jnp.asarray(ext)
+            sol = step(ext_j)
+            jax.block_until_ready(sol.x)
+            n_rep = 3 if batch >= 4096 else 6
+            t0 = time.perf_counter()
+            for _ in range(n_rep):
+                sol = step(ext_j)
+            jax.block_until_ready(sol.x)
+            dt = (time.perf_counter() - t0) / n_rep
+            iters = float(np.asarray(sol.iterations).mean())
+            conv = float(np.asarray(sol.converged).mean())
+            fl = lp_flops(m_rows, n_cols, iters)
+            row = {
+                "network": name,
+                "m": m_rows,
+                "r": n_cols,
+                "batch": batch,
+                "solves_per_s": batch / dt,
+                "iters_mean": iters,
+                "converged_frac": conv,
+                "flops_per_solve": fl,
+                "flops_per_s": fl * batch / dt,
+            }
+            rows.append(row)
+            print(json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
+                              for k, v in row.items()}))
+
+    out = {"backend": backend, "rows": rows}
+    with open("BENCH_LP_SIZES.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

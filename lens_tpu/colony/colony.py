@@ -86,9 +86,17 @@ class Colony:
         compartment: Compartment,
         capacity: int,
         division_trigger: Optional[Path | str] = None,
+        id_offset: int = 0,
     ):
         self.compartment = compartment
         self.capacity = int(capacity)
+        # Static base added to every minted lineage id. 0 for a fresh
+        # colony; capacity expansion (``expanded``) sets it so that ids
+        # minted at the NEW capacity start above every id the old colony
+        # could have minted (the stride of the minting scheme changes
+        # with capacity, so without the shift old and new id ranges
+        # would interleave and collide).
+        self.id_offset = int(id_offset)
         self.division_trigger = (
             normalize_path(division_trigger) if division_trigger is not None else None
         )
@@ -225,6 +233,67 @@ class Colony:
             total_time, timestep, emit_every,
         )
 
+    # -- capacity growth -----------------------------------------------------
+
+    def expanded(
+        self, cs: ColonyState, factor: int = 2
+    ) -> Tuple["Colony", ColonyState]:
+        """Grow the colony to ``factor * capacity`` rows (host-side, at a
+        segment boundary) — the rebuild's answer to the reference's
+        unbounded process spawning (SURVEY.md §3.3: the shepherd just
+        forks more agents; a fixed-shape colony must instead re-allocate).
+
+        Returns ``(bigger_colony, padded_state)``:
+
+        - every agent leaf is padded with fresh template rows (schema
+          defaults; a future daughter overwrites every leaf on arrival,
+          so the padding never leaks into biology);
+        - ``alive``/``step``/``key`` are preserved, so the trajectory up
+          to the expansion point is bitwise identical to the unexpanded
+          run, and the next step simply sees more free rows;
+        - lineage ``row_id``/``cell_id`` padding continues the arange,
+          and the new colony's ``id_offset`` is set to the old colony's
+          id WATERMARK (the supremum of ids it could have minted through
+          ``cs.step``), so ids minted at the new stride can never
+          collide with any pre-expansion id.
+        """
+        if factor < 2:
+            raise ValueError(f"expansion factor must be >= 2, got {factor}")
+        new_cap = self.capacity * int(factor)
+        step_now = int(cs.step)
+        watermark = self.id_offset + (step_now + 1) * 2 * self.capacity
+        # Lineage ids are int32 and the minting stride is 2*capacity per
+        # step, so every expansion accelerates the march toward overflow.
+        # Fail LOUDLY here (host-side, cheap) instead of letting ids wrap
+        # negative and silently corrupt offline lineage reconstruction.
+        headroom_steps = (2**31 - 1 - watermark) // (2 * new_cap)
+        if headroom_steps < 10_000:
+            raise ValueError(
+                f"capacity expansion to {new_cap} rows leaves only "
+                f"{headroom_steps} steps of int32 lineage-id headroom "
+                f"(id watermark {watermark}); cap the colony size "
+                f"(auto_expand max_capacity) or disable division lineage"
+            )
+        grown = Colony(
+            self.compartment,
+            new_cap,
+            division_trigger=self.division_trigger,
+            id_offset=watermark - (step_now + 1) * 2 * new_cap,
+        )
+        template = grown.initial_state(0).agents
+        old_cap = self.capacity
+
+        def pad(old, tmpl):
+            return jnp.concatenate(
+                [old, tmpl[old_cap:].astype(old.dtype)], axis=0
+            )
+
+        agents = jax.tree.map(pad, cs.agents, template)
+        alive = jnp.concatenate(
+            [cs.alive, jnp.zeros(new_cap - old_cap, bool)]
+        )
+        return grown, cs._replace(agents=agents, alive=alive)
+
     # -- division ------------------------------------------------------------
 
     def _divide(
@@ -309,9 +378,12 @@ class Colony:
                 # 2*capacity per step, so id ranges are disjoint from the
                 # founders' [0, capacity) and from every other step.
                 # (int32: overflows after ~2^31/(2*capacity) steps —
-                # ~20k steps at 50k capacity, beyond typical experiments.)
+                # ~20k steps at 50k capacity; ``expanded`` re-checks the
+                # headroom on every capacity growth and fails loudly.)
                 step32 = jnp.asarray(step, jnp.int32)
-                base = (step32 + 1) * jnp.int32(2 * self.capacity)
+                base = jnp.int32(self.id_offset) + (step32 + 1) * jnp.int32(
+                    2 * self.capacity
+                )
                 row_id = lin["row_id"]
                 old_id = lin["cell_id"]
                 slot_row = row_id[jnp.clip(slot, 0, cap - 1)]

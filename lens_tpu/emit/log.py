@@ -161,11 +161,40 @@ def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     return header, records
 
 
+def _pad_stack(arrays: List[np.ndarray]) -> np.ndarray:
+    """``np.stack`` that tolerates a growing leading (agent) axis.
+
+    Capacity expansion (``Colony.expanded``) doubles the agent dimension
+    mid-experiment, so records from different segments may disagree in
+    axis 0. Shorter records are padded with zeros (``False`` for the
+    alive mask, so dead-row masking keeps working); trailing axes must
+    still agree.
+    """
+    shapes = {a.shape for a in arrays}
+    if len(shapes) == 1:
+        return np.stack(arrays)
+    trailing = {a.shape[1:] for a in arrays}
+    if len(trailing) != 1 or any(a.ndim == 0 for a in arrays):
+        raise ValueError(
+            f"cannot stack records with shapes {sorted(shapes)}: only the "
+            f"leading (agent) axis may vary across segments"
+        )
+    n_max = max(a.shape[0] for a in arrays)
+    padded = []
+    for a in arrays:
+        if a.shape[0] < n_max:
+            pad = np.zeros((n_max - a.shape[0],) + a.shape[1:], a.dtype)
+            a = np.concatenate([a, pad], axis=0)
+        padded.append(a)
+    return np.stack(padded)
+
+
 def stack_records(records: List[Mapping]) -> Dict[str, Any]:
     """Stack per-step records into one timeseries tree ([T, ...] leaves).
 
     Records must share a tree structure (the emitter guarantees this
-    within one run segment).
+    within one run segment); the leading agent axis may GROW across
+    segments (capacity expansion) — see ``_pad_stack``.
     """
     if not records:
         return {}
@@ -179,7 +208,7 @@ def stack_records(records: List[Mapping]) -> Dict[str, Any]:
                 walk([n[k] for n in node_list], sub, k)
             target[key] = sub
         else:
-            target[key] = np.stack([np.asarray(n) for n in node_list])
+            target[key] = _pad_stack([np.asarray(n) for n in node_list])
 
     for k in records[0]:
         walk([r[k] for r in records], out, k)

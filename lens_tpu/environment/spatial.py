@@ -86,6 +86,24 @@ class SpatialColony:
 
     # -- construction --------------------------------------------------------
 
+    def expanded(
+        self, ss: SpatialState, factor: int = 2
+    ) -> Tuple["SpatialColony", SpatialState]:
+        """Capacity growth for the embedded colony (host-side, segment
+        boundary): see :meth:`lens_tpu.colony.colony.Colony.expanded`.
+        The lattice and fields are untouched — only the agent rows grow
+        (padded rows are dead, parked at location 0 like every dead
+        row)."""
+        grown, cs = self.colony.expanded(ss.colony, factor)
+        spatial = SpatialColony(
+            grown,
+            self.lattice,
+            self.field_ports,
+            location_path=self.location_path,
+            share_bins=self.share_bins,
+        )
+        return spatial, ss._replace(colony=cs)
+
     def initial_state(
         self,
         n_alive: int,

@@ -62,6 +62,16 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # balanced); False keeps the contiguous row layout, making sharded
     # trajectories row-for-row comparable to unsharded ones.
     "mesh": None,
+    # Segment-boundary capacity growth (the reference grows its colony
+    # without limit by spawning processes, SURVEY.md §3.3; a fixed-shape
+    # colony re-allocates instead): when the free-row fraction drops to
+    # or below ``free_frac`` at a segment boundary, the colony is
+    # rebuilt at ``factor`` x capacity (Colony.expanded — pre-expansion
+    # trajectory bitwise unchanged, lineage ids collision-free).
+    # None disables. Requires checkpoint_every (segments) to react
+    # mid-run, and is not yet supported together with "mesh".
+    # {"free_frac": 0.2, "factor": 2, "max_capacity": None}
+    "auto_expand": None,
 }
 
 
@@ -121,6 +131,11 @@ class Experiment:
                     n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
                 ),
             )
+        if self.config["auto_expand"] and self.runner is not None:
+            raise ValueError(
+                "auto_expand is not supported with a device mesh yet "
+                "(expansion would need to re-stripe the shards)"
+            )
         self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
         self.checkpointer = (
             Checkpointer(self.config["checkpoint_dir"])
@@ -152,13 +167,15 @@ class Experiment:
         n_segments = max(int(round(total / seg)), 1)
         return seg, n_segments
 
-    def _run_segment(self, state, duration: float):
+    def _run_segment(self, state, duration: float, start_step: int):
         dt = float(self.config["timestep"])
         emit_every = int(self.config["emit_every"])
         # Timeline event times are ABSOLUTE: a checkpointed segment (or a
         # resume) starting at t>0 must continue the timeline from where
-        # the state's step counter says it is, not restart it.
-        start_time = self._state_step(state) * dt
+        # it is. ``start_step`` is host-side bookkeeping (initial step +
+        # elapsed segments) — reading the device counter here would force
+        # a sync and serialize the pipelined emission below.
+        start_time = start_step * dt
         if self.runner is not None:
             if self.config["timeline"] is not None:
                 return self.runner.run_timeline(
@@ -179,6 +196,55 @@ class Experiment:
         cs = state.colony if isinstance(state, SpatialState) else state
         return int(cs.step)
 
+    # -- capacity growth -----------------------------------------------------
+
+    def _maybe_expand(self, state):
+        """Segment-boundary capacity check: expand when free rows run low.
+
+        Host-side by design — the decision reads one scalar per segment,
+        and the re-allocation (pad + recompile at the new shape) is rare
+        and amortized over the whole next segment.
+        """
+        cfg = self.config["auto_expand"]
+        if not cfg:
+            return state
+        factor = int(cfg.get("factor", 2))
+        free_frac = float(cfg.get("free_frac", 0.2))
+        max_cap = cfg.get("max_capacity")
+        cs = state.colony if isinstance(state, SpatialState) else state
+        cap = int(cs.alive.shape[0])
+        if max_cap is not None and cap * factor > int(max_cap):
+            return state
+        free = int(np.sum(~np.asarray(jax.device_get(cs.alive))))
+        if free > free_frac * cap:
+            return state
+        if self.spatial is not None:
+            self.spatial, state = self.spatial.expanded(state, factor)
+            self.colony = self.spatial.colony
+        else:
+            self.colony, state = self.colony.expanded(state, factor)
+        return state
+
+    def _colony_meta_path(self) -> str:
+        import os
+
+        return os.path.join(self.config["checkpoint_dir"], "colony_meta.json")
+
+    def _save_colony_meta(self) -> None:
+        """Sidecar for resume: expansion changes capacity and the lineage
+        id offset, neither of which is derivable from the config alone."""
+        from lens_tpu.parallel.distributed import is_coordinator
+
+        if is_coordinator():
+            with open(self._colony_meta_path(), "w") as f:
+                json.dump(
+                    {
+                        "capacity": self.colony.capacity,
+                        "id_offset": self.colony.id_offset,
+                    },
+                    f,
+                )
+
     def run(self, state=None, verbose: bool = False):
         """Run ``total_time``, emitting and checkpointing per segment.
 
@@ -192,43 +258,89 @@ class Experiment:
         seg, n_segments = self._segment_plan()
         dt = float(self.config["timestep"])
         emit_every = int(self.config["emit_every"])
-        for k in range(n_segments):
-            t0 = time.perf_counter()
-            state, trajectory = self._run_segment(state, seg)
-            start_step = self._state_step(state) - int(round(seg / dt))
-            times = (
-                np.arange(1, int(round(seg / dt)) // emit_every + 1)
-                * emit_every
-                * dt
-                + start_step * dt
-            )
-            # Multi-host: gather shards to every host (a collective — all
-            # processes must participate), THEN only the coordinator
-            # writes. Single-host this is the identity.
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
+        # Single-host, checkpoint-free emission is PIPELINED one segment
+        # deep: segment k's trajectory starts its device->host DMA right
+        # after segment k+1 is dispatched, and the (blocking) emit
+        # happens while k+1 computes — the reference keeps emission off
+        # the hot path by putting Mongo in another process (SURVEY.md
+        # §3.5); here the overlap is dispatch-ordering + an async host
+        # copy, and ALL step bookkeeping below stays host-side so
+        # nothing forces an early device sync. With a checkpointer the
+        # strict order (emit k, then save k) is kept: the save blocks on
+        # segment k anyway, and deferring the emit past the save would
+        # let a crash drop segment k from the log while resume continues
+        # after it. Multi-host also keeps the strict order (the shard
+        # allgather is a collective).
+        pipelined = jax.process_count() == 1 and self.checkpointer is None
+        steps_per_seg = int(round(seg / dt))
+        step0 = self._state_step(state)
+        self._pending = None  # (trajectory, times) not yet emitted
+        try:
+            for k in range(n_segments):
+                t0 = time.perf_counter()
+                start_step = step0 + k * steps_per_seg
+                state, trajectory = self._run_segment(state, seg, start_step)
+                times = (
+                    np.arange(1, steps_per_seg // emit_every + 1)
+                    * emit_every
+                    * dt
+                    + start_step * dt
+                )
+                if pipelined:
+                    for leaf in jax.tree.leaves(trajectory):
+                        if hasattr(leaf, "copy_to_host_async"):
+                            leaf.copy_to_host_async()
+                    self._flush_pending()
+                    self._pending = (trajectory, times)
+                else:
+                    if jax.process_count() > 1:
+                        # Gather shards to every host (a collective — all
+                        # processes must participate), THEN only the
+                        # coordinator writes.
+                        from jax.experimental import multihost_utils
 
-                trajectory = multihost_utils.process_allgather(trajectory)
-            if is_coordinator():
-                self.emitter.emit_trajectory(trajectory, times=times)
-            if self.checkpointer is not None:
-                # Unguarded on purpose: orbax multi-host saves need every
-                # process to participate (each writes its own shards).
-                self.checkpointer.save(state, self._state_step(state))
-            if verbose:
-                # The alive count is a computation over globally sharded
-                # state — every process must dispatch it; only the print
-                # is coordinator-local.
-                alive_now = int(np.asarray(jax.device_get(self.n_alive(state))))
-                wall = time.perf_counter() - t0
-                if is_coordinator():
-                    print(
-                        f"segment {k + 1}/{n_segments}: sim t="
-                        f"{self._state_step(state) * dt:g}s  wall={wall:.2f}s  "
-                        f"alive={alive_now}"
+                        trajectory = multihost_utils.process_allgather(
+                            trajectory
+                        )
+                    if is_coordinator():
+                        self.emitter.emit_trajectory(trajectory, times=times)
+                # Expansion BEFORE the checkpoint: the saved state already
+                # has the new capacity, so resume continues expanded.
+                state = self._maybe_expand(state)
+                if self.checkpointer is not None:
+                    # Unguarded on purpose: orbax multi-host saves need
+                    # every process to participate (each writes its own
+                    # shards).
+                    self.checkpointer.save(state, self._state_step(state))
+                    self._save_colony_meta()
+                if verbose:
+                    # The alive count is a computation over globally
+                    # sharded state — every process must dispatch it; only
+                    # the print is coordinator-local.
+                    alive_now = int(
+                        np.asarray(jax.device_get(self.n_alive(state)))
                     )
-        self.emitter.flush()
+                    wall = time.perf_counter() - t0
+                    if is_coordinator():
+                        print(
+                            f"segment {k + 1}/{n_segments}: sim t="
+                            f"{self._state_step(state) * dt:g}s  "
+                            f"wall={wall:.2f}s  alive={alive_now}"
+                        )
+        finally:
+            # The trailing pipelined segment — flushed in `finally` so an
+            # exception mid-run cannot silently drop an already-computed
+            # segment from the record.
+            self._flush_pending()
+            self.emitter.flush()
         return state
+
+    def _flush_pending(self) -> None:
+        from lens_tpu.parallel.distributed import is_coordinator
+
+        pending, self._pending = getattr(self, "_pending", None), None
+        if pending is not None and is_coordinator():
+            self.emitter.emit_trajectory(pending[0], times=pending[1])
 
     def n_alive(self, state):
         cs = state.colony if isinstance(state, SpatialState) else state
@@ -243,6 +355,7 @@ class Experiment:
         if self.checkpointer is None:
             raise ValueError("resume() needs checkpoint_dir in the config")
         state = self.checkpointer.restore()
+        self._adopt_restored_capacity(state)
         done = self._state_step(state) * float(self.config["timestep"])
         remaining = float(self.config["total_time"]) - done
         if remaining <= 0:
@@ -253,6 +366,49 @@ class Experiment:
             return self.run(state, verbose=verbose)
         finally:
             self.config["total_time"] = original
+
+    def _adopt_restored_capacity(self, state) -> None:
+        """A checkpoint written after auto-expansion has more rows than
+        the config builds: rebuild the colony at the restored capacity
+        (with the sidecar's lineage id offset) before continuing. The
+        step programs are shape-polymorphic, but the id minting stride
+        is not — resuming a 2x state through a 1x colony would mint
+        colliding lineage ids."""
+        import os
+
+        cs = state.colony if isinstance(state, SpatialState) else state
+        cap = int(cs.alive.shape[0])
+        if cap == self.colony.capacity:
+            return
+        meta_path = self._colony_meta_path()
+        if not os.path.exists(meta_path):
+            raise ValueError(
+                f"checkpoint has {cap} rows but the config builds "
+                f"{self.colony.capacity}, and no colony_meta.json sidecar "
+                f"records the expansion (was the checkpoint moved?)"
+            )
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if int(meta["capacity"]) != cap:
+            raise ValueError(
+                f"colony_meta.json says capacity {meta['capacity']} but the "
+                f"checkpoint has {cap} rows"
+            )
+        grown = Colony(
+            self.colony.compartment,
+            cap,
+            division_trigger=self.colony.division_trigger,
+            id_offset=int(meta["id_offset"]),
+        )
+        if self.spatial is not None:
+            self.spatial = SpatialColony(
+                grown,
+                self.spatial.lattice,
+                self.spatial.field_ports,
+                location_path=self.spatial.location_path,
+                share_bins=self.spatial.share_bins,
+            )
+        self.colony = grown
 
     def close(self) -> None:
         self.emitter.close()

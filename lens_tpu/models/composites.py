@@ -332,6 +332,31 @@ def rfba_lattice(
         },
         config,
     )
+    if c["metabolism"].get("network") == "ecoli_core_full":
+        # The TRUE e_coli_core (72 metabolites x 95 canonical reactions,
+        # data/ecoli_core_full_*.tsv): 17 lattice fields. LP recipe per
+        # the measured float32 envelope (ops.linprog: Ruiz equilibration
+        # + pinned presolve + d-cap + weighted polish): tol 1e-5 keeps
+        # the anaerobic optimum within ~3% of the float64 solve.
+        c["metabolism"] = _cfg(
+            {"lp_leak": 1.5e-3, "lp_tol": 1e-5, "lp_iterations": 45},
+            c["metabolism"],
+        )
+        c["diffusion"] = _cfg(
+            {"glc": 600.0, "fru": 600.0, "ace": 900.0, "acald": 1000.0,
+             "akg": 700.0, "etoh": 1200.0, "for": 1400.0, "fum": 800.0,
+             "gln": 700.0, "glu": 700.0, "lac": 900.0, "mal": 800.0,
+             "nh4": 1800.0, "o2": 2000.0, "co2": 1900.0, "pyr": 900.0,
+             "succ": 800.0},
+            c["diffusion"],
+        )
+        c["initial"] = _cfg(
+            {"glc": 10.0, "fru": 0.0, "ace": 0.0, "acald": 0.0,
+             "akg": 0.0, "etoh": 0.0, "for": 0.0, "fum": 0.0, "gln": 0.0,
+             "glu": 0.0, "lac": 0.0, "mal": 0.0, "nh4": 5.0, "o2": 5.0,
+             "co2": 0.0, "pyr": 0.0, "succ": 0.0},
+            c["initial"],
+        )
     if c["metabolism"].get("network") == "ecoli_core":
         # Reference-scale network: the loader supplies 7 external species;
         # fill lattice defaults for the ones the small-network defaults
@@ -373,6 +398,8 @@ def rfba_lattice(
         "divide_trigger": {"global": ("global",)},
         "motility": {"boundary": ("boundary",)},
     }
+    if metabolism.config["lp_warm_start"]:
+        topology["metabolism"]["lp_state"] = ("lp_state",)
     if c.get("expression") is not None:
         # Metabolism + transcription in one compartment (config 3's
         # composite shape): the gene table's regulation rules read the

@@ -46,6 +46,59 @@ from jax import lax
 from jax.scipy.linalg import cho_factor, cho_solve
 
 
+class WarmStart(NamedTuple):
+    """Carryable IPM state for warm-starting a SEQUENCE of related LPs.
+
+    FBA solves one LP per agent per step, and environments change slowly,
+    so step k's optimum is an excellent guess for step k+1 (temporal
+    coherence). The warm start re-enters the barrier from an interiorized
+    copy of the previous iterate instead of the scale-based cold point,
+    cutting the decades of complementarity the IPM must burn down.
+
+    - ``x``: [R] primal in ORIGINAL coordinates (including any slack
+      columns the caller appended — thread the FULL vector back).
+    - ``y``: [M] equality duals of the row-equilibrated system (the
+      scaling is deterministic in ``A``, so it matches across calls as
+      long as ``A`` is static — the FBA case).
+    - ``z``/``w``: [R] lower/upper bound multipliers.
+    - ``flag``: scalar; ``<= 0`` means "ignore me" (cold start). The
+      returned warm state carries ``flag = converged`` so a failed solve
+      never seeds the next one.
+
+    The warm start is a HINT: the solve's acceptance tests are identical
+    either way, so it can change iteration counts but not what "converged"
+    means. Pack/unpack helpers flatten to one vector for state threading.
+    """
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    w: jnp.ndarray
+    flag: jnp.ndarray
+
+
+def warm_size(n_constraints: int, n_variables: int) -> int:
+    """Length of the packed warm-start vector."""
+    return 3 * n_variables + n_constraints + 1
+
+
+def pack_warm(ws: WarmStart) -> jnp.ndarray:
+    return jnp.concatenate(
+        [ws.x, ws.y, ws.z, ws.w, jnp.reshape(ws.flag, (1,))]
+    )
+
+
+def unpack_warm(vec: jnp.ndarray, n_constraints: int, n_variables: int) -> WarmStart:
+    r, m = n_variables, n_constraints
+    return WarmStart(
+        x=vec[:r],
+        y=vec[r : r + m],
+        z=vec[r + m : 2 * r + m],
+        w=vec[2 * r + m : 3 * r + m],
+        flag=vec[3 * r + m],
+    )
+
+
 class LPResult(NamedTuple):
     """Solution of one LP (or a batch, under vmap)."""
 
@@ -56,6 +109,7 @@ class LPResult(NamedTuple):
     converged: jnp.ndarray  # bool: gap, primal AND dual residuals below tol
     dual_residual: jnp.ndarray  # ||c - A^T y - z + w||_inf (scaled system)
     iterations: jnp.ndarray  # int32: IPM iterations this problem ran before freezing
+    warm: WarmStart         # final iterate, re-usable to seed the next solve
 
 
 class _IPState(NamedTuple):
@@ -72,6 +126,36 @@ def _max_step(v: jnp.ndarray, dv: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip(jnp.min(ratio), 0.0, 1.0)
 
 
+def _jacobi_solver(mat: jnp.ndarray, tiny):
+    """Float32-safe SPD solve: Jacobi (symmetric diagonal) scaling, a
+    unit-relative ridge, Cholesky, and one iterative-refinement pass.
+
+    Shared by the IPM iteration and the exit polish so their numerics
+    cannot drift apart. The scaling bounds the scaled diagonal at 1; the
+    ridge AFTER scaling bounds the scaled condition number at ~1/ridge —
+    the bound the float32 factorization actually needs (a pre-scaling
+    ridge gives none: the min scaled eigenvalue was measured at -6e-9 on
+    the e_coli_core normal matrix and the factorization went NaN). The
+    refinement pass absorbs the ridge bias. Returns ``solve(rhs)``
+    (reusable: one factorization, many right-hand sides).
+    """
+    dtype = mat.dtype
+    ridge = 1e-6 if dtype == jnp.float32 else 1e-12
+    dn = jnp.sqrt(jnp.maximum(jnp.diagonal(mat), tiny))
+    scaled = mat / dn[:, None] / dn[None, :] + ridge * jnp.eye(
+        mat.shape[0], dtype=dtype
+    )
+    chol = cho_factor(scaled)
+
+    def solve(rhs):
+        rhs_s = rhs / dn
+        dy = cho_solve(chol, rhs_s)
+        dy = dy + cho_solve(chol, rhs_s - scaled @ dy)
+        return dy / dn
+
+    return solve
+
+
 def linprog_box(
     c: jnp.ndarray,
     A: jnp.ndarray,
@@ -81,6 +165,7 @@ def linprog_box(
     n_iter: int = 35,
     tol: float = 1e-5,
     regularization: float = 1e-8,
+    warm: WarmStart | None = None,
 ) -> LPResult:
     """Solve ``min c@x  s.t. A@x = b, lb <= x <= ub`` (dense, batched-friendly).
 
@@ -103,11 +188,11 @@ def linprog_box(
     # far too small for the MXU's bf16 advantage to matter).
     with jax.default_matmul_precision("float32"):
         return _linprog_box_impl(
-            c, A, b, lb, ub, n_iter, tol, regularization
+            c, A, b, lb, ub, n_iter, tol, regularization, warm
         )
 
 
-def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
+def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization, warm=None):
     dtype = jnp.result_type(c.dtype, jnp.float32)
     c = jnp.asarray(c, dtype)
     A = jnp.asarray(A, dtype)
@@ -116,23 +201,58 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
     ub = jnp.asarray(ub, dtype)
     m, r = A.shape
 
-    # Row equilibration: unit inf-norm rows keep the normal equations
-    # well-conditioned in float32 (pure row scaling — the feasible set and
-    # the bounds are untouched).
+    # Ruiz equilibration (two-sided): alternately scale rows and columns
+    # toward unit inf-norm. Row-only scaling is not enough once columns
+    # span decades — a realistic biomass reaction carries coefficients
+    # from 0.07 to 59.81 (growth-associated ATP), and in float32 that
+    # column makes the normal equations unsolvable (measured on the full
+    # e_coli_core: the row-scaled solve stalls at primal residual ~3.5
+    # while float64 converges in 12 iterations; three Ruiz passes fix
+    # float32). Column scaling substitutes x = D_c x~, so bounds and
+    # objective rescale and the solution is mapped back exactly below.
+    col_scale = jnp.ones((r,), dtype)
     if m:
-        row_scale = jnp.maximum(jnp.max(jnp.abs(A), axis=1), 1e-12)
-        A = A / row_scale[:, None]
-        b = b / row_scale
+        row_scale = jnp.ones((m,), dtype)
+        absA = jnp.abs(A)
+        for _ in range(3):
+            scaled = absA * row_scale[:, None] * col_scale[None, :]
+            row_scale = row_scale / jnp.sqrt(
+                jnp.maximum(jnp.max(scaled, axis=1), 1e-12)
+            )
+            scaled = absA * row_scale[:, None] * col_scale[None, :]
+            col_scale = col_scale / jnp.sqrt(
+                jnp.maximum(jnp.max(scaled, axis=0), 1e-12)
+            )
+        A = A * row_scale[:, None] * col_scale[None, :]
+        b = b * row_scale
+        c = c * col_scale
+        lb = lb / col_scale
+        ub = ub / col_scale
 
-    # Shift the box to [0, u]; keep a strictly positive width everywhere so
-    # the interior is non-empty even for pinned (lb == ub) variables.
-    u = jnp.maximum(ub - lb, 1e-8)
+    # Masked presolve for PINNED variables (lb == ub, e.g. every reaction a
+    # regulation rule gated off): a zero-width box has no interior, and
+    # keeping such columns in the barrier collapses the scaling matrix
+    # ``d`` (measured on the regulated e_coli_core: ~25 gated columns
+    # drive d to a 1e-18..1e2 range and the float32 Cholesky goes
+    # singular at iteration 1). Shapes must stay static, so instead of
+    # removing the columns they are masked out of the barrier entirely:
+    # x is fixed at the bound (shifted coordinate 0), their d / direction
+    # components are zeroed each iteration, their complementarity
+    # products vanish (z = w = 0), and they are exempt from the dual
+    # residual test — correct, because a fixed variable's bound
+    # multipliers can absorb ANY reduced cost (z - w = c_j - A_j^T y
+    # always has a nonnegative solution).
+    width = ub - lb
+    pinned = width <= 1e-7
+    free = 1.0 - pinned.astype(dtype)
+    u = jnp.maximum(width, 1e-8)
     b_shift = b - A @ lb
 
-    # Scale-aware starting point strictly inside the box.
-    x0 = 0.5 * u
+    # Scale-aware starting point strictly inside the box (pinned columns
+    # sit at their bound with zeroed multipliers).
+    x0 = free * 0.5 * u
     s0 = u - x0
-    z0 = jnp.full((r,), 1.0 + jnp.max(jnp.abs(c)), dtype)
+    z0 = free * (1.0 + jnp.max(jnp.abs(c)))
     state = _IPState(x=x0, s=s0, y=jnp.zeros((m,), dtype), z=z0, w=z0)
 
     eye = jnp.eye(m, dtype=dtype)
@@ -148,6 +268,34 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
     scale = 1.0 + jnp.max(jnp.abs(b)) if m else jnp.asarray(1.0, dtype)
     dual_scale = 1.0 + jnp.max(jnp.abs(c))
 
+    if warm is not None:
+        # Interiorized restart from the previous solve's iterate: pull x
+        # off the bounds by a fixed fraction of the (new) box and floor
+        # the multipliers at a small multiple of the dual scale. The
+        # resulting complementarity is ~delta * zfloor * u — decades
+        # below the cold start's 0.5 * u * dual_scale — while staying far
+        # enough interior that a moved optimum (a regulation flip) costs
+        # a few extra iterations, not a stall. flag <= 0 (no history yet,
+        # or the previous solve failed) selects the cold point per lane.
+        delta = jnp.asarray(0.005, dtype)
+        # warm.x is in ORIGINAL coordinates; map into the equilibrated,
+        # shifted system before interiorizing
+        xw = free * jnp.clip(
+            jnp.asarray(warm.x, dtype) / col_scale - lb,
+            delta * u,
+            (1 - delta) * u,
+        )
+        zfloor = jnp.asarray(2e-3, dtype) * dual_scale
+        use = jnp.asarray(warm.flag, dtype) > 0
+        pick = lambda wv, cv: jnp.where(use, wv, cv)
+        state = _IPState(
+            x=pick(xw, x0),
+            s=pick(u - xw, s0),
+            y=pick(jnp.asarray(warm.y, dtype), state.y),
+            z=pick(free * jnp.maximum(jnp.asarray(warm.z, dtype), zfloor), z0),
+            w=pick(free * jnp.maximum(jnp.asarray(warm.w, dtype), zfloor), z0),
+        )
+
     def iteration(_, st: _IPState) -> _IPState:
         x, s, y, z, w = st
         r_p = b_shift - A @ x                    # primal (equality) residual
@@ -157,26 +305,43 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
         xc = jnp.maximum(x, tiny)
         sc = jnp.maximum(s, tiny)
 
-        d = 1.0 / (z / xc + w / sc)              # [R] scaling
+        # free-masked scaling: pinned columns have z = w = 0 (denominator
+        # 0 -> guarded), and d = 0 removes them from the normal equations
+        d = free / jnp.maximum(z / xc + w / sc, tiny)  # [R]
+        # FREE-VARIABLE cap: a variable far from both bounds has z, w ->
+        # mu/x, so its d grows like x*s/mu without bound (measured 5.6e7
+        # on e_coli_core's zero-flux reversible reactions in +-20 boxes
+        # while slack pivots sit at 1e-3). Seven decades of pivot spread
+        # erase every other column of those rows from the float32 normal
+        # matrix, and the d-amplified direction noise makes the primal
+        # residual GROW in the endgame. Capping d at max(1e3, u_max^2)
+        # (equilibrated units; allows a full-box step at unit dual scale)
+        # bounds the spread — a mild proximal damping on interior columns
+        # that Mehrotra's corrector absorbs. With the cap the anaerobic
+        # regulated solve accepts at iteration 10 with residual 1e-3;
+        # without it the solve freezes at residual 7e-2 and never
+        # converges.
+        d = jnp.minimum(d, jnp.maximum(1e3, jnp.max(free * u) ** 2))
         AD = A * d                               # [M, R]
         normal = AD @ A.T + regularization * eye  # [M, M] SPD
-        chol = cho_factor(normal)
-
-        def refine_solve(rhs):
-            # Cholesky solve + one iterative-refinement pass: recovers the
-            # accuracy float32 loses when diag(d) spans many decades.
-            dy = cho_solve(chol, rhs)
-            return dy + cho_solve(chol, rhs - normal @ dy)
+        # diag(d) spans many decades as bounds go active, so rows of the
+        # normal matrix do too, and a raw float32 Cholesky goes NaN on
+        # reference-scale networks (measured on the 72x188 e_coli_core:
+        # every direction non-finite from mid-solve, freezing the
+        # iterate; float64 converges in 13) — hence the scaled solver.
+        refine_solve = _jacobi_solver(normal, tiny)
 
         def solve_direction(r_xz, r_sw):
             # Reduced RHS derivation: eliminate dz, dw, ds in favor of dx,
-            # then dx in favor of dy through the normal equations.
+            # then dx in favor of dy through the normal equations. Pinned
+            # columns get identically-zero directions (they are not in
+            # the barrier; their state never moves).
             rhat = r_d - r_xz / xc + r_sw / sc - (w / sc) * r_u
             dy = refine_solve(r_p + AD @ rhat)
             dx = d * (A.T @ dy - rhat)
-            ds = r_u - dx
-            dz = (r_xz - z * dx) / xc
-            dw = (r_sw - w * ds) / sc
+            ds = free * (r_u - dx)
+            dz = free * (r_xz - z * dx) / xc
+            dw = free * (r_sw - w * ds) / sc
             return dx, ds, dy, dz, dw
 
         # Predictor (affine scaling: drive complementarity to zero).
@@ -195,7 +360,15 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
         r_sw = sigma * mu - s * w - ds_a * dw_a
         dx, ds, dy, dz, dw = solve_direction(r_xz, r_sw)
 
-        eta = 0.995
+        # eta = fraction of the distance to the boundary taken per step.
+        # 0.9 (not the textbook 0.995) is a float32 safeguard: at 0.995
+        # the iterate crashes into its bounds faster than the f32 normal
+        # equations can track, and the primal residual DRIFTS UP in the
+        # endgame (measured on e_coli_core: residual grows 2e-3 -> 1e-1
+        # while mu -> 0, never re-entering tolerance; at 0.9 the same
+        # solve accepts at iteration 17 with residual 6e-3). Costs ~1-2
+        # iterations on easy problems.
+        eta = 0.9
         alpha_p = eta * jnp.minimum(_max_step(x, dx), _max_step(s, ds))
         alpha_d = eta * jnp.minimum(_max_step(z, dz), _max_step(w, dw))
         # One shared finiteness flag across ALL direction components:
@@ -240,8 +413,10 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
         accepted = mu < tol
         if m:
             accepted &= jnp.max(jnp.abs(A @ st.x - b_shift)) < sqrt_tol * scale
+        # pinned columns are exempt from dual feasibility (their bound
+        # multipliers can absorb any reduced cost)
         accepted &= (
-            jnp.max(jnp.abs(c - A.T @ st.y - st.z + st.w))
+            jnp.max(jnp.abs(free * (c - A.T @ st.y - st.z + st.w)))
             < sqrt_tol * dual_scale
         )
         return (n_its < n_iter) & (mu > floor) & ~accepted
@@ -254,22 +429,38 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
 
     x = state.x + lb
     if m:
-        # One primal refinement: least-norm correction onto Ax = b sharpens
-        # the float32 equality residual by ~an order of magnitude; the
-        # subsequent clip can only move x by that same (tiny) amount.
-        gram = A @ A.T + regularization * eye
-        x = x + A.T @ cho_solve(cho_factor(gram), b - A @ x)
+        # Weighted active-set polish (two passes): the float32 endgame
+        # leaves a primal residual the iterate cannot shrink (direction
+        # noise accumulates as bounds go active — measured ~8e-3 on
+        # e_coli_core vs 1e-8 in float64, which costs ~12% of the
+        # objective through the |y|*residual suboptimality term). An
+        # UNWEIGHTED least-norm correction cannot fix it: it moves
+        # active variables out of their bounds and the clip re-breaks
+        # feasibility. Weighting the correction by each variable's
+        # distance to its nearest bound confines it to the (nearly-)free
+        # subspace — crossover-style — so the clip barely bites and the
+        # equality residual drops to float32 solve accuracy.
+        for _ in range(2):
+            wgt = jnp.maximum(jnp.minimum(x - lb, ub - x), 0.0)
+            AW = A * wgt
+            gram = AW @ A.T + regularization * eye
+            dy = _jacobi_solver(gram, tiny)(b - A @ x)
+            x = jnp.clip(x + wgt * (A.T @ dy), lb, ub)
     x = jnp.clip(x, lb, ub)
+    # NOTE: residuals/gap/objective below are computed in the equilibrated
+    # system (c @ x is scaling-invariant); only the returned points map
+    # back through the column scaling.
     # Residual and convergence are judged on the RETURNED (clipped) point,
     # so an infeasible problem can never report a small residual just
     # because the pre-clip refinement satisfied Ax = b outside the box.
     primal_residual = jnp.max(jnp.abs(A @ x - b)) if m else jnp.asarray(0.0, dtype)
     gap = (state.x @ state.z + state.s @ state.w) / (2 * r)
-    # Dual residual at the final iterate (scaled/shifted system): without
-    # this, an iteration-starved primal-feasible point could report
+    # Dual residual at the final iterate (scaled/shifted system, free
+    # columns only — see the pinned presolve note): without this, an
+    # iteration-starved primal-feasible point could report
     # converged=True with suboptimal fluxes.
     dual_residual = jnp.max(
-        jnp.abs(c - A.T @ state.y - state.z + state.w)
+        jnp.abs(free * (c - A.T @ state.y - state.z + state.w))
     )
     converged = (
         (gap < tol * (1.0 + jnp.abs(c @ x)))
@@ -277,13 +468,25 @@ def _linprog_box_impl(c, A, b, lb, ub, n_iter, tol, regularization):
         & (dual_residual < sqrt_tol * dual_scale)
     )
     return LPResult(
-        x=x,
+        x=x * col_scale,
         objective=c @ x,
         primal_residual=primal_residual,
         dual_gap=gap,
         converged=converged,
         dual_residual=dual_residual,
         iterations=n_its,
+        # Final INTERIOR iterate (pre-clip x, original coordinates;
+        # y/z/w stay in the equilibrated system — the scaling is
+        # deterministic in A, so it matches across calls), re-usable as
+        # the next solve's warm start; flag = converged so failed solves
+        # never seed.
+        warm=WarmStart(
+            x=(state.x + lb) * col_scale,
+            y=state.y,
+            z=state.z,
+            w=state.w,
+            flag=converged.astype(dtype),
+        ),
     )
 
 
@@ -295,6 +498,7 @@ def flux_balance(
     n_iter: int = 35,
     tol: float = 1e-5,
     leak: float = 0.0,
+    warm: WarmStart | None = None,
 ) -> LPResult:
     """FBA: ``max objective @ v  s.t.  S @ v = 0, lb <= v <= ub``.
 
@@ -340,5 +544,8 @@ def flux_balance(
         ub,
         n_iter=n_iter,
         tol=tol,
+        warm=warm,
     )
+    # x is truncated to the caller's reactions; res.warm keeps the FULL
+    # column space (slacks included) — thread it back verbatim.
     return res._replace(objective=-res.objective, x=res.x[:r])

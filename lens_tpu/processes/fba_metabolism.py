@@ -47,7 +47,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from lens_tpu.core.process import Process
-from lens_tpu.ops.linprog import flux_balance
+from lens_tpu.ops.linprog import (
+    flux_balance,
+    pack_warm,
+    unpack_warm,
+    warm_size,
+)
 from lens_tpu.processes import register
 from lens_tpu.utils.regulation_logic import compile_rule
 
@@ -166,6 +171,14 @@ class FBAMetabolism(Process):
         # Exchange accounting happens in environment units; uptake is also
         # capped so one window cannot import more than is locally present.
         "uptake_cap_fraction": 0.9,
+        # Warm-start each step's LP from the previous step's IPM iterate
+        # (ops.linprog.WarmStart): environments change slowly, so temporal
+        # coherence cuts iterations — and under vmap the batch runs as
+        # long as its SLOWEST lane, so fewer iterations per lane is a
+        # direct wall-clock win. Adds a small non-emitted "lp_state" port;
+        # a hint only (acceptance tests are unchanged), dropped
+        # automatically when the solve fails or the port is not wired.
+        "lp_warm_start": True,
     }
 
     def __init__(self, config=None):
@@ -255,12 +268,32 @@ class FBAMetabolism(Process):
         )  # [R] units of species imported per unit flux
         # (the per-step active-importer share is computed in next_update,
         # after regulation gates are known)
+        # Warm-start bookkeeping: the LP's column space includes the leak
+        # slack columns flux_balance appends, so the packed vector is
+        # sized for the FULL problem.
+        n_lp_vars = n_r + (n_m if self.config["lp_leak"] > 0.0 else 0)
+        self._n_lp_vars = n_lp_vars
+        self._warm_len = warm_size(n_m, n_lp_vars)
 
     # -- declarative surface --------------------------------------------------
 
     def ports_schema(self):
         n_r = len(self.reactions)
-        return {
+        schema = {
+            "lp_state": {
+                "warm": {
+                    # Packed ops.linprog.WarmStart: the previous step's
+                    # interior-point iterate. "copy" divider: daughters
+                    # inherit the mother's basis (their environment is
+                    # hers to first order).
+                    "_default": jnp.zeros(self._warm_len, jnp.float32),
+                    "_updater": "set",
+                    "_divider": "copy",
+                    "_emit": False,
+                },
+            },
+        } if self.config["lp_warm_start"] else {}
+        return schema | {
             "external": {
                 mol: {"_default": 10.0, "_updater": "null", "_divider": "copy"}
                 for mol in self.external
@@ -365,7 +398,17 @@ class FBAMetabolism(Process):
         ext = jnp.stack([states["external"][mol] for mol in self.external])
         lb, ub = self.regulated_bounds(ext, timestep)
 
-        # 4. The LP: max biomass s.t. S v = 0 (to lp_leak), lb <= v <= ub.
+        # 4. The LP: max biomass s.t. S v = 0 (to lp_leak), lb <= v <= ub,
+        # warm-started from the previous step's iterate when the lp_state
+        # port is wired (tests that hand-build states without it fall back
+        # to the cold start — identical answers, more iterations).
+        warm = None
+        if self.config["lp_warm_start"] and "lp_state" in states:
+            warm = unpack_warm(
+                states["lp_state"]["warm"],
+                len(self.internal),
+                self._n_lp_vars,
+            )
         sol = flux_balance(
             self.stoichiometry,
             self.objective,
@@ -374,6 +417,7 @@ class FBAMetabolism(Process):
             n_iter=self.config["lp_iterations"],
             tol=self.config["lp_tol"],
             leak=self.config["lp_leak"],
+            warm=warm,
         )
         # A failed solve (infeasible bounds — e.g. maintenance cannot be
         # met) means no growth and no exchange, not garbage fluxes.
@@ -383,7 +427,10 @@ class FBAMetabolism(Process):
         # 5. Deltas. Exchange port counts net secretion (negative=uptake).
         net_uptake = self.exchange_matrix @ v          # [E], + = imported
         growth = v[self.biomass_index]
-        return {
+        update = {} if warm is None else {
+            "lp_state": {"warm": pack_warm(sol.warm)}
+        }
+        return update | {
             "exchange": {
                 f"{mol}_exchange": -net_uptake[e] * timestep
                 for e, mol in enumerate(self.external)

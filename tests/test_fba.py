@@ -514,3 +514,234 @@ class TestEcoliCoreNetwork:
         assert (lcts_flux > 1e-3).all()
         growth = np.asarray(ss.colony.agents["fluxes"]["growth_rate"])[alive]
         assert (growth > 0.1).all()
+
+
+class TestWarmStartComposite:
+    """The lp_state port threads the IPM warm start through the spatial
+    composite: telemetry must show the iteration drop, and the biology
+    must match a cold-start run (the hint cannot change what converged
+    means — ops.linprog acceptance tests are identical)."""
+
+    def _run(self, warm: bool):
+        from lens_tpu.models.composites import rfba_lattice
+
+        spatial, _ = rfba_lattice(
+            {
+                "capacity": 32,
+                "shape": (8, 8),
+                "division": False,
+                "motility": {"sigma": 0.0},
+                "metabolism": {"lp_warm_start": warm},
+            }
+        )
+        ss = spatial.initial_state(8, jax.random.PRNGKey(2))
+        ss, traj = spatial.run(ss, 20.0, 1.0, emit_every=1)
+        return ss, traj
+
+    def test_iterations_drop_and_biology_matches(self):
+        ss_w, traj_w = self._run(True)
+        ss_c, traj_c = self._run(False)
+        its = np.asarray(traj_w["fluxes"]["lp_iterations"])  # [T, N]
+        alive = np.asarray(traj_w["alive"])
+        # steady state after the first step: warm-started lanes need
+        # strictly fewer iterations than the cold first step
+        assert its[1:][alive[1:]].mean() < its[0][alive[0]].mean() - 1.0, (
+            its.mean(axis=1)
+        )
+        # same biology to solver tolerance (LP optima agree to ~tol)
+        m_w = np.asarray(traj_w["global"]["mass"])
+        m_c = np.asarray(traj_c["global"]["mass"])
+        np.testing.assert_allclose(m_w, m_c, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            np.asarray(ss_w.fields), np.asarray(ss_c.fields),
+            rtol=2e-3, atol=2e-3,
+        )
+
+
+# -- the TRUE e_coli_core (72 metabolites x 95 reactions, VERDICT r3 item 5) --
+
+
+def full_process(**over):
+    cfg = {"network": "ecoli_core_full", "lp_leak": 1.5e-3, "lp_tol": 1e-5,
+           "lp_iterations": 45}
+    cfg.update(over)
+    return FBAMetabolism(cfg)
+
+
+class TestEcoliCoreFullNetwork:
+    """The canonical 72x95 e_coli_core as data (ecoli_core_full_*.tsv).
+
+    The generator (.scratch/gen_ecoli_core_full.py) validated the
+    UNTRANSLATED model against the published numbers (aerobic mu 0.8739,
+    anaerobic 0.2117 secreting ac/etoh/for — exact matches under HiGHS);
+    these tests pin the translated, runtime-format model: canonical-scale
+    phenotypes through the float32 batched IPM, plus HiGHS parity on the
+    identical LPs.
+    """
+
+    def test_loader_counts_and_canonical_content(self):
+        from lens_tpu.data import load_rfba_network
+
+        net = load_rfba_network("ecoli_core_full")
+        assert len(net["internal"]) == 72          # 52 cytosolic + 20 pools
+        assert len(net["external"]) == 17          # lattice fields
+        # 75 canonical non-exchange + 33 exchange columns (20 EX split
+        # into import/export pairs for fields, free columns for h/h2o/pi)
+        assert len(net["reactions"]) == 108
+        assert net["objective"] == "BIOMASS"
+        pts = net["reactions"]["GLCpts"]
+        assert pts["stoich"] == {"glc__D_e": -1.0, "pep": -1.0,
+                                 "g6p": 1.0, "pyr": 1.0}
+        # growth-associated maintenance in the biomass equation (59.81
+        # ATP) and the pinned non-growth maintenance (0.839 scaled)
+        assert net["reactions"]["BIOMASS"]["stoich"]["atp"] == -59.81
+        lo, hi = net["reactions"]["ATPM"]["bounds"]
+        assert abs(lo - 0.839) < 1e-6 and hi == 20.0
+        # import split carries the MM km; export split does not
+        assert net["reactions"]["glc_in"]["exchanges"] == {"glc": 1.0}
+        assert net["reactions"]["glc_in"]["km"] == 0.5
+        assert net["reactions"]["ace_out"]["exchanges"] == {"ace": -1.0}
+
+    def test_aerobic_growth_matches_canonical(self):
+        p = full_process()
+        upd = p.next_update(
+            1.0, core_states(p, {"glc": 10, "o2": 50, "nh4": 50})
+        )
+        assert float(upd["fluxes"]["lp_converged"]) == 1.0
+        g = float(upd["fluxes"]["growth_rate"])
+        # canonical mu 0.8739 x 0.1 scale x MM saturation, affine-
+        # corrected for fixed maintenance -> 0.0830; leak bias ~ +0.004
+        assert 0.078 < g < 0.093, g
+        assert float(upd["exchange"]["glc_exchange"]) < -0.05   # uptake
+        assert float(upd["exchange"]["co2_exchange"]) > 0.05    # respiration
+
+    def test_anaerobic_mixed_acid_fermentation(self):
+        p = full_process()
+        upd = p.next_update(
+            1.0, core_states(p, {"glc": 10, "nh4": 50})
+        )
+        assert float(upd["fluxes"]["lp_converged"]) == 1.0
+        g = float(upd["fluxes"]["growth_rate"])
+        # canonical anaerobic mu 0.2117 x 0.1 x saturation ~ 0.0202
+        assert 0.016 < g < 0.024, g
+        # the canonical product trio is secreted
+        assert float(upd["exchange"]["ace_exchange"]) > 0.01
+        assert float(upd["exchange"]["etoh_exchange"]) > 0.01
+        assert float(upd["exchange"]["for_exchange"]) > 0.05
+        v = np.asarray(upd["fluxes"]["reaction_fluxes"])
+        assert v[p.reactions.index("PFL")] > 0.1       # anaerobic route
+        assert v[p.reactions.index("CYTBD")] < 1e-2    # no respiration
+
+    def test_fructose_grows_like_glucose_when_derepressed(self):
+        p = full_process()
+        both = p.next_update(
+            1.0, core_states(p, {"glc": 10, "fru": 10, "o2": 50, "nh4": 50})
+        )
+        v = np.asarray(both["fluxes"]["reaction_fluxes"])
+        assert v[p.reactions.index("fru_in")] < 1e-4   # repressed by glc
+        alone = p.next_update(
+            1.0, core_states(p, {"fru": 10, "o2": 50, "nh4": 50})
+        )
+        ga = float(alone["fluxes"]["growth_rate"])
+        assert 0.078 < ga < 0.093, ga                  # same entry point
+
+    def test_acetate_growth_uses_glyoxylate_shunt(self):
+        p = full_process()
+        upd = p.next_update(
+            1.0, core_states(p, {"ace": 10, "o2": 50, "nh4": 50})
+        )
+        assert float(upd["fluxes"]["lp_converged"]) == 1.0
+        assert float(upd["fluxes"]["growth_rate"]) > 0.008
+        v = np.asarray(upd["fluxes"]["reaction_fluxes"])
+        assert v[p.reactions.index("ICL")] > 0.01
+        assert v[p.reactions.index("MALS")] > 0.01
+
+    def test_nitrogen_limitation_full(self):
+        p = full_process()
+        upd = p.next_update(1.0, core_states(p, {"glc": 10, "o2": 50}))
+        assert float(upd["fluxes"]["growth_rate"]) < 5e-3
+
+    def test_batched_oracle_parity_full(self):
+        """Random environments through the float32 batched IPM vs HiGHS
+        on the IDENTICAL leak-relaxed 72x180 LP."""
+        import scipy.optimize
+
+        p = full_process()
+        rng = np.random.default_rng(11)
+        n_env = 12
+        envs = np.zeros((n_env, len(p.external)), np.float32)
+        for i in range(n_env):
+            for e, mol in enumerate(p.external):
+                if rng.random() < 0.5:
+                    envs[i, e] = rng.uniform(0.0, 20.0)
+
+        lbub = jax.vmap(lambda e: p.regulated_bounds(e, 1.0))(
+            jnp.asarray(envs)
+        )
+        from lens_tpu.ops.linprog import flux_balance
+
+        sols = jax.vmap(
+            lambda l, u: flux_balance(
+                p.stoichiometry, p.objective, l, u,
+                n_iter=45, tol=1e-5, leak=1.5e-3,
+            )
+        )(*lbub)
+
+        S = np.asarray(p.stoichiometry)
+        m = S.shape[0]
+        S_aug = np.concatenate([S, np.eye(m)], axis=1)
+        c_aug = np.concatenate([-np.asarray(p.objective), np.zeros(m)])
+        n_conv = 0
+        for i in range(n_env):
+            lb = np.concatenate(
+                [np.asarray(lbub[0][i]), -1.5e-3 * np.ones(m)]
+            )
+            ub = np.concatenate(
+                [np.asarray(lbub[1][i]), 1.5e-3 * np.ones(m)]
+            )
+            ref = scipy.optimize.linprog(
+                c_aug, A_eq=S_aug, b_eq=np.zeros(m),
+                bounds=list(zip(lb, ub)), method="highs",
+            )
+            conv = bool(sols.converged[i])
+            if ref.status != 0:
+                assert not conv, f"env {i}: converged on infeasible LP"
+                continue
+            if conv:
+                n_conv += 1
+                np.testing.assert_allclose(
+                    float(sols.objective[i]), -ref.fun, atol=5e-3,
+                    err_msg=f"env {i}",
+                )
+        assert n_conv >= int(0.75 * n_env), f"only {n_conv}/{n_env}"
+
+    def test_full_gene_table_loads(self):
+        from lens_tpu.processes.genome_expression import GenomeExpression
+
+        expr = GenomeExpression({"genes": "ecoli_core_full"})
+        assert len(expr.genes) >= 130
+        # operon rules read lattice fields only
+        p = full_process()
+        assert set(expr.rule_species) <= set(p.external)
+
+    def test_rfba_lattice_full_composite(self):
+        from lens_tpu.models.composites import rfba_lattice
+
+        spatial, _ = rfba_lattice(
+            {
+                "capacity": 16,
+                "shape": (8, 8),
+                "division": False,
+                "motility": {"sigma": 0.0},
+                "metabolism": {"network": "ecoli_core_full"},
+            }
+        )
+        ss = spatial.initial_state(8, jax.random.PRNGKey(0))
+        glc0 = float(jnp.sum(ss.fields[spatial.lattice.index("glc")]))
+        ss, traj = spatial.run(ss, 10.0, 1.0, emit_every=5)
+        glc1 = float(jnp.sum(ss.fields[spatial.lattice.index("glc")]))
+        assert glc1 < glc0
+        assert bool(jnp.all(jnp.isfinite(ss.fields)))
+        m = np.asarray(traj["global"]["mass"])
+        alive = np.asarray(traj["alive"])
+        assert (m[-1][alive[-1]] > m[0][alive[-1]]).all()

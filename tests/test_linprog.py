@@ -202,3 +202,92 @@ class TestFluxBalance:
         res = flux_balance(S, objective, lb, ub)
         assert abs(float(res.objective) - 2.0) < 1e-4
         assert float(res.x[1]) < 1e-3  # low-yield route unused
+
+
+class TestWarmStart:
+    """Warm-starting is a HINT: identical acceptance tests, fewer
+    iterations on a sequence of related problems (the FBA usage —
+    SURVEY.md §2 "Metabolism": one LP per agent per step, environments
+    drifting slowly between steps)."""
+
+    def _drifting_bounds(self, t, r, rng_phase):
+        lb = jnp.zeros(r)
+        ub = jnp.asarray(
+            1.0 + 0.5 * np.abs(np.sin(0.05 * t + rng_phase)), jnp.float32
+        )
+        return lb, ub
+
+    def test_warm_matches_cold_and_cuts_iterations(self):
+        # A -> B -> biomass chain with drifting uptake bounds.
+        S = jnp.asarray([[1.0, -1.0, 0.0], [0.0, 1.0, -1.0]])
+        objective = jnp.asarray([0.0, 0.0, 1.0])
+        rng = np.random.default_rng(7)
+        phase = rng.uniform(0, 3, size=3)
+        warm = None
+        iters_cold, iters_warm = [], []
+        for t in range(8):
+            lb, ub = self._drifting_bounds(t, 3, phase)
+            cold = flux_balance(S, objective, lb, ub)
+            res = (
+                cold
+                if warm is None
+                else flux_balance(S, objective, lb, ub, warm=warm)
+            )
+            warm = res.warm
+            assert bool(res.converged)
+            # same optimum to solver tolerance
+            scale = 1.0 + abs(float(cold.objective))
+            assert (
+                abs(float(res.objective) - float(cold.objective)) / scale
+                < 5e-4
+            )
+            iters_cold.append(int(cold.iterations))
+            iters_warm.append(int(res.iterations))
+        # After the first step, the warm chain must be strictly cheaper in
+        # total (each subsequent problem differs only by a small drift).
+        assert sum(iters_warm[1:]) < sum(iters_cold[1:]), (
+            iters_warm,
+            iters_cold,
+        )
+
+    def test_flag_zero_reproduces_cold_bitwise(self):
+        from lens_tpu.ops.linprog import WarmStart
+
+        rng = np.random.default_rng(3)
+        c, A, b, lb, ub = random_feasible_lp(rng)
+        args = (
+            jnp.asarray(c), jnp.asarray(A), jnp.asarray(b),
+            jnp.asarray(lb), jnp.asarray(ub),
+        )
+        cold = linprog_box(*args)
+        # garbage warm data with flag = 0 must be ignored per-lane
+        bogus = WarmStart(
+            x=jnp.full_like(cold.x, 123.0),
+            y=cold.warm.y * 0 + 9.0,
+            z=jnp.full_like(cold.x, 5.0),
+            w=jnp.full_like(cold.x, 5.0),
+            flag=jnp.asarray(0.0),
+        )
+        res = linprog_box(*args, warm=bogus)
+        np.testing.assert_array_equal(np.asarray(res.x), np.asarray(cold.x))
+        assert int(res.iterations) == int(cold.iterations)
+
+    def test_failed_solve_flag_is_zero(self):
+        c = jnp.asarray([1.0, 1.0])
+        A = jnp.asarray([[1.0, 1.0]])
+        b = jnp.asarray([10.0])
+        res = linprog_box(c, A, b, jnp.zeros(2), jnp.ones(2))
+        assert not bool(res.converged)
+        assert float(res.warm.flag) == 0.0
+
+    def test_pack_unpack_roundtrip(self):
+        from lens_tpu.ops.linprog import pack_warm, unpack_warm, warm_size
+
+        S = jnp.asarray([[1.0, -1.0, 0.0], [0.0, 1.0, -1.0]])
+        objective = jnp.asarray([0.0, 0.0, 1.0])
+        res = flux_balance(S, objective, jnp.zeros(3), jnp.ones(3))
+        vec = pack_warm(res.warm)
+        assert vec.shape == (warm_size(2, 3),)
+        ws = unpack_warm(vec, 2, 3)
+        for a, b_ in zip(ws, res.warm):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
