@@ -1,0 +1,186 @@
+"""MFU / roofline accounting for the flagship configs (VERDICT r4 item 3).
+
+agent-steps/s says nothing about whether the chip is BUSY; with no
+published reference numbers (BASELINE `published: {}`), utilization is
+the only honest yardstick. Per flagship config this bench records:
+
+- an analytic FLOPs-per-step model (diffusion stencil substeps, LP
+  factorization + solves at the MEASURED mean iteration count from the
+  state's lp_iterations telemetry, tau-leap expression, per-agent
+  kinetics) — the model the MFU numbers use;
+- XLA's compiled cost analysis as a cross-check, labeled for what it is:
+  `scan`/`while` bodies are counted ONCE, so it is a lower bound that
+  undercounts by roughly the loop trip counts (measured ~70x on the LP
+  window) — useful only to sanity-check the model's single-iteration
+  magnitude;
+- measured window wall-clock -> achieved FLOP/s -> MFU against the
+  device's dense bf16 peak (conservative: the LP/exchange math is
+  f32-pinned and cannot reach bf16 peak, so true utilization is higher);
+- model bytes-touched -> arithmetic intensity, which names the roofline
+  side (HBM-bound vs compute-bound). The per-op idle breakdown still
+  needs an on-device `--trace` capture (queued with the TPU work).
+
+Writes BENCH_MFU.json and prints one JSON line per config.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from bench_lp_sizes import lp_flops
+from lens_tpu.utils.platform import guard_accelerator_or_exit
+
+#: Dense peak FLOP/s by device kind (bf16 for TPUs; host CPUs record no
+#: MFU — there is no meaningful single peak for this box).
+PEAK_FLOPS = {
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6e": 918e12,
+}
+
+WINDOW_S = 32.0          # TPU window; CPU runs shrink it (see main)
+
+#: per-agent-per-step FLOPs for the kinetic side (MM transport rk4 — 4
+#: rhs evals — growth, trigger, gather/scatter index math); a deliberate
+#: round overestimate of a few dozen scalar ops.
+KINETIC_FLOPS = 150.0
+#: per-gene-per-step FLOPs of the tau-leap expression block (4 reaction
+#: channels: propensities, Poisson draws, count updates).
+GENE_FLOPS = 40.0
+
+
+def _stencil_flops(lattice, steps):
+    h, w = lattice.shape
+    m = len(lattice.molecules)
+    # 5-point FTCS: 4 adds + 2 muls per cell per substep per molecule
+    return steps * lattice.n_substeps * m * h * w * 6.0
+
+
+def _flagships(window_s):
+    import jax
+
+    from lens_tpu.models.composites import ecoli_lattice, rfba_lattice
+
+    out = {}
+
+    def window(spatial):
+        return lambda s: spatial.run(
+            s, window_s, 1.0, emit_every=int(window_s)
+        )[0]
+
+    n2 = 10240
+    spatial2, _ = ecoli_lattice({"capacity": n2})
+
+    def model2(state):
+        return _stencil_flops(spatial2.lattice, window_s) + (
+            window_s * n2 * KINETIC_FLOPS
+        )
+
+    out["2"] = (n2, spatial2, window(spatial2), model2)
+
+    for name, net in (("3b", "ecoli_core"), ("3c", "ecoli_core_full")):
+        n3 = 1024
+        spatial3, _ = rfba_lattice(
+            {
+                "capacity": n3,
+                "shape": (64, 64),
+                "metabolism": {"network": net},
+                "expression": {"genes": net},
+            }
+        )
+        procs = spatial3.colony.compartment.processes
+        proc = procs["metabolism"]
+        genes = len(procs["expression"].genes)
+        m_rows = len(proc.internal)
+        n_cols = proc._n_lp_vars
+
+        def model3(state, spatial3=spatial3, n3=n3, m_rows=m_rows,
+                   n_cols=n_cols, genes=genes):
+            iters = float(
+                np.asarray(
+                    state.colony.agents["fluxes"]["lp_iterations"]
+                ).mean()
+            )
+            return (
+                _stencil_flops(spatial3.lattice, window_s)
+                + window_s * n3 * lp_flops(m_rows, n_cols, iters)
+                + window_s * n3 * genes * GENE_FLOPS
+                + window_s * n3 * KINETIC_FLOPS
+            )
+
+        out[name] = (n3, spatial3, window(spatial3), model3)
+    return out
+
+
+def _xla_cost(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0] if ca else {}
+    return ca or {}
+
+
+def main():
+    guard_accelerator_or_exit()
+    import jax
+
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    peak = next(
+        (v for k, v in PEAK_FLOPS.items() if k.lower() in kind.lower()), None
+    )
+    # the full-network 3c window alone takes >30 min on this 1-core host;
+    # the CPU record shrinks the window (recorded per row) — TPU runs the
+    # full 32 s
+    window_s = WINDOW_S if backend != "cpu" else 8.0
+    rows = []
+    for name, (n, spatial, window_fn, model) in _flagships(window_s).items():
+        state = spatial.initial_state(n, jax.random.PRNGKey(0))
+        window = jax.jit(window_fn)
+        compiled = window.lower(state).compile()
+        ca = _xla_cost(compiled)
+        state = jax.block_until_ready(window(state))  # warm-up
+        t0 = time.perf_counter()
+        state = jax.block_until_ready(window(state))
+        dt = time.perf_counter() - t0
+        flops = float(model(state))
+        row = {
+            "config": name,
+            "agents": n,
+            "window_s": window_s,
+            "agent_steps_per_s": n * window_s / dt,
+            "model_flops_per_window": flops,
+            "model_flops_per_agent_step": flops / (n * window_s),
+            "achieved_flops_per_s": flops / dt,
+            "mfu": flops / dt / peak if peak else None,
+            "xla_flops_lower_bound": float(ca.get("flops", 0.0)) or None,
+            "xla_bytes_lower_bound": (
+                float(ca.get("bytes accessed", 0.0)) or None
+            ),
+            "device_kind": kind,
+        }
+        rows.append(row)
+        print(json.dumps({
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in row.items()
+        }))
+    out = {
+        "backend": backend,
+        "device_kind": kind,
+        "peak_flops_assumed": peak,
+        "note": (
+            "MFU = analytic-model FLOPs / wall / dense-bf16 peak "
+            "(conservative: f32-pinned math cannot reach bf16 peak). "
+            "xla_*_lower_bound come from compiled.cost_analysis(), which "
+            "counts scan/while bodies ONCE — lower bounds only. Per-op "
+            "idle breakdown needs an on-device --trace capture."
+        ),
+        "rows": rows,
+    }
+    with open("BENCH_MFU.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,7 +69,8 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # rebuilt at ``factor`` x capacity (Colony.expanded — pre-expansion
     # trajectory bitwise unchanged, lineage ids collision-free).
     # None disables. Requires checkpoint_every (segments) to react
-    # mid-run, and is not yet supported together with "mesh".
+    # mid-run. Composes with a single-host "mesh" (fresh rows are dealt
+    # evenly across agent shards); multi-host meshes not yet.
     # {"free_frac": 0.2, "factor": 2, "max_capacity": None}
     "auto_expand": None,
 }
@@ -131,10 +132,15 @@ class Experiment:
                     n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
                 ),
             )
-        if self.config["auto_expand"] and self.runner is not None:
+        if (
+            self.config["auto_expand"]
+            and self.runner is not None
+            and jax.process_count() > 1
+        ):
+            # fail at construction, not hours in when the colony fills
             raise ValueError(
-                "auto_expand is not supported with a device mesh yet "
-                "(expansion would need to re-stripe the shards)"
+                "auto_expand on a multi-host mesh is not supported yet "
+                "(expansion gathers the full state to one host)"
             )
         self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
         self.checkpointer = (
@@ -218,12 +224,47 @@ class Experiment:
         free = int(np.sum(~np.asarray(jax.device_get(cs.alive))))
         if free > free_frac * cap:
             return state
+        if self.runner is not None:
+            return self._expand_sharded(state, factor)
         if self.spatial is not None:
             self.spatial, state = self.spatial.expanded(state, factor)
             self.colony = self.spatial.colony
         else:
             self.colony, state = self.colony.expanded(state, factor)
         return state
+
+    def _expand_sharded(self, state, factor: int):
+        """Capacity growth under a device mesh: pull the state to host,
+        expand, deal the fresh rows evenly across the agent shards (the
+        end-appended layout would dump every free slot into the tail
+        shards), rebuild the runner at the new capacity, re-place."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "auto_expand on a multi-host mesh is not supported yet "
+                "(expansion gathers the full state to one host)"
+            )
+        from lens_tpu.parallel import ShardedSpatialColony
+        from lens_tpu.parallel.mesh import (
+            AGENTS_AXIS,
+            interleave_expanded_rows,
+            mesh_shardings,
+            spatial_pspecs,
+        )
+
+        old_cap = self.colony.capacity
+        host = jax.device_get(state)
+        self.spatial, grown = self.spatial.expanded(host, factor)
+        self.colony = self.spatial.colony
+        mesh = self.runner.mesh
+        grown = grown._replace(
+            colony=interleave_expanded_rows(
+                grown.colony, old_cap, mesh.shape[AGENTS_AXIS]
+            )
+        )
+        self.runner = ShardedSpatialColony(self.spatial, mesh)
+        return jax.device_put(
+            grown, mesh_shardings(mesh, spatial_pspecs(grown))
+        )
 
     def _colony_meta_path(self) -> str:
         import os
@@ -331,7 +372,13 @@ class Experiment:
             # The trailing pipelined segment — flushed in `finally` so an
             # exception mid-run cannot silently drop an already-computed
             # segment from the record.
-            self._flush_pending()
+            try:
+                self._flush_pending()
+            except Exception:
+                # a poisoned pending segment (e.g. the device error that
+                # aborted the loop) must not mask the original exception
+                # or block the flush of already-buffered records
+                self._pending = None
             self.emitter.flush()
         return state
 
@@ -408,6 +455,12 @@ class Experiment:
                 location_path=self.spatial.location_path,
                 share_bins=self.spatial.share_bins,
             )
+            if self.runner is not None:
+                from lens_tpu.parallel import ShardedSpatialColony
+
+                self.runner = ShardedSpatialColony(
+                    self.spatial, self.runner.mesh
+                )
         self.colony = grown
 
     def close(self) -> None:

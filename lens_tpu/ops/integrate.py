@@ -97,11 +97,85 @@ def implicit_euler_step(rhs: RHS, t, y, dt, args=None, newton_iters: int = 4):
     return unravel(v)
 
 
+def tr_bdf2_step(rhs: RHS, t, y, dt, args=None, newton_iters: int = 16):
+    """One TR-BDF2 step: trapezoidal to ``t + gamma*dt``, then a BDF2
+    closure to ``t + dt`` (gamma = 2 - sqrt(2), the L-stable choice).
+
+    The reference's ``scipy.odeint`` is LSODA — automatic stiff
+    switching with ACCURACY adaptivity, not just stability. Implicit
+    Euler (the ``"implicit"`` stepper) matches the stability half only:
+    it is first order, so at dt = 1 s its error is set by accuracy, not
+    stiffness. TR-BDF2 is the fixed-shape second-order counterpart —
+    one-step (vmappable, no history rows), L-stable, and composed of
+    two Newton solves with the machinery implicit Euler uses (dense
+    ``jacfwd`` Jacobian, one small solve per iteration).
+
+    ``newton_iters`` is a CAP, not a fixed count: each stage's Newton
+    runs until its residual drops below float roundoff scale (measured
+    on Robertson at dt = 1: the trapezoidal half-kick throws the fast
+    species three decades above equilibrium, and 4 fixed iterations
+    leave a visibly wrong trajectory while ~10 reach the floor — under
+    ``vmap`` the batch runs as long as its slowest lane, the adaptive-LP
+    pattern of ops.linprog). Oracle-pinned on Robertson in
+    tests/test_integrate.py: second-order convergence and >10x less
+    error than implicit Euler at the same dt.
+    """
+    import math
+
+    from jax.flatten_util import ravel_pytree
+
+    flat0, unravel = ravel_pytree(y)
+    n = flat0.size
+    dt = jnp.asarray(dt, flat0.dtype)
+    g = 2.0 - math.sqrt(2.0)
+    eps = jnp.asarray(
+        1e-7 if flat0.dtype == jnp.float32 else 1e-13, flat0.dtype
+    )
+
+    def f(v, tt):
+        return ravel_pytree(rhs(tt, unravel(v), args))[0]
+
+    def solve_implicit(const, coeff, tt, v0):
+        # Early-exit Newton on  v = const + coeff * f(v, tt)
+        tol = eps * (1.0 + jnp.max(jnp.abs(const)))
+
+        def residual(v):
+            return v - const - coeff * f(v, tt)
+
+        def cond(carry):
+            i, _, res = carry
+            return (i < newton_iters) & (jnp.max(jnp.abs(res)) > tol)
+
+        def body(carry):
+            i, v, res = carry
+            A = jnp.eye(n, dtype=flat0.dtype) - coeff * jax.jacfwd(
+                lambda u: f(u, tt)
+            )(v)
+            v = v - jnp.linalg.solve(A, res)
+            return i + 1, v, residual(v)
+
+        _, v, _ = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), v0, residual(v0))
+        )
+        return v
+
+    # TR half: y_g = y0 + (g dt / 2) (f(y0) + f(y_g))
+    a = g * dt / 2.0
+    f0 = f(flat0, t)
+    yg = solve_implicit(flat0 + a * f0, a, t + g * dt, flat0)
+    # BDF2 closure: y1 = [y_g - (1-g)^2 y0] / (g (2-g)) + d dt f(y1)
+    d = (1.0 - g) / (2.0 - g)
+    c0 = (yg - (1.0 - g) ** 2 * flat0) / (g * (2.0 - g))
+    y1 = solve_implicit(c0, d * dt, t + dt, yg)
+    return unravel(y1)
+
+
 _STEPPERS = {
     "euler": euler_step,
     "heun": heun_step,
     "rk4": rk4_step,
     "implicit": implicit_euler_step,
+    "tr_bdf2": tr_bdf2_step,
 }
 
 

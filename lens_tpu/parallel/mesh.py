@@ -125,6 +125,50 @@ def stripe_colony_rows(colony_state, n_blocks: int):
     )
 
 
+def interleave_expanded_rows(colony_state, old_cap: int, n_blocks: int):
+    """Deal a capacity expansion's fresh rows evenly across agent shards.
+
+    ``Colony.expanded`` appends its new (dead, template) rows at the END
+    of the row axis; split contiguously over ``n_blocks`` shards, that
+    layout would dump every fresh row into the tail shards and re-create
+    the saturation skew striping exists to prevent. Fresh rows are
+    exchangeable, so a pure permutation fixes it: new block ``b`` is
+    ``[old block b | its share of fresh rows]`` — every shard keeps its
+    old rows AND gains the same number of free slots.
+
+    CONTRACT NOTE: this permutation renumbers live rows, so emitted
+    trajectories from before and after a sharded expansion do NOT align
+    row-for-row (the stacked series pads at the end while agents moved
+    elsewhere). Row index was never a cross-time identity in a dividing
+    colony anyway — daughters recycle dead rows every step; the stable
+    identity is ``lineage.cell_id``, which rides the permutation and is
+    what the analysis layer's lineage tools key on.
+    """
+    cap = colony_state.alive.shape[0]
+    if old_cap % n_blocks or cap % n_blocks:
+        raise ValueError(
+            f"capacities {old_cap}->{cap} not divisible by {n_blocks} blocks"
+        )
+    b_old = old_cap // n_blocks
+    b_fresh = (cap - old_cap) // n_blocks
+    src = jnp.concatenate(
+        [
+            jnp.concatenate(
+                [
+                    jnp.arange(b * b_old, (b + 1) * b_old),
+                    old_cap + jnp.arange(b * b_fresh, (b + 1) * b_fresh),
+                ]
+            )
+            for b in range(n_blocks)
+        ]
+    )
+    take = lambda leaf: leaf[src]
+    return colony_state._replace(
+        agents=jax.tree.map(take, colony_state.agents),
+        alive=take(colony_state.alive),
+    )
+
+
 def validate_divisible(capacity: int, field_h: int, mesh: Mesh) -> None:
     n_a = mesh.shape[AGENTS_AXIS]
     n_s = mesh.shape[SPACE_AXIS]

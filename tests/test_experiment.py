@@ -501,3 +501,56 @@ class TestPipelinedEmission:
         np.testing.assert_array_equal(
             np.asarray(one["alive"]), np.asarray(many["alive"])
         )
+
+
+class TestAutoExpandWithMesh:
+    """auto_expand composes with a (single-host) device mesh: fresh rows
+    are dealt evenly across agent shards, so the sharded expanded run
+    tracks the unsharded one and never starves a shard's division pool."""
+
+    def growth_config(self, mesh):
+        return {
+            "composite": "ecoli_lattice",
+            "config": {
+                "capacity": 32,
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": True,
+                "motility": {"sigma": 0.0},
+                "growth": {"rate": 0.05},
+            },
+            "n_agents": 8,
+            "total_time": 45.0,
+            "checkpoint_every": 5.0,
+            "auto_expand": {"free_frac": 0.3, "factor": 2},
+            "mesh": mesh,
+            "seed": 11,
+        }
+
+    def test_sharded_expansion_tracks_unsharded(self):
+        with Experiment(self.growth_config(None)) as exp:
+            ref_state = exp.run()
+            ref_ts = exp.emitter.timeseries()
+        with Experiment(self.growth_config({"agents": 4, "space": 1})) as exp:
+            state = exp.run()
+            ts = exp.emitter.timeseries()
+            assert exp.runner is not None
+            assert exp.colony.capacity == int(state.colony.alive.shape[0])
+        assert int(state.colony.alive.shape[0]) > 32      # expanded
+        # same growth curve and zero backlog on both paths (rows are
+        # permuted differently, so compare aggregates, not rows)
+        np.testing.assert_array_equal(
+            np.asarray(ts["alive"]).sum(axis=1),
+            np.asarray(ref_ts["alive"]).sum(axis=1),
+        )
+        assert (np.asarray(ts["division_backlog"]) == 0).all()
+        assert (np.asarray(ref_ts["division_backlog"]) == 0).all()
+        np.testing.assert_array_equal(
+            np.asarray(state.colony.alive).sum(),
+            np.asarray(ref_state.colony.alive).sum(),
+        )
+        # lineage ids stay unique through sharded expansion
+        ids = np.asarray(state.colony.agents["lineage"]["cell_id"])[
+            np.asarray(state.colony.alive)
+        ]
+        assert len(np.unique(ids)) == len(ids)

@@ -154,3 +154,104 @@ def test_implicit_pytree_and_vmap():
     assert out["x"].shape == (4,)
     assert np.isfinite(np.asarray(out["x"])).all()
     assert (np.asarray(out["x"]) >= 0).all()  # stiff decay stays stable
+
+
+class TestTRBDF2:
+    """TR-BDF2 (VERDICT r3 item 8): the second-order L-stable stepper —
+    LSODA's ACCURACY half, not just its stability half, at fixed shapes."""
+
+    k1, k2, k3 = 0.04, 3e7, 1e4
+
+    def rhs(self, t, y, args):
+        a, b, c = y[0], y[1], y[2]
+        r1, r2, r3 = self.k1 * a, self.k2 * b * b, self.k3 * b * c
+        return jnp.stack([-r1 + r3, r1 - r2 - r3, r2])
+
+    def robertson_oracle(self, t_end):
+        from scipy.integrate import solve_ivp
+
+        def rhs_scipy(t, y):
+            a, b, c = y
+            r1, r2, r3 = self.k1 * a, self.k2 * b * b, self.k3 * b * c
+            return [-r1 + r3, r1 - r2 - r3, r2]
+
+        return solve_ivp(
+            rhs_scipy, [0.0, t_end], [1.0, 0.0, 0.0],
+            method="BDF", rtol=1e-10, atol=1e-14,
+        ).y[:, -1]
+
+    def test_accuracy_beats_implicit_euler_at_dt_1(self):
+        """The VERDICT's bar: accuracy at dt = 1 s on Robertson. The
+        first-order stepper's error there is accuracy-limited; TR-BDF2
+        must land an order of magnitude closer to the BDF oracle."""
+        y0 = jnp.asarray([1.0, 0.0, 0.0])
+        ref = self.robertson_oracle(100.0)
+        got2 = np.asarray(
+            odeint_window(self.rhs, y0, 0.0, 1.0, 100, method="tr_bdf2"),
+            np.float64,
+        )
+        got1 = np.asarray(
+            odeint_window(self.rhs, y0, 0.0, 1.0, 100, method="implicit"),
+            np.float64,
+        )
+        assert np.isfinite(got2).all()
+        err2 = abs(got2[0] - ref[0]) + abs(got2[2] - ref[2])
+        err1 = abs(got1[0] - ref[0]) + abs(got1[2] - ref[2])
+        assert err2 < err1 / 10.0, (err1, err2)  # adaptive Newton reaches the f32 floor
+        # and absolutely accurate on the O(1) components
+        np.testing.assert_allclose(got2[0], ref[0], rtol=2e-4)
+        np.testing.assert_allclose(got2[2], ref[2], atol=2e-4)
+        np.testing.assert_allclose(float(got2.sum()), 1.0, rtol=1e-5)
+
+    def test_second_order_convergence(self):
+        """Halving dt must cut the error ~4x (order 2) on a nonlinear
+        non-stiff problem with a tight oracle."""
+
+        def rhs(t, y, args):
+            return -y * y  # y(t) = 1 / (1 + t)
+
+        errs = []
+        for n, dt in ((16, 0.25), (32, 0.125), (64, 0.0625)):
+            got = float(
+                odeint_window(rhs, jnp.asarray(1.0), 0.0, dt, n,
+                              method="tr_bdf2")
+            )
+            errs.append(abs(got - 1.0 / 5.0))
+        assert errs[0] / errs[1] > 3.0, errs
+        assert errs[1] / errs[2] > 3.0, errs
+
+    def test_l_stable_where_rk4_diverges(self):
+        """Stiff decay at |lambda| dt = 500: explicit steppers explode,
+        TR-BDF2 damps to the slow manifold."""
+
+        def rhs(t, y, args):
+            return jnp.stack([-500.0 * (y[0] - jnp.cos(t)), -0.1 * y[1]])
+
+        y0 = jnp.asarray([0.0, 1.0])
+        got = np.asarray(
+            odeint_window(rhs, y0, 0.0, 1.0, 10, method="tr_bdf2")
+        )
+        assert np.isfinite(got).all()
+        assert abs(got[0] - np.cos(10.0)) < 0.05
+        bad = np.asarray(odeint_window(rhs, y0, 0.0, 1.0, 10, method="rk4"))
+        assert not np.isfinite(bad).all() or abs(bad[0]) > 1e3
+
+    def test_pytree_and_vmap(self):
+        def rhs(t, y, args):
+            return {"x": -y["x"], "v": -50.0 * y["v"]}
+
+        y0 = {"x": jnp.ones(8) * jnp.arange(1, 9), "v": jnp.ones(8)}
+        out = jax.vmap(
+            lambda x, v: odeint_window(
+                rhs, {"x": x, "v": v}, 0.0, 0.5, 8, method="tr_bdf2"
+            )
+        )(y0["x"], y0["v"])
+        # dt = 0.5 on y' = -y: TR-BDF2's per-step error is ~5e-3 of y
+        # (second order with a visible constant); this test pins the
+        # pytree/vmap mechanics, accuracy is pinned above
+        np.testing.assert_allclose(
+            np.asarray(out["x"]),
+            np.arange(1, 9) * np.exp(-4.0),
+            rtol=5e-2,
+        )
+        assert np.all(np.abs(np.asarray(out["v"])) < 1e-6)
