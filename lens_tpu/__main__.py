@@ -90,6 +90,25 @@ def _build_parser() -> argparse.ArgumentParser:
             metavar="AGENTSxSPACE",
             help="shard over a device mesh, e.g. 4x2 (spatial models)",
         )
+        def _free_frac(value: str) -> float:
+            frac = float(value)
+            if not 0.0 < frac < 1.0:
+                raise argparse.ArgumentTypeError(
+                    f"FREE_FRAC must be a fraction in (0, 1), got {frac}"
+                )
+            return frac
+
+        sp.add_argument(
+            "--auto-expand",
+            nargs="?",
+            const=0.2,
+            default=None,
+            type=_free_frac,
+            metavar="FREE_FRAC",
+            help="double colony capacity at segment boundaries when the "
+            "free-row fraction drops to this value (default 0.2); needs "
+            "--checkpoint-every to define segments",
+        )
         sp.add_argument("--quiet", action="store_true")
         sp.add_argument(
             "--trace",
@@ -136,6 +155,18 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _validate_run_args(args: argparse.Namespace) -> None:
+    """Flag cross-checks that must fire BEFORE any jax import (backend
+    init can block on a dead relay — fail fast on bad flags instead)."""
+    if args.auto_expand is not None and not args.checkpoint_every:
+        # expansion fires at segment boundaries; one big segment means
+        # the flag would silently do nothing until the run is over
+        raise SystemExit(
+            "--auto-expand needs --checkpoint-every to define the "
+            "segments at which expansion can happen"
+        )
+
+
 def _experiment_config(args: argparse.Namespace) -> dict:
     emitter: dict = {"type": args.emitter}
     checkpoint_dir = None
@@ -145,6 +176,11 @@ def _experiment_config(args: argparse.Namespace) -> dict:
         checkpoint_dir = f"{args.out_dir}/checkpoints"
     return {
         "mesh": args.mesh,
+        "auto_expand": (
+            {"free_frac": args.auto_expand, "factor": 2}
+            if args.auto_expand is not None
+            else None
+        ),
         "composite": args.composite,
         "config": json.loads(args.config),
         "n_agents": args.n_agents,
@@ -213,6 +249,8 @@ def main(argv=None) -> int:
         )
         print(f"plot: {out['plot']}")
         return 0
+
+    _validate_run_args(args)
 
     import contextlib
 

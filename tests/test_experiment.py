@@ -554,3 +554,25 @@ class TestAutoExpandWithMesh:
             np.asarray(state.colony.alive)
         ]
         assert len(np.unique(ids)) == len(ids)
+
+
+class TestCLIAutoExpand:
+    def test_run_command_with_auto_expand(self, capsys):
+        from lens_tpu.__main__ import main
+
+        rc = main(
+            [
+                "run",
+                "--composite", "grow_divide",
+                "--config", '{"growth": {"rate": 0.05}}',
+                "--n-agents", "6",
+                "--capacity", "8",
+                "--time", "30",
+                "--checkpoint-every", "5",
+                "--auto-expand", "0.3",
+                "--quiet",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "done:" in out

@@ -137,3 +137,104 @@ class TestFlagshipWindow:
             if jnp.issubdtype(leaf.dtype, jnp.floating):
                 assert bool(jnp.isfinite(leaf).all())
         assert bool(jnp.isfinite(out.fields).all())
+
+
+class TestFullNetworkOnDevice:
+    """Round-4 float32 envelope on the REAL chip: the canonical 72x95
+    e_coli_core must converge aerobic AND anaerobic, and the warm start
+    must cut iterations without changing what converged means."""
+
+    def _bounds(self, proc, env, n):
+        rng = np.random.default_rng(3)
+        base = np.zeros((n, len(proc.external)), np.float32)
+        for e, mol in enumerate(proc.external):
+            base[:, e] = env.get(mol, 0.0) * rng.uniform(0.8, 1.2, n)
+        return jax.vmap(lambda e: proc.regulated_bounds(e, 1.0))(
+            jnp.asarray(base)
+        )
+
+    def test_full_core_converges_both_regimes(self, tpu_device):
+        from lens_tpu.ops.linprog import flux_balance
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        proc = FBAMetabolism(
+            {"network": "ecoli_core_full", "lp_leak": 1.5e-3,
+             "lp_tol": 1e-5, "lp_iterations": 45}
+        )
+        solve = jax.jit(
+            jax.vmap(
+                lambda l, u: flux_balance(
+                    proc.stoichiometry, proc.objective, l, u,
+                    n_iter=45, tol=1e-5, leak=1.5e-3,
+                )
+            )
+        )
+        for env, lo, hi in (
+            ({"glc": 10.0, "o2": 50.0, "nh4": 50.0}, 0.07, 0.10),
+            ({"glc": 10.0, "nh4": 50.0}, 0.015, 0.025),
+        ):
+            lbs, ubs = self._bounds(proc, env, 128)
+            sol = jax.block_until_ready(solve(lbs, ubs))
+            conv = float(jnp.mean(sol.converged.astype(jnp.float32)))
+            assert conv == 1.0, (env, conv)
+            mu = float(jnp.mean(sol.objective))
+            assert lo < mu < hi, (env, mu)
+
+    def test_warm_start_cuts_iterations_on_device(self, tpu_device):
+        from lens_tpu.ops.linprog import flux_balance
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        proc = FBAMetabolism(
+            {"network": "ecoli_core_full", "lp_leak": 1.5e-3,
+             "lp_tol": 1e-5, "lp_iterations": 45}
+        )
+        lbs, ubs = self._bounds(
+            proc, {"glc": 10.0, "o2": 50.0, "nh4": 50.0}, 128
+        )
+        cold = jax.jit(
+            jax.vmap(
+                lambda l, u: flux_balance(
+                    proc.stoichiometry, proc.objective, l, u,
+                    n_iter=45, tol=1e-5, leak=1.5e-3,
+                )
+            )
+        )
+        warm = jax.jit(
+            jax.vmap(
+                lambda l, u, w: flux_balance(
+                    proc.stoichiometry, proc.objective, l, u,
+                    n_iter=45, tol=1e-5, leak=1.5e-3, warm=w,
+                )
+            )
+        )
+        a = jax.block_until_ready(cold(lbs, ubs))
+        b = jax.block_until_ready(warm(lbs, ubs, a.warm))
+        assert float(jnp.mean(b.converged.astype(jnp.float32))) == 1.0
+        # same problems re-solved from their own optimum: the warm pass
+        # must be several times cheaper in max-lane iterations
+        assert int(jnp.max(b.iterations)) <= int(jnp.max(a.iterations)) // 2
+        # and land on the same objective to tolerance
+        np.testing.assert_allclose(
+            np.asarray(b.objective), np.asarray(a.objective), atol=2e-3
+        )
+
+
+class TestExpandedColonyWindowOnDevice:
+    def test_config2_window_after_expansion_finite(self, tpu_device):
+        """A capacity-expanded colony's next window runs clean on the
+        chip (recompile at the new shape, frozen dead rows stay inert)."""
+        from lens_tpu.models.composites import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 512, "shape": (32, 32), "division": True,
+             "growth": {"rate": 0.05}}
+        )
+        ss = spatial.initial_state(256, jax.random.PRNGKey(0))
+        ss, _ = spatial.run(ss, 8.0, 1.0, emit_every=8)
+        spatial2, ss2 = spatial.expanded(ss, 2)
+        ss2, traj = spatial2.run(ss2, 8.0, 1.0, emit_every=8)
+        assert int(ss2.colony.alive.shape[0]) == 1024
+        assert bool(jnp.all(jnp.isfinite(ss2.fields)))
+        assert bool(
+            jnp.all(jnp.isfinite(traj["global"]["volume"]))
+        )
