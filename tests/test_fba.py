@@ -745,3 +745,38 @@ class TestEcoliCoreFullNetwork:
         m = np.asarray(traj["global"]["mass"])
         alive = np.asarray(traj["alive"])
         assert (m[-1][alive[-1]] > m[0][alive[-1]]).all()
+
+
+    def test_full_network_anaerobic_shift_timeline(self):
+        """Aerobic -> anaerobic era via a media timeline on the FULL
+        network (full_* recipes): after oxygen disappears the colony
+        switches to mixed-acid fermentation — PFL carries flux and
+        formate/ethanol land in the lattice."""
+        from lens_tpu.models.composites import rfba_lattice
+
+        spatial, _ = rfba_lattice(
+            {
+                "capacity": 16,
+                "shape": (8, 8),
+                "division": False,
+                "motility": {"sigma": 0.0},
+                "metabolism": {"network": "ecoli_core_full"},
+            }
+        )
+        ss = spatial.initial_state(8, jax.random.PRNGKey(1))
+        ss, traj = spatial.run_timeline(
+            ss, "0 full_aerobic_glucose, 10 full_anaerobic_glucose",
+            20.0, 1.0, emit_every=2,
+        )
+        fields = np.asarray(traj["fields"])
+        o2 = spatial.lattice.index("o2")
+        formate = spatial.lattice.index("for")
+        assert fields[3, o2].mean() > 2.0       # aerobic era (minus uptake)
+        assert fields[6, o2].mean() < 0.5       # media shift took
+        assert fields[-1, formate].mean() > 1e-3  # fermentation products
+        v = np.asarray(ss.colony.agents["fluxes"]["reaction_fluxes"])
+        alive = np.asarray(ss.colony.alive)
+        p = full_process()
+        assert (v[alive][:, p.reactions.index("PFL")] > 0.05).all()
+        growth = np.asarray(ss.colony.agents["fluxes"]["growth_rate"])[alive]
+        assert (growth > 0.01).all()            # still growing, slower
