@@ -1,0 +1,23 @@
+#!/bin/bash
+# Canonical suite invocation for this box: TWO pytest processes.
+#
+# Since 2026-07-30 ~21:45 this machine's XLA CPU compiler segfaults
+# probabilistically in LONG-lived processes with many compiles behind
+# them (observed at different tests, with and without the axon PJRT
+# plugin on PYTHONPATH, with the persistent compilation cache shared,
+# fresh, and disabled — traces in SURVEY.md header). Short-lived
+# processes have never crashed: the same suite is consistently green
+# split in two (~10 min each). Until the environment recovers, run it
+# this way; `python -m pytest tests/ -q` remains the honest single
+# invocation to try first on a healthy box.
+set -u
+cd "$(dirname "$0")"
+files=$(ls tests/test_*.py)
+n=$(echo "$files" | wc -l)
+half=$(( (n + 1) / 2 ))
+first=$(echo "$files" | head -n "$half" | tr '\n' ' ')
+second=$(echo "$files" | tail -n +"$((half + 1))" | tr '\n' ' ')
+rc=0
+python -m pytest $first -q "$@" || rc=$?
+python -m pytest $second -q "$@" || rc=$?
+exit $rc

@@ -24,8 +24,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+# NO persistent compilation cache for the suite. Three independent
+# full-suite segfaults (2026-07-30/31) traced into the persistent
+# cache's executable (de)serialization — one mid-READ of a torn entry
+# in the shared dir, one mid-WRITE into a FRESH per-session dir —
+# always on the large sharded executables, and only in long-lived
+# processes. The in-memory jit cache fully covers a test session;
+# cross-run compile reuse is not worth a crashing suite. (Examples and
+# benches keep their shared dir: their long compiles benefit and their
+# executables have not exhibited the crash.)
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+os.environ.pop("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", None)
 
 import jax  # noqa: E402  (import after env setup is the whole point)
 
