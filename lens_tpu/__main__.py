@@ -58,7 +58,20 @@ def _build_parser() -> argparse.ArgumentParser:
         sp.add_argument(
             "--config", default="{}", help="composite config as JSON"
         )
-        sp.add_argument("--n-agents", type=int, default=1)
+        def _n_agents(value: str):
+            # int for single-species composites; a JSON dict for
+            # multi-species ones, e.g. '{"ecoli": 100, "scavenger": 50}'
+            try:
+                return int(value)
+            except ValueError:
+                parsed = json.loads(value)
+                if not isinstance(parsed, dict):
+                    raise argparse.ArgumentTypeError(
+                        f"expected an int or a JSON dict, got {value!r}"
+                    )
+                return parsed
+
+        sp.add_argument("--n-agents", type=_n_agents, default=1)
         sp.add_argument("--capacity", type=int, default=None)
         sp.add_argument("--time", type=float, default=100.0, help="sim seconds")
         sp.add_argument("--timestep", type=float, default=1.0)

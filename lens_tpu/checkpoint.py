@@ -24,6 +24,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from lens_tpu.colony.colony import ColonyState
+from lens_tpu.environment.multispecies import MultiSpeciesState
 from lens_tpu.environment.spatial import SpatialState
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -41,6 +42,13 @@ def _to_plain(state: Any) -> Any:
             "spatial_colony": _to_plain(state.colony),
             "fields": state.fields,
         }
+    if isinstance(state, MultiSpeciesState):
+        return {
+            "species_colonies": {
+                name: _to_plain(cs) for name, cs in state.species.items()
+            },
+            "fields": state.fields,
+        }
     if isinstance(state, ColonyState):
         return {
             "agents": state.agents,
@@ -53,6 +61,14 @@ def _to_plain(state: Any) -> Any:
 
 def _from_plain(plain: Any) -> Any:
     keys = set(plain)
+    if keys == {"species_colonies", "fields"}:
+        return MultiSpeciesState(
+            species={
+                name: _from_plain(cs)
+                for name, cs in plain["species_colonies"].items()
+            },
+            fields=plain["fields"],
+        )
     if keys == {"spatial_colony", "fields"}:
         return SpatialState(
             colony=_from_plain(plain["spatial_colony"]),

@@ -279,6 +279,43 @@ class MultiSpeciesColony:
             total_time, timestep, emit_every,
         )
 
+    # -- capacity growth -----------------------------------------------------
+
+    def expanded(
+        self,
+        ms: MultiSpeciesState,
+        factors: Mapping[str, int] | int = 2,
+    ) -> Tuple["MultiSpeciesColony", MultiSpeciesState]:
+        """Per-species capacity growth (host-side, segment boundary).
+
+        ``factors``: one int for every species, or a per-species mapping
+        (missing / <=1 leaves that species untouched — species fill their
+        pools at different rates, so growth is naturally per-species).
+        Delegates to :meth:`lens_tpu.colony.colony.Colony.expanded` per
+        species (pre-expansion trajectories bitwise unchanged, lineage id
+        watermarks carried), shares the untouched lattice fields, and
+        rebuilds the wrapper with the same lattice/wiring.
+        """
+        new_species: Dict[str, SpatialColony] = {}
+        new_states: Dict[str, ColonyState] = {}
+        for name, sp in self.species.items():
+            f = factors if isinstance(factors, int) else int(
+                factors.get(name, 1)
+            )
+            if f <= 1:
+                new_species[name] = sp
+                new_states[name] = ms.species[name]
+                continue
+            grown, cs = sp.colony.expanded(ms.species[name], f)
+            new_species[name] = sp.with_colony(grown)
+            new_states[name] = cs
+        multi = MultiSpeciesColony(
+            new_species, self.lattice, share_bins=self.share_bins
+        )
+        return multi, MultiSpeciesState(
+            species=new_states, fields=ms.fields
+        )
+
     # -- diagnostics ---------------------------------------------------------
 
     def total_field_mass(self, ms: MultiSpeciesState) -> jax.Array:

@@ -86,6 +86,19 @@ class SpatialColony:
 
     # -- construction --------------------------------------------------------
 
+    def with_colony(self, colony: Colony) -> "SpatialColony":
+        """Rewrap a (typically capacity-grown) colony with this
+        SpatialColony's lattice and wiring — the ONE place the
+        constructor-argument set is repeated, so expansion/resume paths
+        cannot silently drop a newly added parameter."""
+        return SpatialColony(
+            colony,
+            self.lattice,
+            self.field_ports,
+            location_path=self.location_path,
+            share_bins=self.share_bins,
+        )
+
     def expanded(
         self, ss: SpatialState, factor: int = 2
     ) -> Tuple["SpatialColony", SpatialState]:
@@ -95,14 +108,7 @@ class SpatialColony:
         (padded rows are dead, parked at location 0 like every dead
         row)."""
         grown, cs = self.colony.expanded(ss.colony, factor)
-        spatial = SpatialColony(
-            grown,
-            self.lattice,
-            self.field_ports,
-            location_path=self.location_path,
-            share_bins=self.share_bins,
-        )
-        return spatial, ss._replace(colony=cs)
+        return self.with_colony(grown), ss._replace(colony=cs)
 
     def initial_state(
         self,

@@ -33,6 +33,10 @@ from lens_tpu.checkpoint import Checkpointer
 from lens_tpu.colony.colony import Colony, ColonyState
 from lens_tpu.core.engine import Compartment
 from lens_tpu.emit import Emitter, get_emitter
+from lens_tpu.environment.multispecies import (
+    MultiSpeciesColony,
+    MultiSpeciesState,
+)
 from lens_tpu.environment.spatial import SpatialColony, SpatialState
 from lens_tpu.models.composites import composite_registry
 from lens_tpu.utils.dicts import deep_merge
@@ -93,7 +97,26 @@ class Experiment:
             )
         built = composite_registry[name](self.config["config"])
         self.spatial: Optional[SpatialColony] = None
-        if isinstance(built, tuple):  # (SpatialColony, Compartment)
+        self.multi = None  # MultiSpeciesColony composites (config 4)
+        if isinstance(built, tuple) and isinstance(
+            built[0], MultiSpeciesColony
+        ):
+            # (MultiSpeciesColony, {name: Compartment})
+            self.multi, self.compartment = built
+            self.colony = None
+            if self.config["mesh"]:
+                raise ValueError(
+                    "config 'mesh' with a multi-species composite: use "
+                    "parallel.ShardedMultiSpeciesColony directly (the "
+                    "Experiment mesh path wraps single-species spatial "
+                    "models)"
+                )
+            if self.config["timeline"] is not None:
+                raise ValueError(
+                    "media timelines are not wired for multi-species "
+                    "composites yet"
+                )
+        elif isinstance(built, tuple):  # (SpatialColony, Compartment)
             self.spatial, self.compartment = built
             self.colony = self.spatial.colony
         elif isinstance(built, Compartment):
@@ -153,6 +176,20 @@ class Experiment:
 
     def initial_state(self):
         key = jax.random.PRNGKey(int(self.config["seed"]))
+        if self.multi is not None:
+            n_cfg = self.config["n_agents"]
+            if not isinstance(n_cfg, Mapping):
+                raise ValueError(
+                    "multi-species composites need n_agents as a "
+                    'per-species dict, e.g. {"ecoli": 100, "scavenger": 50}'
+                    " (the CLI accepts the same as JSON: "
+                    "--n-agents '{\"ecoli\": 100, ...}')"
+                )
+            return self.multi.initial_state(
+                {k: int(v) for k, v in n_cfg.items()},
+                key,
+                overrides=self.config["overrides"] or None,
+            )
         n = int(self.config["n_agents"])
         overrides = self.config["overrides"] or None
         if self.runner is not None:
@@ -189,6 +226,8 @@ class Experiment:
                     emit_every, start_time=start_time,
                 )
             return self.runner.run(state, duration, dt, emit_every)
+        if self.multi is not None:
+            return self.multi.run(state, duration, dt, emit_every)
         if self.spatial is not None:
             if self.config["timeline"] is not None:
                 return self.spatial.run_timeline(
@@ -199,7 +238,11 @@ class Experiment:
         return self.colony.run(state, duration, dt, emit_every)
 
     def _state_step(self, state) -> int:
-        cs = state.colony if isinstance(state, SpatialState) else state
+        if isinstance(state, MultiSpeciesState):
+            # all species advance in lockstep inside one jitted step
+            cs = next(iter(state.species.values()))
+        else:
+            cs = state.colony if isinstance(state, SpatialState) else state
         return int(cs.step)
 
     # -- capacity growth -----------------------------------------------------
@@ -217,12 +260,24 @@ class Experiment:
         factor = int(cfg.get("factor", 2))
         free_frac = float(cfg.get("free_frac", 0.2))
         max_cap = cfg.get("max_capacity")
-        cs = state.colony if isinstance(state, SpatialState) else state
-        cap = int(cs.alive.shape[0])
-        if max_cap is not None and cap * factor > int(max_cap):
+
+        def wants_growth(cs) -> bool:
+            cap = int(cs.alive.shape[0])
+            if max_cap is not None and cap * factor > int(max_cap):
+                return False
+            free = int(np.sum(~np.asarray(jax.device_get(cs.alive))))
+            return free <= free_frac * cap
+
+        if self.multi is not None:
+            factors = {
+                name: factor if wants_growth(state.species[name]) else 1
+                for name in self.multi.species
+            }
+            if any(f > 1 for f in factors.values()):
+                self.multi, state = self.multi.expanded(state, factors)
             return state
-        free = int(np.sum(~np.asarray(jax.device_get(cs.alive))))
-        if free > free_frac * cap:
+        cs = state.colony if isinstance(state, SpatialState) else state
+        if not wants_growth(cs):
             return state
         if self.runner is not None:
             return self._expand_sharded(state, factor)
@@ -276,15 +331,25 @@ class Experiment:
         id offset, neither of which is derivable from the config alone."""
         from lens_tpu.parallel.distributed import is_coordinator
 
-        if is_coordinator():
-            with open(self._colony_meta_path(), "w") as f:
-                json.dump(
-                    {
-                        "capacity": self.colony.capacity,
-                        "id_offset": self.colony.id_offset,
-                    },
-                    f,
-                )
+        if not is_coordinator():
+            return
+        if self.multi is not None:
+            meta = {
+                "species": {
+                    name: {
+                        "capacity": sp.colony.capacity,
+                        "id_offset": sp.colony.id_offset,
+                    }
+                    for name, sp in self.multi.species.items()
+                }
+            }
+        else:
+            meta = {
+                "capacity": self.colony.capacity,
+                "id_offset": self.colony.id_offset,
+            }
+        with open(self._colony_meta_path(), "w") as f:
+            json.dump(meta, f)
 
     def run(self, state=None, verbose: bool = False):
         """Run ``total_time``, emitting and checkpointing per segment.
@@ -390,6 +455,9 @@ class Experiment:
             self.emitter.emit_trajectory(pending[0], times=pending[1])
 
     def n_alive(self, state):
+        if self.multi is not None:
+            counts = self.multi.n_alive(state)
+            return sum(counts.values())
         cs = state.colony if isinstance(state, SpatialState) else state
         return self.colony.n_alive(cs)
 
@@ -423,6 +491,9 @@ class Experiment:
         colliding lineage ids."""
         import os
 
+        if self.multi is not None:
+            self._adopt_restored_capacity_multi(state)
+            return
         cs = state.colony if isinstance(state, SpatialState) else state
         cap = int(cs.alive.shape[0])
         if cap == self.colony.capacity:
@@ -448,13 +519,7 @@ class Experiment:
             id_offset=int(meta["id_offset"]),
         )
         if self.spatial is not None:
-            self.spatial = SpatialColony(
-                grown,
-                self.spatial.lattice,
-                self.spatial.field_ports,
-                location_path=self.spatial.location_path,
-                share_bins=self.spatial.share_bins,
-            )
+            self.spatial = self.spatial.with_colony(grown)
             if self.runner is not None:
                 from lens_tpu.parallel import ShardedSpatialColony
 
@@ -462,6 +527,54 @@ class Experiment:
                     self.spatial, self.runner.mesh
                 )
         self.colony = grown
+
+    def _adopt_restored_capacity_multi(self, state) -> None:
+        import os
+
+        caps = {
+            name: int(cs.alive.shape[0])
+            for name, cs in state.species.items()
+        }
+        if caps == {
+            name: sp.colony.capacity
+            for name, sp in self.multi.species.items()
+        }:
+            return
+        meta_path = self._colony_meta_path()
+        if not os.path.exists(meta_path):
+            raise ValueError(
+                f"checkpoint species capacities {caps} differ from the "
+                f"config's, and no colony_meta.json sidecar records the "
+                f"expansion (was the checkpoint moved?)"
+            )
+        with open(meta_path) as f:
+            meta = json.load(f)["species"]
+        # rebuild EVERY species whose capacity differs from the restored
+        # state's, in either direction (a user may have edited the config
+        # capacity since the checkpoint — the state, not the config, is
+        # authoritative), at the sidecar's id offset (expanded() would
+        # recompute a wrong offset from the config-sized colony)
+        species = {}
+        for name, sp in self.multi.species.items():
+            if int(meta[name]["capacity"]) != caps[name]:
+                raise ValueError(
+                    f"colony_meta.json says {name} capacity "
+                    f"{meta[name]['capacity']} but the checkpoint has "
+                    f"{caps[name]} rows"
+                )
+            if caps[name] == sp.colony.capacity:
+                species[name] = sp
+                continue
+            grown = Colony(
+                sp.colony.compartment,
+                caps[name],
+                division_trigger=sp.colony.division_trigger,
+                id_offset=int(meta[name]["id_offset"]),
+            )
+            species[name] = sp.with_colony(grown)
+        self.multi = MultiSpeciesColony(
+            species, self.multi.lattice, share_bins=self.multi.share_bins
+        )
 
     def close(self) -> None:
         self.emitter.close()

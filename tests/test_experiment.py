@@ -576,3 +576,82 @@ class TestCLIAutoExpand:
         assert rc == 0
         out = capsys.readouterr().out
         assert "done:" in out
+
+
+class TestMultiSpeciesExperiment:
+    """Config-4 composites through the L5 layer: the Experiment runs,
+    emits, checkpoints, auto-expands, and resumes MIXED-SPECIES colonies
+    the same way it does single-species ones."""
+
+    def config(self, tmp_path=None, **over):
+        cfg = {
+            "composite": "mixed_species_lattice",
+            "config": {
+                "capacity": {"ecoli": 8, "scavenger": 8},
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "ecoli": {"motility": {"sigma": 0.0},
+                          "growth": {"rate": 0.05}},
+                "scavenger": {"motility": {"sigma": 0.0},
+                              "growth": {"rate": 0.02}},
+            },
+            "n_agents": {"ecoli": 6, "scavenger": 4},
+            "total_time": 30.0,
+            "checkpoint_every": 5.0,
+            "auto_expand": {"free_frac": 0.3, "factor": 2},
+            "seed": 7,
+        }
+        if tmp_path is not None:
+            cfg["checkpoint_dir"] = str(tmp_path / "ckpt")
+            cfg["emitter"] = {"type": "null"}
+        cfg.update(over)
+        return cfg
+
+    def test_runs_emits_and_expands_per_species(self):
+        with Experiment(self.config()) as exp:
+            state = exp.run()
+            ts = exp.emitter.timeseries()
+        # ecoli (fast divider) outgrew its 8 rows and expanded; the
+        # population actually multiplied
+        caps = {n: int(cs.alive.shape[0]) for n, cs in state.species.items()}
+        assert caps["ecoli"] > 8, caps
+        alive = {n: int(np.asarray(cs.alive).sum())
+                 for n, cs in state.species.items()}
+        assert alive["ecoli"] >= 4 * 6 - 4, alive   # ~2 doublings
+        # emitted per-species subtrees stacked across the capacity jump
+        assert ts["ecoli"]["alive"].shape[1] >= 8
+        assert (np.asarray(ts["ecoli"]["division_backlog"]) == 0).all()
+        assert "fields" in ts
+
+    def test_checkpoint_resume_after_expansion(self, tmp_path):
+        with Experiment(self.config(tmp_path)) as exp:
+            full = exp.run()
+        cfg_b = self.config(tmp_path, total_time=15.0)
+        cfg_b["checkpoint_dir"] = str(tmp_path / "b")
+        with Experiment(cfg_b) as exp:
+            mid = exp.run()
+        assert int(mid.species["ecoli"].alive.shape[0]) > 8
+        cfg_c = dict(cfg_b, total_time=30.0)
+        with Experiment(cfg_c) as exp:
+            resumed = exp.resume()
+            caps = {n: sp.colony.capacity
+                    for n, sp in exp.multi.species.items()}
+            assert caps["ecoli"] == int(
+                resumed.species["ecoli"].alive.shape[0]
+            )
+        for name in full.species:
+            np.testing.assert_array_equal(
+                np.asarray(full.species[name].alive),
+                np.asarray(resumed.species[name].alive),
+                err_msg=name,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(full.species[name].agents["global"]["volume"]),
+                np.asarray(resumed.species[name].agents["global"]["volume"]),
+                err_msg=name,
+            )
+
+    def test_scalar_n_agents_rejected(self):
+        with pytest.raises(ValueError, match="per-species dict"):
+            with Experiment(self.config(n_agents=4)) as exp:
+                exp.initial_state()
