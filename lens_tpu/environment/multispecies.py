@@ -279,6 +279,41 @@ class MultiSpeciesColony:
             total_time, timestep, emit_every,
         )
 
+    def run_timeline(
+        self,
+        ms: MultiSpeciesState,
+        timeline,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+        start_time: float = 0.0,
+    ) -> Tuple[MultiSpeciesState, dict]:
+        """Run with media changes: same semantics as
+        ``SpatialColony.run_timeline`` (one shared helper —
+        environment.media.run_media_timeline): the timeline splits the
+        run into segments, each segment is one jitted scan, and at each
+        media EVENT the shared fields are rebuilt from the new recipe.
+        ``start_time`` is absolute, so checkpointed segments / resumes
+        continue the timeline instead of restarting it."""
+        from lens_tpu.environment.media import (
+            fields_from_media,
+            run_media_timeline,
+        )
+
+        def reset_fields(s, media):
+            return s._replace(
+                fields=fields_from_media(self.lattice, media)
+            )
+
+        return run_media_timeline(
+            ms,
+            timeline,
+            total_time,
+            start_time,
+            run_segment=lambda s, d: self.run(s, d, timestep, emit_every),
+            reset_fields=reset_fields,
+        )
+
     # -- capacity growth -----------------------------------------------------
 
     def expanded(

@@ -111,11 +111,6 @@ class Experiment:
                     "Experiment mesh path wraps single-species spatial "
                     "models)"
                 )
-            if self.config["timeline"] is not None:
-                raise ValueError(
-                    "media timelines are not wired for multi-species "
-                    "composites yet"
-                )
         elif isinstance(built, tuple):  # (SpatialColony, Compartment)
             self.spatial, self.compartment = built
             self.colony = self.spatial.colony
@@ -185,6 +180,13 @@ class Experiment:
                     " (the CLI accepts the same as JSON: "
                     "--n-agents '{\"ecoli\": 100, ...}')"
                 )
+            unknown = set(n_cfg) - set(self.multi.species)
+            if unknown:
+                # a typo would otherwise silently boot that species empty
+                raise ValueError(
+                    f"n_agents names unknown species {sorted(unknown)}; "
+                    f"this composite has {sorted(self.multi.species)}"
+                )
             return self.multi.initial_state(
                 {k: int(v) for k, v in n_cfg.items()},
                 key,
@@ -227,6 +229,11 @@ class Experiment:
                 )
             return self.runner.run(state, duration, dt, emit_every)
         if self.multi is not None:
+            if self.config["timeline"] is not None:
+                return self.multi.run_timeline(
+                    state, self.config["timeline"], duration, dt,
+                    emit_every, start_time=start_time,
+                )
             return self.multi.run(state, duration, dt, emit_every)
         if self.spatial is not None:
             if self.config["timeline"] is not None:
@@ -507,6 +514,12 @@ class Experiment:
             )
         with open(meta_path) as f:
             meta = json.load(f)
+        if "capacity" not in meta:
+            raise ValueError(
+                f"colony_meta.json at {meta_path} is not a single-species "
+                f"sidecar (keys {sorted(meta)}) — was the checkpoint "
+                f"directory reused from a multi-species run?"
+            )
         if int(meta["capacity"]) != cap:
             raise ValueError(
                 f"colony_meta.json says capacity {meta['capacity']} but the "
@@ -548,7 +561,16 @@ class Experiment:
                 f"expansion (was the checkpoint moved?)"
             )
         with open(meta_path) as f:
-            meta = json.load(f)["species"]
+            loaded = json.load(f)
+        meta = loaded.get("species")
+        if meta is None or set(meta) != set(self.multi.species):
+            raise ValueError(
+                f"colony_meta.json at {meta_path} does not describe this "
+                f"composite's species {sorted(self.multi.species)} (found "
+                f"{sorted(meta) if meta else 'a single-species sidecar'}) "
+                f"— was the checkpoint directory reused or a species "
+                f"renamed?"
+            )
         # rebuild EVERY species whose capacity differs from the restored
         # state's, in either direction (a user may have edited the config
         # capacity since the checkpoint — the state, not the config, is
