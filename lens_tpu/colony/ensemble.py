@@ -28,15 +28,25 @@ gain a replicate axis after time: ``[T, R, ...]``.
 Note ``lax.cond``-guarded work (division) runs unconditionally under
 ``vmap`` (cond becomes select across lanes) — the ensemble trades that
 small overhead for R-way parallelism.
+
+Replicates need not be identical twins: ``initial_state`` accepts
+``replicate_overrides`` — a nested mapping whose leaves carry a leading
+``[R, ...]`` axis — so the same one-compile program doubles as a
+**parameter scan** (R initial conditions / parameter values stepped in
+lock-step on one chip). The reference lineage runs a scan as R separate
+experiment processes (SURVEY.md §3.3: one cluster of OS processes per
+experiment); here it is one more ``in_axes`` entry.
 """
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Mapping, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from lens_tpu.core.schedule import scan_schedule
+from lens_tpu.utils.dicts import flatten_paths, set_path
 
 
 class Ensemble:
@@ -54,14 +64,62 @@ class Ensemble:
         self.sim = sim
         self.n_replicates = int(n_replicates)
 
-    def initial_state(self, *args, key: jax.Array, **kwargs):
+    def initial_state(
+        self,
+        *args,
+        key: jax.Array,
+        replicate_overrides: Mapping | None = None,
+        **kwargs,
+    ):
         """Stacked initial states: ``sim.initial_state`` vmapped over
         ``n_replicates`` keys split from ``key`` (all other arguments are
-        shared and static across replicates)."""
+        shared and static across replicates).
+
+        ``replicate_overrides`` turns the ensemble into a parameter scan:
+        a nested mapping of schema-variable paths to arrays with a leading
+        ``[n_replicates, ...]`` axis. Replicate ``r``'s slice is merged
+        over the shared ``overrides`` kwarg (per-replicate wins on a path
+        collision) and flows through the sim's own override validation —
+        a ``[R]`` leaf sets one scalar per replicate (broadcast to every
+        agent), a ``[R, capacity, ...]`` leaf sets per-agent values per
+        replicate.
+        """
         keys = jax.random.split(key, self.n_replicates)
-        return jax.vmap(
-            lambda k: self.sim.initial_state(*args, key=k, **kwargs)
-        )(keys)
+        if not replicate_overrides:
+            return jax.vmap(
+                lambda k: self.sim.initial_state(*args, key=k, **kwargs)
+            )(keys)
+        if len(args) > 1:
+            # Colony's 2nd positional is `overrides` but SpatialColony's
+            # is `key`, so a positional arg here can't be merged safely —
+            # it would either collide with the overrides kwarg below or
+            # silently skip the documented per-replicate merge.
+            raise ValueError(
+                "with replicate_overrides, pass the sim's other "
+                "initial_state arguments (overrides, locations, ...) as "
+                "keywords, not positionally"
+            )
+        shared = kwargs.pop("overrides", None) or {}
+        rep = {}
+        for path, value in flatten_paths(replicate_overrides):
+            value = jnp.asarray(value)
+            if value.ndim < 1 or value.shape[0] != self.n_replicates:
+                raise ValueError(
+                    f"replicate override {path} needs a leading "
+                    f"[n_replicates={self.n_replicates}] axis, got shape "
+                    f"{value.shape}"
+                )
+            rep[path] = value
+
+        def build(k, rep_slice):
+            merged = dict(shared)
+            for path, value in rep_slice.items():
+                merged = set_path(merged, path, value)
+            return self.sim.initial_state(
+                *args, key=k, overrides=merged, **kwargs
+            )
+
+        return jax.vmap(build)(keys, rep)
 
     def step(self, states, timestep: float):
         return jax.vmap(lambda s: self.sim.step(s, timestep))(states)
