@@ -122,6 +122,15 @@ def _build_parser() -> argparse.ArgumentParser:
             "free-row fraction drops to this value (default 0.2); needs "
             "--checkpoint-every to define segments",
         )
+        sp.add_argument(
+            "--replicates",
+            type=int,
+            default=None,
+            metavar="R",
+            help="run R independent replicates as one device program "
+            "(colony.Ensemble); emission gains a [T, R, ...] layout and "
+            "`analyze` renders fan charts",
+        )
         sp.add_argument("--quiet", action="store_true")
         sp.add_argument(
             "--trace",
@@ -178,6 +187,15 @@ def _validate_run_args(args: argparse.Namespace) -> None:
             "--auto-expand needs --checkpoint-every to define the "
             "segments at which expansion can happen"
         )
+    if args.replicates is not None:
+        if args.replicates < 1:
+            raise SystemExit(f"--replicates must be >= 1, got {args.replicates}")
+        for flag in ("mesh", "auto_expand", "timeline"):
+            if getattr(args, flag) is not None:
+                raise SystemExit(
+                    f"--replicates does not compose with --{flag.replace('_', '-')} "
+                    "(see experiment.DEFAULT_CONFIG)"
+                )
 
 
 def _experiment_config(args: argparse.Namespace) -> dict:
@@ -206,6 +224,7 @@ def _experiment_config(args: argparse.Namespace) -> dict:
         "checkpoint_dir": checkpoint_dir,
         "checkpoint_every": args.checkpoint_every,
         "timeline": args.timeline,
+        "replicates": args.replicates,
     }
 
 

@@ -77,6 +77,16 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # evenly across agent shards); multi-host meshes not yet.
     # {"free_frac": 0.2, "factor": 2, "max_capacity": None}
     "auto_expand": None,
+    # Replicate ensembles (colony.Ensemble): N independent copies of the
+    # built sim stepped as ONE device program — the reference runs
+    # replicates as N separate experiment clusters (SURVEY.md §3.3).
+    # ``replicate_overrides`` (nested mapping, leaves [N, ...]) turns the
+    # replicate axis into a parameter scan. Emission gains a [T, R, ...]
+    # layout that analysis.report renders as fan charts. Composes with
+    # checkpoint/resume; NOT with mesh / auto_expand / timeline (gated at
+    # construction).
+    "replicates": None,
+    "replicate_overrides": {},
 }
 
 
@@ -130,6 +140,33 @@ class Experiment:
             raise TypeError(
                 f"composite factory {name!r} returned {type(built)!r}"
             )
+        # Replicates gates fire BEFORE any runner/distributed bring-up:
+        # initialize() can block on multi-host peers, and a doomed config
+        # must not get that far.
+        self.ensemble = None
+        if self.config["replicates"] is not None:
+            r = self.config["replicates"]
+            if not isinstance(r, int) or r < 1:
+                # truthiness would let 0 degrade to an unreplicated run
+                # and a float silently truncate downstream
+                raise ValueError(f"replicates must be an int >= 1, got {r!r}")
+            for gate, why in (
+                ("mesh", "shard the colony axis OR replicate it, not both "
+                 "through this layer (wrap parallel runners in "
+                 "colony.Ensemble directly if you need both)"),
+                ("auto_expand", "capacity expansion re-allocates unbatched "
+                 "states"),
+                ("timeline", "media timelines are not wired through the "
+                 "replicate axis yet (run one experiment per medium, or "
+                 "drive Ensemble + run_timeline by hand)"),
+            ):
+                if self.config[gate]:
+                    raise ValueError(f"'replicates' with '{gate}': {why}")
+        elif self.config["replicate_overrides"]:
+            raise ValueError(
+                "replicate_overrides without replicates: set "
+                "'replicates': N to enable the scan axis"
+            )
         self.runner = None
         if self.config["mesh"]:
             if self.spatial is None:
@@ -160,6 +197,11 @@ class Experiment:
                 "auto_expand on a multi-host mesh is not supported yet "
                 "(expansion gathers the full state to one host)"
             )
+        if self.config["replicates"] is not None:
+            from lens_tpu.colony.ensemble import Ensemble
+
+            sim = self.multi or self.spatial or self.colony
+            self.ensemble = Ensemble(sim, int(self.config["replicates"]))
         self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
         self.checkpointer = (
             Checkpointer(self.config["checkpoint_dir"])
@@ -187,8 +229,17 @@ class Experiment:
                     f"n_agents names unknown species {sorted(unknown)}; "
                     f"this composite has {sorted(self.multi.species)}"
                 )
+            counts = {k: int(v) for k, v in n_cfg.items()}
+            if self.ensemble is not None:
+                return self.ensemble.initial_state(
+                    counts,
+                    key=key,
+                    overrides=self.config["overrides"] or None,
+                    replicate_overrides=self.config["replicate_overrides"]
+                    or None,
+                )
             return self.multi.initial_state(
-                {k: int(v) for k, v in n_cfg.items()},
+                counts,
                 key,
                 overrides=self.config["overrides"] or None,
             )
@@ -198,6 +249,14 @@ class Experiment:
             stripe = bool(self.config["mesh"].get("stripe", True))
             return self.runner.initial_state(
                 n, key, stripe=stripe, overrides=overrides
+            )
+        if self.ensemble is not None:
+            return self.ensemble.initial_state(
+                n,
+                key=key,
+                overrides=overrides,
+                replicate_overrides=self.config["replicate_overrides"]
+                or None,
             )
         if self.spatial is not None:
             return self.spatial.initial_state(n, key, overrides=overrides)
@@ -221,6 +280,10 @@ class Experiment:
         # elapsed segments) — reading the device counter here would force
         # a sync and serialize the pipelined emission below.
         start_time = start_step * dt
+        if self.ensemble is not None:
+            # timelines are gated off at construction; the replicate axis
+            # runs the plain scan schedule
+            return self.ensemble.run(state, duration, dt, emit_every)
         if self.runner is not None:
             if self.config["timeline"] is not None:
                 return self.runner.run_timeline(
@@ -250,7 +313,9 @@ class Experiment:
             cs = next(iter(state.species.values()))
         else:
             cs = state.colony if isinstance(state, SpatialState) else state
-        return int(cs.step)
+        # Replicates advance in lockstep, so under an ensemble the step
+        # counter is [R] with equal entries — read any one.
+        return int(np.asarray(jax.device_get(cs.step)).reshape(-1)[0])
 
     # -- capacity growth -----------------------------------------------------
 
@@ -499,12 +564,28 @@ class Experiment:
         import os
 
         if self.multi is not None:
+            self._check_restored_replicates(
+                next(iter(state.species.values()))
+            )
             self._adopt_restored_capacity_multi(state)
             return
         cs = state.colony if isinstance(state, SpatialState) else state
-        cap = int(cs.alive.shape[0])
+        self._check_restored_replicates(cs)
+        # Row axis is LAST: an ensemble checkpoint's alive is [R, rows].
+        cap = int(cs.alive.shape[-1])
         if cap == self.colony.capacity:
             return
+        if self.ensemble is not None:
+            # auto_expand is gated off with replicates, so no legitimate
+            # run produced an expanded ensemble checkpoint — a capacity
+            # mismatch here is a config edit, and adopting it would step
+            # the restored state through a stale Ensemble-wrapped colony.
+            raise ValueError(
+                f"checkpoint has {cap} rows per replicate but the config "
+                f"builds capacity {self.colony.capacity}; with "
+                f"'replicates' set, resume with the capacity the run was "
+                f"checkpointed at"
+            )
         meta_path = self._colony_meta_path()
         if not os.path.exists(meta_path):
             raise ValueError(
@@ -541,11 +622,38 @@ class Experiment:
                 )
         self.colony = grown
 
+    def _check_restored_replicates(self, cs) -> None:
+        """A checkpoint's replicate axis must match the resume config:
+        alive is [rows] unreplicated, [R, rows] under an ensemble.
+        Silently stepping a mismatched state produces shape errors deep
+        in jit (or wrong dynamics) — fail loudly at restore instead."""
+        ndim = int(cs.alive.ndim)
+        if self.ensemble is None:
+            if ndim != 1:
+                raise ValueError(
+                    f"checkpoint state has a replicate axis (alive is "
+                    f"{ndim}-d) but the config does not set 'replicates' "
+                    f"— resume with the run's original replicates value"
+                )
+            return
+        r = self.ensemble.n_replicates
+        if ndim != 2 or int(cs.alive.shape[0]) != r:
+            have = (
+                f"{int(cs.alive.shape[0])} replicates" if ndim == 2
+                else "no replicate axis"
+            )
+            raise ValueError(
+                f"config sets replicates={r} but the checkpoint has "
+                f"{have} — resume with the run's original replicates "
+                f"value"
+            )
+
     def _adopt_restored_capacity_multi(self, state) -> None:
         import os
 
         caps = {
-            name: int(cs.alive.shape[0])
+            # row axis LAST: an ensemble checkpoint's alive is [R, rows]
+            name: int(cs.alive.shape[-1])
             for name, cs in state.species.items()
         }
         if caps == {
@@ -553,6 +661,14 @@ class Experiment:
             for name, sp in self.multi.species.items()
         }:
             return
+        if self.ensemble is not None:
+            # same stance as the single-species path: nothing legitimate
+            # expands an ensemble checkpoint (auto_expand is gated off)
+            raise ValueError(
+                f"checkpoint species capacities {caps} differ from the "
+                f"config's; with 'replicates' set, resume with the "
+                f"capacities the run was checkpointed at"
+            )
         meta_path = self._colony_meta_path()
         if not os.path.exists(meta_path):
             raise ValueError(

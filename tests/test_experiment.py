@@ -62,6 +62,165 @@ class TestExperiment:
             assert int(np.asarray(exp.n_alive(state))) > 2
 
 
+class TestReplicatesExperiment:
+    """'replicates' runs colony.Ensemble through the config-driven layer."""
+
+    def test_colony_replicates_emit_fan_layout(self):
+        with Experiment(
+            {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 16,
+                "total_time": 20.0,
+                "emit_every": 10,
+                "replicates": 3,
+            }
+        ) as exp:
+            state = exp.run()
+            assert state.alive.shape == (3, 16)
+            assert int(np.asarray(exp.n_alive(state))) == 3 * 4
+            ts = exp.emitter.timeseries()
+        assert ts["cell"]["protein_u"].shape == (2, 3, 16)  # [T, R, N]
+
+    def test_replicate_overrides_scan_through_config(self):
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.02}},
+                "n_agents": 1,
+                "capacity": 16,
+                "total_time": 40.0,
+                "emit_every": 40,
+                "replicates": 3,
+                "replicate_overrides": {
+                    "global": {"volume": [1.0, 1.4, 1.9]}
+                },
+            }
+        ) as exp:
+            state = exp.run()
+        pops = np.asarray(state.alive).sum(axis=1)
+        assert pops[2] >= pops[0] and pops[2] > 1
+
+    def test_replicates_checkpoint_resume_bitwise(self, tmp_path):
+        def cfg(base, total):
+            return {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 16,
+                "total_time": total,
+                "checkpoint_dir": str(base / "ckpt"),
+                "checkpoint_every": 10.0,
+                "emitter": {"type": "null"},
+                "replicates": 2,
+            }
+
+        with Experiment(cfg(tmp_path / "a", 40.0)) as exp:
+            full = exp.run()
+        with Experiment(cfg(tmp_path / "b", 20.0)) as exp:
+            exp.run()
+        with Experiment(cfg(tmp_path / "b", 40.0)) as exp:
+            resumed = exp.resume()
+        for la, lb in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_multispecies_replicates_run(self):
+        with Experiment(
+            {
+                "composite": "mixed_species_lattice",
+                "config": {
+                    "capacity": {"ecoli": 8, "scavenger": 8},
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                },
+                "n_agents": {"ecoli": 4, "scavenger": 4},
+                "total_time": 4.0,
+                "emit_every": 2,
+                "replicates": 2,
+            }
+        ) as exp:
+            state = exp.run()
+            assert int(np.asarray(exp.n_alive(state))) >= 2 * 8
+            ts = exp.emitter.timeseries()
+        assert ts["fields"].shape[:2] == (2, 2)  # [T, R, ...]
+
+    def test_resume_replicates_mismatch_fails_loudly(self, tmp_path):
+        """Resuming an ensemble checkpoint with the wrong (or no)
+        replicates/capacity config must raise at restore, not explode
+        (or silently mis-step) inside jit."""
+
+        def cfg(**kw):
+            base = {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 16,
+                "total_time": 20.0,
+                "checkpoint_dir": str(tmp_path / "ckpt"),
+                "checkpoint_every": 10.0,
+                "emitter": {"type": "null"},
+                "replicates": 2,
+            }
+            base.update(kw)
+            return base
+
+        with Experiment(cfg()) as exp:
+            exp.run()
+        with Experiment(cfg(total_time=40.0, replicates=None)) as exp:
+            with pytest.raises(ValueError, match="does not set 'replicates'"):
+                exp.resume()
+        with Experiment(cfg(total_time=40.0, replicates=3)) as exp:
+            with pytest.raises(ValueError, match="replicates=3"):
+                exp.resume()
+        with Experiment(cfg(total_time=40.0, capacity=32)) as exp:
+            with pytest.raises(ValueError, match="16 rows per replicate"):
+                exp.resume()
+
+    def test_multispecies_replicates_resume(self, tmp_path):
+        """The capacity-adoption probe must read the ROW axis (last), not
+        the replicate axis, for every species."""
+
+        def cfg(total):
+            return {
+                "composite": "mixed_species_lattice",
+                "config": {
+                    "capacity": {"ecoli": 8, "scavenger": 8},
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                },
+                "n_agents": {"ecoli": 4, "scavenger": 4},
+                "total_time": total,
+                "emit_every": 2,
+                "replicates": 2,
+                "checkpoint_dir": str(tmp_path / "ckpt"),
+                "checkpoint_every": 2.0,
+                "emitter": {"type": "null"},
+            }
+
+        with Experiment(cfg(4.0)) as exp:
+            exp.run()
+        with Experiment(cfg(8.0)) as exp:
+            state = exp.resume()
+        assert state.species["ecoli"].alive.shape == (2, 8)
+        assert exp._state_step(state) == 8
+
+    def test_gates_raise_at_construction(self):
+        with pytest.raises(ValueError, match="int >= 1"):
+            Experiment({"composite": "toggle_colony", "replicates": 0})
+        with pytest.raises(ValueError, match="int >= 1"):
+            Experiment({"composite": "toggle_colony", "replicates": 2.5})
+        base = {"composite": "toggle_colony", "replicates": 2}
+        with pytest.raises(ValueError, match="'replicates' with 'timeline'"):
+            Experiment(dict(base, timeline="0 minimal"))
+        with pytest.raises(ValueError, match="'replicates' with 'auto_expand'"):
+            Experiment(dict(base, auto_expand={"free_frac": 0.2}))
+        with pytest.raises(ValueError, match="replicate_overrides without"):
+            Experiment(
+                {
+                    "composite": "toggle_colony",
+                    "replicate_overrides": {"global": {"volume": [1.0]}},
+                }
+            )
+
+
 class TestCheckpointResume:
     def config(self, tmp_path, total_time):
         return {
