@@ -1,0 +1,128 @@
+"""Parameter scan as one compiled program: a batch-culture yield curve.
+
+Scans the initial glucose concentration across the replicate axis of a
+``colony.Ensemble`` wrapping the wcEcoli-minimal cell (config 3's
+metabolism + expression + division composite): replicate r starts every
+cell at dose[r] mM glucose, and ONE jitted scan computes the whole
+dose-response. Each replicate is a batch culture — cells burn their
+finite substrate and growth stops — so final live biomass tracks the
+dose (the classic substrate-limited yield curve) while the population
+count responds only once a dose buys a full volume doubling. The
+reference would submit one experiment cluster per dose (SURVEY.md
+§3.3); here the scan axis is an ``in_axes`` entry.
+
+    python examples/param_scan.py            # chip-sized (16 doses x 1k cells)
+    python examples/param_scan.py --small    # CPU-sized check (6 doses x 32)
+
+Writes PARAM_SCAN.json (PARAM_SCAN_SMALL.json for --small) +
+out/param_scan.png (dose-response curve).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--out-dir", default="out")
+    args = ap.parse_args()
+
+    if args.small:
+        from lens_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lens_tpu.colony import Colony, Ensemble
+    from lens_tpu.models.composites import minimal_wcecoli
+
+    if args.small:
+        doses_n, n, total, emit_every = 6, 32, 450.0, 10
+    else:
+        doses_n, n, total, emit_every = 16, 1024, 600.0, 10
+
+    # log-spaced doses spanning sub-Km starvation to saturation
+    # (network Km for glucose is 0.5 mM — processes/metabolism.py)
+    doses = jnp.logspace(-1.5, 1.0, doses_n)
+
+    colony = Colony(
+        minimal_wcecoli({}), capacity=n, division_trigger=("global", "divide")
+    )
+    ens = Ensemble(colony, doses_n)
+    states = ens.initial_state(
+        n // 4,
+        key=jax.random.PRNGKey(0),
+        replicate_overrides={"metabolites": {"glc": doses}},
+    )
+
+    run = jax.jit(lambda s: ens.run(s, total, 1.0, emit_every=emit_every))
+    t0 = time.perf_counter()
+    final, traj = jax.block_until_ready(run(states))
+    wall = time.perf_counter() - t0
+
+    pops = np.asarray(final.alive).sum(axis=1)  # [R] final populations
+    alive_mask = np.asarray(final.alive)
+    mass = np.asarray(final.agents["global"]["mass"])
+    total_mass = (mass * alive_mask).sum(axis=1)  # [R] final live biomass
+    live_counts = np.asarray(traj["alive"]).sum(axis=(1, 2))
+    agent_steps = float(live_counts.sum()) * emit_every
+
+    d = np.asarray(doses)
+    summary = {
+        "scenario": "glucose dose-response scan, wcEcoli-minimal colony "
+        "(one compiled program, scan on the replicate axis)",
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "doses_mM": [round(float(x), 4) for x in d],
+        "cells_per_dose": n // 4,
+        "sim_seconds": total,
+        "wall_seconds": round(wall, 1),
+        "final_population_per_dose": [int(p) for p in pops],
+        "final_live_mass_per_dose": [round(float(m), 1) for m in total_mass],
+        "monotone_dose_response": bool(
+            (np.diff(pops) >= 0).all()
+            and (np.diff(total_mass) >= 0).all()
+            and total_mass[-1] > total_mass[0]
+        ),
+        "agent_steps_per_sec": round(agent_steps / wall, 1),
+    }
+    record = "PARAM_SCAN_SMALL.json" if args.small else "PARAM_SCAN.json"
+    with open(record, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary, indent=2))
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    ax1.semilogx(d, total_mass, "o-", color="tab:green")
+    ax1.set_xlabel("initial glucose (mM)")
+    ax1.set_ylabel("final live biomass (fg)")
+    ax1.set_title("batch-culture yield vs dose")
+    ax2.semilogx(d, pops, "o-")
+    ax2.set_xlabel("initial glucose (mM)")
+    ax2.set_ylabel(f"population after {total:g} s")
+    ax2.set_title("divisions vs dose")
+    fig.tight_layout()
+    os.makedirs(args.out_dir, exist_ok=True)
+    p = os.path.join(args.out_dir, "param_scan.png")
+    fig.savefig(p, dpi=120)
+    print(f"plot: {p}")
+
+
+if __name__ == "__main__":
+    main()

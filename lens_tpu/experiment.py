@@ -146,7 +146,7 @@ class Experiment:
         self.ensemble = None
         if self.config["replicates"] is not None:
             r = self.config["replicates"]
-            if not isinstance(r, int) or r < 1:
+            if not isinstance(r, int) or isinstance(r, bool) or r < 1:
                 # truthiness would let 0 degrade to an unreplicated run
                 # and a float silently truncate downstream
                 raise ValueError(f"replicates must be an int >= 1, got {r!r}")
