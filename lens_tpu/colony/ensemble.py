@@ -139,3 +139,37 @@ class Ensemble:
             timestep,
             emit_every,
         )
+
+    def run_timeline(
+        self,
+        states,
+        timeline,
+        total_time: float,
+        timestep: float,
+        emit_every: int = 1,
+        start_time: float = 0.0,
+    ) -> Tuple[Any, dict]:
+        """Timeline-driven run over the replicate axis.
+
+        The media schedule is replicate-independent (event times and
+        recipes are static), and ``run_media_timeline``'s segment loop is
+        fully traceable — static Python unrolling, jnp field resets, scan
+        segments — so vmapping the wrapped sim's whole ``run_timeline``
+        gives every replicate the same media history at one compile.
+        Needs a sim with fields (spatial / multi-species forms).
+        """
+        if not callable(getattr(self.sim, "run_timeline", None)):
+            raise TypeError(
+                f"{type(self.sim).__name__} has no run_timeline(); media "
+                f"timelines need a lattice sim (SpatialColony / "
+                f"MultiSpeciesColony)"
+            )
+        final, traj = jax.vmap(
+            lambda s: self.sim.run_timeline(
+                s, timeline, total_time, timestep, emit_every, start_time
+            )
+        )(states)
+        # vmap stacks the replicate axis FIRST; the ensemble layout is
+        # [T, R, ...] (time-leading, matching Ensemble.run and what the
+        # emitter/analysis consume)
+        return final, jax.tree.map(lambda x: jnp.moveaxis(x, 0, 1), traj)

@@ -83,8 +83,8 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # ``replicate_overrides`` (nested mapping, leaves [N, ...]) turns the
     # replicate axis into a parameter scan. Emission gains a [T, R, ...]
     # layout that analysis.report renders as fan charts. Composes with
-    # checkpoint/resume; NOT with mesh / auto_expand / timeline (gated at
-    # construction).
+    # checkpoint/resume and (for lattice composites) media timelines;
+    # NOT with mesh / auto_expand (gated at construction).
     "replicates": None,
     "replicate_overrides": {},
 }
@@ -156,12 +156,15 @@ class Experiment:
                  "colony.Ensemble directly if you need both)"),
                 ("auto_expand", "capacity expansion re-allocates unbatched "
                  "states"),
-                ("timeline", "media timelines are not wired through the "
-                 "replicate axis yet (run one experiment per medium, or "
-                 "drive Ensemble + run_timeline by hand)"),
             ):
                 if self.config[gate]:
                     raise ValueError(f"'replicates' with '{gate}': {why}")
+            if self.config["timeline"] is not None and self.spatial is None \
+                    and self.multi is None:
+                raise ValueError(
+                    "'replicates' with 'timeline' needs a lattice "
+                    "composite (media timelines reset fields)"
+                )
         elif self.config["replicate_overrides"]:
             raise ValueError(
                 "replicate_overrides without replicates: set "
@@ -281,8 +284,11 @@ class Experiment:
         # a sync and serialize the pipelined emission below.
         start_time = start_step * dt
         if self.ensemble is not None:
-            # timelines are gated off at construction; the replicate axis
-            # runs the plain scan schedule
+            if self.config["timeline"] is not None:
+                return self.ensemble.run_timeline(
+                    state, self.config["timeline"], duration, dt,
+                    emit_every, start_time=start_time,
+                )
             return self.ensemble.run(state, duration, dt, emit_every)
         if self.runner is not None:
             if self.config["timeline"] is not None:

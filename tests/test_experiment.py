@@ -202,13 +202,56 @@ class TestReplicatesExperiment:
         assert state.species["ecoli"].alive.shape == (2, 8)
         assert exp._state_step(state) == 8
 
+    def test_replicates_with_timeline(self):
+        """Media timelines vmap over the replicate axis: every replicate
+        sees the same media shift, and replicate r equals a solo
+        run_timeline with that replicate's key."""
+        cfg = {
+            "composite": "ecoli_lattice",
+            "config": {
+                "capacity": 16,
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": False,
+                "motility": {"sigma": 0.0},
+            },
+            "n_agents": 8,
+            "total_time": 8.0,
+            "timeline": "0 minimal, 4 minimal_low_glucose",
+            "seed": 3,
+            "replicates": 2,
+        }
+        with Experiment(cfg) as exp:
+            exp.run()
+            ts = exp.emitter.timeseries()
+        fields = np.asarray(ts["fields"])  # [T=8, R=2, 1, 8, 8]
+        assert fields.shape[:2] == (8, 2)
+        # both replicates see the shift: pre-shift minimal (10 mM),
+        # post-shift reset to 0.5 mM
+        assert (fields[3].mean(axis=(1, 2, 3)) > 5.0).all()
+        assert (fields[4].mean(axis=(1, 2, 3)) < 1.0).all()
+
+        # replicate 0 == solo run_timeline with replicate 0's key
+        from lens_tpu.models import ecoli_lattice as _el
+
+        spatial, _ = _el(dict(cfg["config"]))
+        keys = jax.random.split(jax.random.PRNGKey(3), 2)
+        solo0 = spatial.initial_state(8, keys[0])
+        _, solo_traj = spatial.run_timeline(
+            solo0, cfg["timeline"], 8.0, 1.0
+        )
+        np.testing.assert_allclose(
+            fields[:, 0], np.asarray(solo_traj["fields"]),
+            rtol=1e-6, atol=1e-6,
+        )
+
     def test_gates_raise_at_construction(self):
         with pytest.raises(ValueError, match="int >= 1"):
             Experiment({"composite": "toggle_colony", "replicates": 0})
         with pytest.raises(ValueError, match="int >= 1"):
             Experiment({"composite": "toggle_colony", "replicates": 2.5})
         base = {"composite": "toggle_colony", "replicates": 2}
-        with pytest.raises(ValueError, match="'replicates' with 'timeline'"):
+        with pytest.raises(ValueError, match="needs a lattice composite"):
             Experiment(dict(base, timeline="0 minimal"))
         with pytest.raises(ValueError, match="'replicates' with 'auto_expand'"):
             Experiment(dict(base, auto_expand={"free_frac": 0.2}))
