@@ -187,6 +187,9 @@ def _validate_run_args(args: argparse.Namespace) -> None:
             "--auto-expand needs --checkpoint-every to define the "
             "segments at which expansion can happen"
         )
+    # (--timeline with a non-lattice composite is rejected by Experiment
+    # at construction — lattice-ness needs the composite registry, which
+    # lives behind the jax import this function runs before.)
     if args.replicates is not None:
         if args.replicates < 1:
             raise SystemExit(f"--replicates must be >= 1, got {args.replicates}")

@@ -83,8 +83,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # ``replicate_overrides`` (nested mapping, leaves [N, ...]) turns the
     # replicate axis into a parameter scan. Emission gains a [T, R, ...]
     # layout that analysis.report renders as fan charts. Composes with
-    # checkpoint/resume and (for lattice composites) media timelines;
-    # NOT with mesh / auto_expand (gated at construction).
+    # checkpoint/resume, (for lattice composites) media timelines, and
+    # replicate-parallel meshes ({"mesh": {"replicates": N}} splits the
+    # replicate axis over N devices — zero collectives, perfect scaling);
+    # NOT with agent/space meshes or auto_expand (gated at construction).
     "replicates": None,
     "replicate_overrides": {},
 }
@@ -105,6 +107,23 @@ class Experiment:
             raise ValueError(
                 f"unknown composite {name!r}; known: {sorted(composite_registry)}"
             )
+        # ONE definition of "the mesh shards the replicate axis" — three
+        # code paths branch on it and must agree.
+        mesh_cfg = self.config["mesh"]
+        replicate_mesh = bool(mesh_cfg) and set(mesh_cfg) == {"replicates"}
+        if replicate_mesh:
+            if self.config["replicates"] is None:
+                raise ValueError(
+                    "mesh={'replicates': N} needs 'replicates' set — a "
+                    "replicate mesh without replicates would silently run "
+                    "unsharded"
+                )
+            n_dev = mesh_cfg["replicates"]
+            if not isinstance(n_dev, int) or isinstance(n_dev, bool) \
+                    or n_dev < 1:
+                raise ValueError(
+                    f"mesh replicates must be an int >= 1, got {n_dev!r}"
+                )
         built = composite_registry[name](self.config["config"])
         self.spatial: Optional[SpatialColony] = None
         self.multi = None  # MultiSpeciesColony composites (config 4)
@@ -114,12 +133,13 @@ class Experiment:
             # (MultiSpeciesColony, {name: Compartment})
             self.multi, self.compartment = built
             self.colony = None
-            if self.config["mesh"]:
+            if self.config["mesh"] and not replicate_mesh:
                 raise ValueError(
                     "config 'mesh' with a multi-species composite: use "
                     "parallel.ShardedMultiSpeciesColony directly (the "
                     "Experiment mesh path wraps single-species spatial "
-                    "models)"
+                    "models); mesh={'replicates': N} works via "
+                    "'replicates'"
                 )
         elif isinstance(built, tuple):  # (SpatialColony, Compartment)
             self.spatial, self.compartment = built
@@ -140,6 +160,14 @@ class Experiment:
             raise TypeError(
                 f"composite factory {name!r} returned {type(built)!r}"
             )
+        if self.config["timeline"] is not None and self.spatial is None \
+                and self.multi is None:
+            # without this the run loop would fall through to the plain
+            # colony path and silently drop the media schedule
+            raise ValueError(
+                "'timeline' needs a lattice composite (media timelines "
+                "reset fields)"
+            )
         # Replicates gates fire BEFORE any runner/distributed bring-up:
         # initialize() can block on multi-host peers, and a doomed config
         # must not get that far.
@@ -150,20 +178,18 @@ class Experiment:
                 # truthiness would let 0 degrade to an unreplicated run
                 # and a float silently truncate downstream
                 raise ValueError(f"replicates must be an int >= 1, got {r!r}")
-            for gate, why in (
-                ("mesh", "shard the colony axis OR replicate it, not both "
-                 "through this layer (wrap parallel runners in "
-                 "colony.Ensemble directly if you need both)"),
-                ("auto_expand", "capacity expansion re-allocates unbatched "
-                 "states"),
-            ):
-                if self.config[gate]:
-                    raise ValueError(f"'replicates' with '{gate}': {why}")
-            if self.config["timeline"] is not None and self.spatial is None \
-                    and self.multi is None:
+            if self.config["auto_expand"]:
                 raise ValueError(
-                    "'replicates' with 'timeline' needs a lattice "
-                    "composite (media timelines reset fields)"
+                    "'replicates' with 'auto_expand': capacity expansion "
+                    "re-allocates unbatched states"
+                )
+            if self.config["mesh"] and not replicate_mesh:
+                raise ValueError(
+                    "'replicates' composes with mesh={'replicates': N} "
+                    "(replicate-parallel: the replicate axis splits over N "
+                    "devices) — agent/space mesh axes shard the colony "
+                    "axis instead; wrap parallel runners in "
+                    "colony.Ensemble directly if you need both"
                 )
         elif self.config["replicate_overrides"]:
             raise ValueError(
@@ -171,7 +197,7 @@ class Experiment:
                 "'replicates': N to enable the scan axis"
             )
         self.runner = None
-        if self.config["mesh"]:
+        if self.config["mesh"] and not replicate_mesh:
             if self.spatial is None:
                 raise ValueError(
                     "config 'mesh' needs a spatial composite (lattice model)"
@@ -200,11 +226,23 @@ class Experiment:
                 "auto_expand on a multi-host mesh is not supported yet "
                 "(expansion gathers the full state to one host)"
             )
+        self.ensemble_runner = None
         if self.config["replicates"] is not None:
             from lens_tpu.colony.ensemble import Ensemble
 
             sim = self.multi or self.spatial or self.colony
             self.ensemble = Ensemble(sim, int(self.config["replicates"]))
+            if replicate_mesh:
+                from lens_tpu.parallel import ShardedEnsemble, initialize
+                from lens_tpu.parallel.mesh import make_mesh
+
+                initialize()
+                self.ensemble_runner = ShardedEnsemble(
+                    self.ensemble,
+                    make_mesh(
+                        n_agents=int(mesh_cfg["replicates"]), n_space=1
+                    ),
+                )
         self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
         self.checkpointer = (
             Checkpointer(self.config["checkpoint_dir"])
@@ -234,7 +272,7 @@ class Experiment:
                 )
             counts = {k: int(v) for k, v in n_cfg.items()}
             if self.ensemble is not None:
-                return self.ensemble.initial_state(
+                return (self.ensemble_runner or self.ensemble).initial_state(
                     counts,
                     key=key,
                     overrides=self.config["overrides"] or None,
@@ -254,7 +292,7 @@ class Experiment:
                 n, key, stripe=stripe, overrides=overrides
             )
         if self.ensemble is not None:
-            return self.ensemble.initial_state(
+            return (self.ensemble_runner or self.ensemble).initial_state(
                 n,
                 key=key,
                 overrides=overrides,
@@ -284,12 +322,13 @@ class Experiment:
         # a sync and serialize the pipelined emission below.
         start_time = start_step * dt
         if self.ensemble is not None:
+            ens = self.ensemble_runner or self.ensemble
             if self.config["timeline"] is not None:
-                return self.ensemble.run_timeline(
+                return ens.run_timeline(
                     state, self.config["timeline"], duration, dt,
                     emit_every, start_time=start_time,
                 )
-            return self.ensemble.run(state, duration, dt, emit_every)
+            return ens.run(state, duration, dt, emit_every)
         if self.runner is not None:
             if self.config["timeline"] is not None:
                 return self.runner.run_timeline(
@@ -549,6 +588,10 @@ class Experiment:
             raise ValueError("resume() needs checkpoint_dir in the config")
         state = self.checkpointer.restore()
         self._adopt_restored_capacity(state)
+        if self.ensemble_runner is not None:
+            # restore() hands back host arrays; without re-placement, jit
+            # would silently run the whole program on one device
+            state = self.ensemble_runner.shard(state)
         done = self._state_step(state) * float(self.config["timestep"])
         remaining = float(self.config["total_time"]) - done
         if remaining <= 0:

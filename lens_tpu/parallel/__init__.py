@@ -11,6 +11,11 @@ rebuild's backend is the TPU interconnect itself: a
 - ``space``: domain decomposition of the lattice (field rows split
   across devices, stencil halos exchanged with ``ppermute``).
 
+A third scale dimension needs no collectives at all: the replicate axis
+of a ``colony.Ensemble`` (``ShardedEnsemble``) — independent replicates
+split across devices by XLA's batch partitioner, the framework's
+perfect-scaling path for replicate statistics and parameter scans.
+
 Collectives (``psum`` for global occupancy/exchange reduction,
 ``all_gather`` for field assembly, ``ppermute`` for halos) ride ICI
 within a slice and DCN across slices — there is no broker tier at all.
@@ -26,6 +31,7 @@ from lens_tpu.parallel.mesh import (
 from lens_tpu.parallel.halo import diffuse_halo
 from lens_tpu.parallel.runner import ShardedSpatialColony
 from lens_tpu.parallel.multispecies import ShardedMultiSpeciesColony
+from lens_tpu.parallel.ensemble import ShardedEnsemble
 from lens_tpu.parallel.distributed import (
     coordinator_only,
     distribute,
@@ -43,6 +49,7 @@ __all__ = [
     "diffuse_halo",
     "ShardedSpatialColony",
     "ShardedMultiSpeciesColony",
+    "ShardedEnsemble",
     "initialize",
     "global_mesh",
     "distribute",

@@ -245,11 +245,103 @@ class TestReplicatesExperiment:
             rtol=1e-6, atol=1e-6,
         )
 
+    def test_replicate_mesh_through_config(self):
+        """mesh={'replicates': N} splits the replicate axis over N
+        devices and stays equal to the unsharded replicates run."""
+
+        def cfg(mesh=None):
+            return {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 16,
+                "total_time": 10.0,
+                "emit_every": 5,
+                "replicates": 8,
+                "mesh": mesh,
+            }
+
+        with Experiment(cfg()) as exp:
+            ref = exp.run()
+            ref_ts = exp.emitter.timeseries()
+        with Experiment(cfg({"replicates": 8})) as exp:
+            assert exp.ensemble_runner is not None
+            state = exp.run()
+            assert len(state.alive.sharding.device_set) == 8
+            ts = exp.emitter.timeseries()
+        for la, lb in zip(jax.tree.leaves(ref), jax.tree.leaves(state)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        np.testing.assert_array_equal(
+            np.asarray(ref_ts["cell"]["protein_u"]),
+            np.asarray(ts["cell"]["protein_u"]),
+        )
+
+    def test_replicate_mesh_resume_stays_sharded_and_bitwise(self, tmp_path):
+        def cfg(base, total, mesh=None):
+            return {
+                "composite": "toggle_colony",
+                "n_agents": 4,
+                "capacity": 16,
+                "total_time": total,
+                "checkpoint_dir": str(base / "ckpt"),
+                "checkpoint_every": 10.0,
+                "emitter": {"type": "null"},
+                "replicates": 8,
+                "mesh": mesh,
+            }
+
+        mesh = {"replicates": 8}
+        with Experiment(cfg(tmp_path / "a", 40.0, mesh)) as exp:
+            full = exp.run()
+        with Experiment(cfg(tmp_path / "b", 20.0, mesh)) as exp:
+            exp.run()
+        with Experiment(cfg(tmp_path / "b", 40.0, mesh)) as exp:
+            resumed = exp.resume()
+        # the resumed run kept the 8-way replicate split
+        assert len(resumed.alive.sharding.device_set) == 8
+        for la, lb in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
     def test_gates_raise_at_construction(self):
+        with pytest.raises(ValueError, match="needs 'replicates' set"):
+            Experiment(
+                {"composite": "toggle_colony", "mesh": {"replicates": 8}}
+            )
+        with pytest.raises(ValueError, match="mesh replicates must be"):
+            Experiment(
+                {
+                    "composite": "toggle_colony",
+                    "replicates": 8,
+                    "mesh": {"replicates": 0},
+                }
+            )
         with pytest.raises(ValueError, match="int >= 1"):
             Experiment({"composite": "toggle_colony", "replicates": 0})
         with pytest.raises(ValueError, match="int >= 1"):
             Experiment({"composite": "toggle_colony", "replicates": 2.5})
+        with pytest.raises(ValueError, match="replicate-parallel"):
+            Experiment(
+                {
+                    "composite": "toggle_colony",
+                    "replicates": 2,
+                    "mesh": {"agents": 4},
+                }
+            )
+        # replicate meshes are composite-agnostic: multi-species builds
+        # (the agent/space mesh gate must NOT catch them)
+        with Experiment(
+            {
+                "composite": "mixed_species_lattice",
+                "config": {
+                    "capacity": {"ecoli": 8, "scavenger": 8},
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                },
+                "n_agents": {"ecoli": 4, "scavenger": 4},
+                "replicates": 2,
+                "mesh": {"replicates": 2},
+            }
+        ) as exp:
+            assert exp.ensemble_runner is not None
         base = {"composite": "toggle_colony", "replicates": 2}
         with pytest.raises(ValueError, match="needs a lattice composite"):
             Experiment(dict(base, timeline="0 minimal"))

@@ -567,3 +567,69 @@ def test_sharded_full_network_rfba_matches_unsharded():
     # every agent's LP converged on both paths
     assert float(np.asarray(emits["fluxes"]["lp_converged"]).min()) == 1.0
     assert float(np.asarray(ref_emits["fluxes"]["lp_converged"]).min()) == 1.0
+
+
+# -- replicate-parallel ensembles -------------------------------------------
+
+
+class TestShardedEnsemble:
+    """The replicate axis sharded over the mesh: zero collectives, and
+    the program must be bitwise the single-device Ensemble program."""
+
+    def _toggle_ensemble(self, r=8, n=16):
+        from lens_tpu.colony import Colony, Ensemble
+        from lens_tpu.models.composites import toggle_colony
+
+        colony = Colony(toggle_colony({}), capacity=n)
+        return Ensemble(colony, r)
+
+    def test_sharded_matches_unsharded_bitwise(self):
+        from lens_tpu.parallel import ShardedEnsemble
+
+        ens = self._toggle_ensemble()
+        key = jax.random.PRNGKey(0)
+        ref_final, ref_traj = ens.run(
+            ens.initial_state(16, key=key), 10.0, 1.0, emit_every=5
+        )
+
+        sharded = ShardedEnsemble(ens)
+        states = sharded.initial_state(16, key=key)
+        # the replicate axis really is split across all 8 devices
+        assert len(states.alive.sharding.device_set) == 8
+        final, traj = sharded.run(states, 10.0, 1.0, emit_every=5)
+        for la, lb in zip(jax.tree.leaves(final), jax.tree.leaves(ref_final)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        for la, lb in zip(jax.tree.leaves(traj), jax.tree.leaves(ref_traj)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_sharded_timeline_matches_unsharded(self):
+        from lens_tpu.colony import Ensemble
+        from lens_tpu.parallel import ShardedEnsemble
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 16, "shape": (8, 8), "size": (8.0, 8.0),
+             "division": False, "motility": {"sigma": 0.0}}
+        )
+        ens = Ensemble(spatial, 8)
+        key = jax.random.PRNGKey(1)
+        timeline = "0 minimal, 4 minimal_low_glucose"
+        ref_final, ref_traj = ens.run_timeline(
+            ens.initial_state(4, key=key), timeline, 8.0, 1.0
+        )
+        sharded = ShardedEnsemble(ens)
+        final, traj = sharded.run_timeline(
+            sharded.initial_state(4, key=key), timeline, 8.0, 1.0
+        )
+        np.testing.assert_array_equal(
+            np.asarray(final.fields), np.asarray(ref_final.fields)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(traj["fields"]), np.asarray(ref_traj["fields"])
+        )
+
+    def test_indivisible_replicates_rejected(self):
+        from lens_tpu.parallel import ShardedEnsemble
+
+        ens = self._toggle_ensemble(r=6)
+        with pytest.raises(ValueError, match="does not divide"):
+            ShardedEnsemble(ens)
