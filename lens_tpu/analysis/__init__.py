@@ -640,6 +640,56 @@ def plot_ensemble_fan(
     return out_path
 
 
+def scan_response(
+    timeseries: Mapping,
+    path: Sequence[str] | None = None,
+) -> np.ndarray:
+    """The final per-replicate value of a series: the response column of
+    a parameter scan (``Ensemble`` + ``replicate_overrides``). Returns
+    ``[R]`` — live-cell count per replicate by default, or the
+    live-masked per-agent mean of ``path`` at the last emit."""
+    return ensemble_series(timeseries, path)[-1]
+
+
+def plot_scan_response(
+    timeseries: Mapping,
+    values: Sequence[float],
+    path: Sequence[str] | None = None,
+    out_path: str = "out/scan_response.png",
+    value_label: str = "scanned parameter",
+    log_x: bool = True,
+) -> str:
+    """Dose-response curve of a parameter scan: the final value of a
+    series (``scan_response``) against the scanned parameter values.
+
+    ``values`` is the per-replicate parameter vector the scan was built
+    with (the same array passed via ``replicate_overrides``). The scan
+    runs as one compiled program; this draws its one-figure summary.
+    """
+    plt = _plt()
+    values = np.asarray(values)
+    resp = scan_response(timeseries, path)
+    if values.shape != resp.shape:
+        raise ValueError(
+            f"values has shape {values.shape} but the trajectory has "
+            f"{resp.shape[0]} replicates"
+        )
+    fig, ax = plt.subplots(figsize=(6, 4))
+    # semilogx silently clips x <= 0 — a zero-dose control point would
+    # vanish from the curve; fall back to a linear axis instead
+    use_log = log_x and bool((values > 0).all())
+    (ax.semilogx if use_log else ax.plot)(values, resp, "o-")
+    ax.set_xlabel(value_label)
+    label = "live cells" if path is None else SEP_TITLE.join(path)
+    ax.set_ylabel(f"final {label}")
+    ax.set_title(f"{label} vs {value_label} ({len(values)} points)")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
 # -- the standard report ------------------------------------------------------
 
 
@@ -798,6 +848,8 @@ __all__ = [
     "report",
     "ensemble_series",
     "plot_ensemble_fan",
+    "scan_response",
+    "plot_scan_response",
     "alive_counts",
     "masked_agent_series",
     "plot_timeseries",

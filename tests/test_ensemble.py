@@ -154,6 +154,32 @@ class TestParameterScan:
         np.testing.assert_allclose(vols[0], 1.0)
         np.testing.assert_allclose(vols[1], 2.0)
 
+    def test_scan_response_helpers(self, tmp_path):
+        import os
+
+        import pytest
+
+        from lens_tpu.analysis import plot_scan_response, scan_response
+
+        ens, _ = toggle_ensemble(r=3, n=8)
+        vols = jnp.asarray([0.8, 1.0, 1.3])
+        states = ens.initial_state(
+            8, key=jax.random.PRNGKey(0),
+            replicate_overrides={"global": {"volume": vols}},
+        )
+        _, traj = ens.run(states, 8.0, 1.0, emit_every=4)
+        resp = scan_response(traj, ("global", "volume"))
+        assert resp.shape == (3,)
+        assert (np.diff(resp) > 0).all()  # bigger seed volume stays bigger
+        p = plot_scan_response(
+            traj, vols, ("global", "volume"),
+            out_path=str(tmp_path / "scan.png"),
+            value_label="initial volume (fL)",
+        )
+        assert os.path.getsize(p) > 1000
+        with pytest.raises(ValueError, match="replicates"):
+            plot_scan_response(traj, [1.0, 2.0], ("global", "volume"))
+
     def test_bad_leading_axis_rejected(self):
         import pytest
 
