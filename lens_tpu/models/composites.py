@@ -303,6 +303,122 @@ def chemotaxis_lattice(
     )
 
 
+def _rfba_network_fill(metab: dict, diffusion: dict, initial: dict):
+    """Per-network lattice/LP conditioning shared by every rFBA composite:
+    the larger data-layer networks bring more external species (lattice
+    fields need diffusion/initial entries) and need the measured float32
+    LP envelope (ops.linprog: Ruiz equilibration + pinned presolve +
+    d-cap + weighted polish)."""
+    if metab.get("network") == "ecoli_core_full":
+        # The TRUE e_coli_core (72 metabolites x 95 canonical reactions,
+        # data/ecoli_core_full_*.tsv): 17 lattice fields. tol 1e-5 keeps
+        # the anaerobic optimum within ~3% of the float64 solve.
+        metab = _cfg(
+            {"lp_leak": 1.5e-3, "lp_tol": 1e-5, "lp_iterations": 45},
+            metab,
+        )
+        diffusion = _cfg(
+            {"glc": 600.0, "fru": 600.0, "ace": 900.0, "acald": 1000.0,
+             "akg": 700.0, "etoh": 1200.0, "for": 1400.0, "fum": 800.0,
+             "gln": 700.0, "glu": 700.0, "lac": 900.0, "mal": 800.0,
+             "nh4": 1800.0, "o2": 2000.0, "co2": 1900.0, "pyr": 900.0,
+             "succ": 800.0},
+            diffusion,
+        )
+        initial = _cfg(
+            {"glc": 10.0, "fru": 0.0, "ace": 0.0, "acald": 0.0,
+             "akg": 0.0, "etoh": 0.0, "for": 0.0, "fum": 0.0, "gln": 0.0,
+             "glu": 0.0, "lac": 0.0, "mal": 0.0, "nh4": 5.0, "o2": 5.0,
+             "co2": 0.0, "pyr": 0.0, "succ": 0.0},
+            initial,
+        )
+    if metab.get("network") == "ecoli_core":
+        # Reference-scale network: the loader supplies 7 external species;
+        # fill lattice defaults for the ones the small-network defaults
+        # don't name, and give the float32 LP the conditioning recipe it
+        # needs at this size (see FBAMetabolism.defaults["lp_leak"]).
+        # lp_iterations=45 is a CAP (the while-loop solve exits once the
+        # whole batch is accepted at tolerance — typically ~10 iterations
+        # on these environments): measured (64 random environments,
+        # CPU+TPU) that convergence fraction and converged objectives are
+        # IDENTICAL from 40 to 60 iterations, so 45 keeps margin over the
+        # measured 40 floor at zero typical-case cost.
+        metab = _cfg(
+            {"lp_leak": 1.5e-3, "lp_tol": 1e-4, "lp_iterations": 45},
+            metab,
+        )
+        diffusion = _cfg(
+            {"lcts": 500.0, "nh4": 1800.0, "co2": 1900.0, "eth": 1200.0},
+            diffusion,
+        )
+        initial = _cfg(
+            {"lcts": 0.0, "nh4": 5.0, "co2": 0.0, "eth": 0.0},
+            initial,
+        )
+    return metab, diffusion, initial
+
+
+def _rfba_cell(
+    metab_cfg: Mapping, divide_cfg: Mapping, motility_cfg: Mapping
+) -> Tuple[FBAMetabolism, Dict, Dict]:
+    """The rFBA cell shared by every rFBA composite: exact-LP metabolism
+    + volume derivation + division trigger + Brownian motility. Returns
+    ``(metabolism, processes, topology)`` so callers can extend both
+    dicts (rfba_lattice adds genome expression) before building the
+    Compartment."""
+    metabolism = FBAMetabolism(metab_cfg)
+    processes = {
+        "metabolism": metabolism,
+        "derive_volume": DeriveVolume(),
+        "divide_trigger": DivideTrigger(divide_cfg),
+        "motility": BrownianMotility(motility_cfg),
+    }
+    topology = {
+        "metabolism": {
+            "external": ("boundary", "external"),
+            "exchange": ("boundary", "exchange"),
+            "global": ("global",),
+            "fluxes": ("fluxes",),
+        },
+        "derive_volume": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+        "motility": {"boundary": ("boundary",)},
+    }
+    if metabolism.config["lp_warm_start"]:
+        topology["metabolism"]["lp_state"] = ("lp_state",)
+    return metabolism, processes, topology
+
+
+def _field_species(
+    compartment: Compartment,
+    capacity: int,
+    lattice: Lattice,
+    mols,
+    division: bool,
+) -> SpatialColony:
+    """One species of a multi-species lattice: Colony + SpatialColony
+    with the standard boundary port wiring for ``mols`` (shared by
+    mixed_species_lattice and rfba_cross_feeding — species on ONE
+    lattice, so the Lattice is passed in, unlike ``_spatial_colony``)."""
+    colony = Colony(
+        compartment,
+        capacity=int(capacity),
+        division_trigger=("global", "divide") if division else None,
+    )
+    return SpatialColony(
+        colony,
+        lattice,
+        field_ports={
+            mol: (
+                ("boundary", "external", mol),
+                ("boundary", "exchange", f"{mol}_exchange"),
+            )
+            for mol in mols
+        },
+        location_path=("boundary", "location"),
+    )
+
+
 @register_composite
 def rfba_lattice(
     config: Mapping | None = None,
@@ -332,74 +448,12 @@ def rfba_lattice(
         },
         config,
     )
-    if c["metabolism"].get("network") == "ecoli_core_full":
-        # The TRUE e_coli_core (72 metabolites x 95 canonical reactions,
-        # data/ecoli_core_full_*.tsv): 17 lattice fields. LP recipe per
-        # the measured float32 envelope (ops.linprog: Ruiz equilibration
-        # + pinned presolve + d-cap + weighted polish): tol 1e-5 keeps
-        # the anaerobic optimum within ~3% of the float64 solve.
-        c["metabolism"] = _cfg(
-            {"lp_leak": 1.5e-3, "lp_tol": 1e-5, "lp_iterations": 45},
-            c["metabolism"],
-        )
-        c["diffusion"] = _cfg(
-            {"glc": 600.0, "fru": 600.0, "ace": 900.0, "acald": 1000.0,
-             "akg": 700.0, "etoh": 1200.0, "for": 1400.0, "fum": 800.0,
-             "gln": 700.0, "glu": 700.0, "lac": 900.0, "mal": 800.0,
-             "nh4": 1800.0, "o2": 2000.0, "co2": 1900.0, "pyr": 900.0,
-             "succ": 800.0},
-            c["diffusion"],
-        )
-        c["initial"] = _cfg(
-            {"glc": 10.0, "fru": 0.0, "ace": 0.0, "acald": 0.0,
-             "akg": 0.0, "etoh": 0.0, "for": 0.0, "fum": 0.0, "gln": 0.0,
-             "glu": 0.0, "lac": 0.0, "mal": 0.0, "nh4": 5.0, "o2": 5.0,
-             "co2": 0.0, "pyr": 0.0, "succ": 0.0},
-            c["initial"],
-        )
-    if c["metabolism"].get("network") == "ecoli_core":
-        # Reference-scale network: the loader supplies 7 external species;
-        # fill lattice defaults for the ones the small-network defaults
-        # don't name, and give the float32 LP the conditioning recipe it
-        # needs at this size (see FBAMetabolism.defaults["lp_leak"]).
-        # lp_iterations=45 is a CAP (the while-loop solve exits once the
-        # whole batch is accepted at tolerance — typically ~10 iterations
-        # on these environments): measured (64 random environments,
-        # CPU+TPU) that convergence fraction and converged objectives are
-        # IDENTICAL from 40 to 60 iterations, so 45 keeps margin over the
-        # measured 40 floor at zero typical-case cost.
-        c["metabolism"] = _cfg(
-            {"lp_leak": 1.5e-3, "lp_tol": 1e-4, "lp_iterations": 45},
-            c["metabolism"],
-        )
-        c["diffusion"] = _cfg(
-            {"lcts": 500.0, "nh4": 1800.0, "co2": 1900.0, "eth": 1200.0},
-            c["diffusion"],
-        )
-        c["initial"] = _cfg(
-            {"lcts": 0.0, "nh4": 5.0, "co2": 0.0, "eth": 0.0},
-            c["initial"],
-        )
-    metabolism = FBAMetabolism(c["metabolism"])
-    processes = {
-        "metabolism": metabolism,
-        "derive_volume": DeriveVolume(),
-        "divide_trigger": DivideTrigger(c["divide"]),
-        "motility": BrownianMotility(c["motility"]),
-    }
-    topology = {
-        "metabolism": {
-            "external": ("boundary", "external"),
-            "exchange": ("boundary", "exchange"),
-            "global": ("global",),
-            "fluxes": ("fluxes",),
-        },
-        "derive_volume": {"global": ("global",)},
-        "divide_trigger": {"global": ("global",)},
-        "motility": {"boundary": ("boundary",)},
-    }
-    if metabolism.config["lp_warm_start"]:
-        topology["metabolism"]["lp_state"] = ("lp_state",)
+    c["metabolism"], c["diffusion"], c["initial"] = _rfba_network_fill(
+        c["metabolism"], c["diffusion"], c["initial"]
+    )
+    metabolism, processes, topology = _rfba_cell(
+        c["metabolism"], c["divide"], c["motility"]
+    )
     if c.get("expression") is not None:
         # Metabolism + transcription in one compartment (config 3's
         # composite shape): the gene table's regulation rules read the
@@ -438,6 +492,110 @@ def rfba_lattice(
         diffusion=c["diffusion"],
         initial=c["initial"],
     )
+
+
+@register_composite
+def rfba_cross_feeding(
+    config: Mapping | None = None,
+):
+    """Cross-feeding at network scale: exact-rFBA E. coli + an acetate
+    scavenger on one lattice.
+
+    The ``ecoli`` species runs the regulated core-carbon LP per cell
+    (:mod:`lens_tpu.processes.fba_metabolism`, Covert–Palsson lineage):
+    under glucose-rich aerobic growth the network OVERFLOWS, secreting
+    acetate into the cell's lattice bin. The ``scavenger`` species
+    (Michaelis–Menten acetate transport + growth + division + motility)
+    lives off that secretion — the classic E. coli syntrophy loop, with
+    the two populations coupled ONLY through the shared acetate field.
+    The reference boots different agent types onto one environment
+    through its broker (SURVEY.md §7 hard-part #1); here each species is
+    its own vmap inside one program, and the cross-feeding flux is a
+    gather/scatter through the field.
+    """
+    c = _cfg(
+        {
+            "capacity": {"ecoli": 256, "scavenger": 256},
+            "shape": (32, 32),
+            "size": None,             # defaults to 10 um bins
+            "diffusion": {"glc": 600.0, "ace": 900.0, "o2": 2000.0},
+            "initial": {"glc": 10.0, "ace": 0.0, "o2": 5.0},
+            "timestep": 1.0,
+            "division": True,
+            "ecoli": {
+                "metabolism": {},
+                "divide": {},
+                "motility": {"sigma": 0.5},
+            },
+            "scavenger": {
+                # starts on an EMPTY acetate field: everything it eats
+                # was secreted by the rFBA species
+                "transport": {
+                    "molecule": "ace",
+                    "vmax": 0.05,
+                    "external_default": 0.0,
+                },
+                "growth": {"rate": 0.0003},
+                "divide": {},
+                "motility": {"sigma": 0.5},
+            },
+        },
+        config,
+    )
+    from lens_tpu.environment.multispecies import MultiSpeciesColony
+
+    e = c["ecoli"]
+    e["metabolism"], c["diffusion"], c["initial"] = _rfba_network_fill(
+        e["metabolism"], c["diffusion"], c["initial"]
+    )
+    metabolism, ecoli_procs, ecoli_topo = _rfba_cell(
+        e["metabolism"], e["divide"], e["motility"]
+    )
+    ecoli = Compartment(processes=ecoli_procs, topology=ecoli_topo)
+    s = c["scavenger"]
+    scavenger = Compartment(
+        processes={
+            "transport": MichaelisMentenTransport(s["transport"]),
+            "growth": Growth(s["growth"]),
+            "divide_trigger": DivideTrigger(s["divide"]),
+            "motility": BrownianMotility(s["motility"]),
+        },
+        topology={
+            "transport": {
+                "external": ("boundary", "external"),
+                "internal": ("cell",),
+                "exchange": ("boundary", "exchange"),
+            },
+            "growth": {"global": ("global",)},
+            "divide_trigger": {"global": ("global",)},
+            "motility": {"boundary": ("boundary",)},
+        },
+    )
+    shape = tuple(c["shape"])
+    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
+    lattice = Lattice(
+        molecules=list(metabolism.external),
+        shape=shape,
+        size=size,
+        diffusion=c["diffusion"],
+        initial=c["initial"],
+        timestep=c["timestep"],
+        impl=c.get("impl", "auto"),
+    )
+    multi = MultiSpeciesColony(
+        species={
+            "ecoli": _field_species(
+                ecoli, c["capacity"]["ecoli"], lattice,
+                list(metabolism.external), c["division"],
+            ),
+            "scavenger": _field_species(
+                scavenger, c["capacity"]["scavenger"], lattice, ["ace"],
+                c["division"],
+            ),
+        },
+        lattice=lattice,
+    )
+    return multi, {"ecoli": ecoli, "scavenger": scavenger}
 
 
 @register_composite
@@ -498,25 +656,6 @@ def mixed_species_lattice(
         impl=c.get("impl", "auto"),
     )
 
-    def _species(compartment: Compartment, capacity: int, mols):
-        colony = Colony(
-            compartment,
-            capacity=capacity,
-            division_trigger=("global", "divide") if c["division"] else None,
-        )
-        return SpatialColony(
-            colony,
-            lattice,
-            field_ports={
-                mol: (
-                    ("boundary", "external", mol),
-                    ("boundary", "exchange", f"{mol}_exchange"),
-                )
-                for mol in mols
-            },
-            location_path=("boundary", "location"),
-        )
-
     e = c["ecoli"]
     ecoli = Compartment(
         processes={
@@ -563,9 +702,13 @@ def mixed_species_lattice(
     scavenger = Compartment(processes=scav_procs, topology=scav_topo)
     multi = MultiSpeciesColony(
         species={
-            "ecoli": _species(ecoli, int(c["capacity"]["ecoli"]), ["glucose"]),
-            "scavenger": _species(
-                scavenger, int(c["capacity"]["scavenger"]), ["acetate"]
+            "ecoli": _field_species(
+                ecoli, c["capacity"]["ecoli"], lattice, ["glucose"],
+                c["division"],
+            ),
+            "scavenger": _field_species(
+                scavenger, c["capacity"]["scavenger"], lattice, ["acetate"],
+                c["division"],
             ),
         },
         lattice=lattice,
