@@ -48,6 +48,24 @@ def _cfg(defaults: dict, config: Mapping | None) -> dict:
     return deep_merge(defaults, config)
 
 
+def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:
+    """The standard lattice from a composite config: ``size`` defaults to
+    10 um bins; ``impl`` selects the diffusion scheme ("auto" =
+    pallas/xla by backend, "xla", "pallas", "adi" — reaches the CLI as
+    e.g. ``--config '{"impl": "adi"}'``)."""
+    shape = tuple(c["shape"])
+    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
+    return Lattice(
+        molecules=molecules,
+        shape=shape,
+        size=size,
+        diffusion=diffusion,
+        initial=initial,
+        timestep=c["timestep"],
+        impl=c.get("impl", "auto"),
+    )
+
+
 def _spatial_colony(
     compartment: Compartment,
     molecules: list,
@@ -64,20 +82,7 @@ def _spatial_colony(
         capacity=int(c["capacity"]),
         division_trigger=("global", "divide") if c["division"] else None,
     )
-    shape = tuple(c["shape"])
-    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
-    lattice = Lattice(
-        molecules=molecules,
-        shape=shape,
-        size=size,
-        diffusion=diffusion,
-        initial=initial,
-        timestep=c["timestep"],
-        # diffusion scheme: "auto" (pallas/xla by backend), "xla",
-        # "pallas", "adi" (unconditionally stable backward-Euler split)
-        # — reaches the CLI as e.g. --config '{"impl": "adi"}'
-        impl=c.get("impl", "auto"),
-    )
+    lattice = _make_lattice(c, molecules, diffusion, initial)
     spatial = SpatialColony(
         colony,
         lattice,
@@ -571,16 +576,8 @@ def rfba_cross_feeding(
             "motility": {"boundary": ("boundary",)},
         },
     )
-    shape = tuple(c["shape"])
-    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
-    lattice = Lattice(
-        molecules=list(metabolism.external),
-        shape=shape,
-        size=size,
-        diffusion=c["diffusion"],
-        initial=c["initial"],
-        timestep=c["timestep"],
-        impl=c.get("impl", "auto"),
+    lattice = _make_lattice(
+        c, list(metabolism.external), c["diffusion"], c["initial"]
     )
     multi = MultiSpeciesColony(
         species={
@@ -644,16 +641,8 @@ def mixed_species_lattice(
     )
     from lens_tpu.environment.multispecies import MultiSpeciesColony
 
-    shape = tuple(c["shape"])
-    size = c["size"] or (10.0 * shape[0], 10.0 * shape[1])
-    lattice = Lattice(
-        molecules=["glucose", "acetate"],
-        shape=shape,
-        size=size,
-        diffusion=c["diffusion"],
-        initial=c["initial"],
-        timestep=c["timestep"],
-        impl=c.get("impl", "auto"),
+    lattice = _make_lattice(
+        c, ["glucose", "acetate"], c["diffusion"], c["initial"]
     )
 
     e = c["ecoli"]
