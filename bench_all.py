@@ -207,6 +207,42 @@ def config_4():
     }
 
 
+def config_xf():
+    """Cross-feeding at network scale (rfba_cross_feeding): 1k exact-rFBA
+    cells (core-carbon LP per cell per step) + 1k kinetic scavengers on
+    one 64x64 lattice — the heterogeneous-biology frontier beyond
+    BASELINE's configs (per-agent LP for half the population)."""
+    import jax
+
+    from lens_tpu.models.composites import rfba_cross_feeding
+
+    n_each = 1024
+    multi, _ = rfba_cross_feeding(
+        {
+            "capacity": {"ecoli": n_each, "scavenger": n_each},
+            "shape": (64, 64),
+        }
+    )
+
+    def build():
+        state = multi.initial_state(
+            {"ecoli": n_each, "scavenger": n_each}, jax.random.PRNGKey(0)
+        )
+        window = jax.jit(
+            lambda s: multi.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, 2 * n_each)
+    return {
+        "config": "xf",
+        "scenario": "rFBA cross-feeding: 1k LP cells + 1k scavengers, "
+        "64x64 lattice (network-scale syntrophy)",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
 def config_2e():
     """Config 2 with DENSE emission: every step's emit slice is produced
     and materialized (the reference's every-step MongoDB emit pattern,
@@ -251,6 +287,7 @@ CONFIGS = {
     3: config_3,
     "3b": config_3b,
     4: config_4,
+    "xf": config_xf,
 }
 
 
