@@ -131,6 +131,16 @@ def _build_parser() -> argparse.ArgumentParser:
             "(colony.Ensemble); emission gains a [T, R, ...] layout and "
             "`analyze` renders fan charts",
         )
+        sp.add_argument(
+            "--replicate-overrides",
+            default=None,
+            metavar="JSON",
+            help="per-replicate initial conditions (leaves carry a "
+            "leading [R] axis) turning --replicates into a parameter "
+            'scan, e.g. \'{"global": {"volume": [1.0, 1.4, 1.9]}}\'; '
+            "`analyze` then auto-plots the dose-response from the log "
+            "header",
+        )
         sp.add_argument("--quiet", action="store_true")
         sp.add_argument(
             "--trace",
@@ -190,6 +200,16 @@ def _validate_run_args(args: argparse.Namespace) -> None:
     # (--timeline with a non-lattice composite is rejected by Experiment
     # at construction — lattice-ness needs the composite registry, which
     # lives behind the jax import this function runs before.)
+    if args.replicate_overrides is not None:
+        if args.replicates is None:
+            raise SystemExit(
+                "--replicate-overrides needs --replicates to define the "
+                "scan axis"
+            )
+        try:
+            json.loads(args.replicate_overrides)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"--replicate-overrides is not valid JSON: {e}")
     if args.replicates is not None:
         if args.replicates < 1:
             raise SystemExit(f"--replicates must be >= 1, got {args.replicates}")
@@ -228,6 +248,11 @@ def _experiment_config(args: argparse.Namespace) -> dict:
         "checkpoint_every": args.checkpoint_every,
         "timeline": args.timeline,
         "replicates": args.replicates,
+        "replicate_overrides": (
+            json.loads(args.replicate_overrides)
+            if args.replicate_overrides
+            else {}
+        ),
     }
 
 

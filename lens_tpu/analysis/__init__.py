@@ -735,6 +735,19 @@ def report(
         if isinstance(sub, Mapping) and _alive_ndim(sub) == 3
     }
     if _alive_ndim(ts) == 3 or ens_species:
+        # Scan axis from provenance: when the log's experiment config
+        # (header) scanned exactly ONE parameter across replicates, the
+        # dose-response curve is drawable without the user re-supplying
+        # the values.
+        scan = None
+        cfg = header.get("config") if isinstance(header, Mapping) else None
+        if isinstance(cfg, Mapping) and cfg.get("replicate_overrides"):
+            from lens_tpu.utils.dicts import flatten_paths
+
+            leaves = list(flatten_paths(cfg["replicate_overrides"]))
+            if len(leaves) == 1:
+                scan = (leaves[0][0], np.asarray(leaves[0][1]))
+
         targets = {"": ts} if _alive_ndim(ts) == 3 else ens_species
         for name, sub in targets.items():
             prefix = f"{name}_" if name else ""
@@ -745,6 +758,17 @@ def report(
             written[f"{dot}timeseries"] = plot_timeseries(
                 sub, out_path=os.path.join(out_dir, f"{prefix}timeseries.png")
             )
+            if scan is not None and scan[1].ndim == 1 and scan[1].shape[
+                0
+            ] == np.asarray(sub["alive"]).shape[1]:
+                written[f"{dot}scan_response"] = plot_scan_response(
+                    sub,
+                    scan[1],
+                    out_path=os.path.join(
+                        out_dir, f"{prefix}scan_response.png"
+                    ),
+                    value_label=SEP_TITLE.join(scan[0]),
+                )
         return written
 
     species = {

@@ -148,6 +148,13 @@ def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     records: List[Dict[str, Any]] = []
     for record in read_records(path):
         if is_header(record):
+            if header:
+                # FIRST header wins: each resume appends another header
+                # (LogEmitter writes one at construction), but the run
+                # that CREATED the experiment is the provenance — a
+                # resume invocation's config (fresh experiment_id, maybe
+                # missing replicate_overrides) must not overwrite it.
+                continue
             h = record["__header__"]
             header = {
                 "experiment_id": str(h["experiment_id"]),

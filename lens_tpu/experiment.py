@@ -92,6 +92,23 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 }
 
 
+def _jsonable(node):
+    """Config tree -> plain JSON-serializable types (tuples -> lists,
+    arrays -> lists, anything else -> str) for the log header's
+    provenance record. The str fallback matters: a Path or other object
+    in the config must degrade to readable provenance, not crash
+    json.dumps inside the emitter header."""
+    if isinstance(node, Mapping):
+        return {str(k): _jsonable(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_jsonable(v) for v in node]
+    if hasattr(node, "tolist"):
+        return node.tolist()
+    if isinstance(node, (str, int, float, bool)) or node is None:
+        return node
+    return str(node)
+
+
 class Experiment:
     """One configured, runnable simulation (the reference's "experiment").
 
@@ -243,7 +260,15 @@ class Experiment:
                         n_agents=int(mesh_cfg["replicates"]), n_space=1
                     ),
                 )
-        self.emitter: Emitter = get_emitter(dict(self.config["emitter"]))
+        # Experiment provenance rides the emitter: the log header records
+        # the FULL experiment config (the reference stored experiment
+        # documents beside the data in Mongo — SURVEY.md §3.5), so a log
+        # is self-describing: `analyze` can report what produced it and
+        # auto-detect scan axes from replicate_overrides.
+        emitter_cfg = dict(self.config["emitter"])
+        if "config" not in emitter_cfg:
+            emitter_cfg["config"] = _jsonable(self.config)
+        self.emitter: Emitter = get_emitter(emitter_cfg)
         self.checkpointer = (
             Checkpointer(self.config["checkpoint_dir"])
             if self.config["checkpoint_dir"]

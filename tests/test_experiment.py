@@ -101,6 +101,76 @@ class TestReplicatesExperiment:
         pops = np.asarray(state.alive).sum(axis=1)
         assert pops[2] >= pops[0] and pops[2] > 1
 
+    def test_log_header_provenance_and_scan_autoplot(self, tmp_path):
+        """The log header records the FULL experiment config, and
+        `analyze` derives the dose-response plot from it without the
+        user re-supplying the scanned values."""
+        import os
+
+        from lens_tpu.analysis import load, report
+
+        log = str(tmp_path / "scan.lens")
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.02}},
+                "n_agents": 1,
+                "capacity": 16,
+                "total_time": 20.0,
+                "emit_every": 10,
+                "replicates": 3,
+                "replicate_overrides": {
+                    "global": {"volume": [1.0, 1.4, 1.9]}
+                },
+                "emitter": {"type": "log", "path": log},
+            }
+        ) as exp:
+            exp.run()
+        header, _ = load(log)
+        assert header["config"]["composite"] == "grow_divide"
+        assert header["config"]["replicate_overrides"]["global"][
+            "volume"
+        ] == [1.0, 1.4, 1.9]
+        written = report(log, out_dir=str(tmp_path / "plots"))
+        assert "scan_response" in written
+        assert os.path.getsize(written["scan_response"]) > 1000
+
+    def test_resume_keeps_original_provenance(self, tmp_path):
+        """A resume appends its own header; the log must still report the
+        CREATING run's config (first header wins), so the scan auto-plot
+        survives resumes that don't re-pass replicate_overrides."""
+        from lens_tpu.analysis import load, report
+
+        log = str(tmp_path / "scan.lens")
+
+        def cfg(total, overrides):
+            return {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.02}},
+                "n_agents": 1,
+                "capacity": 16,
+                "total_time": total,
+                "emit_every": 10,
+                "replicates": 3,
+                "replicate_overrides": overrides,
+                "emitter": {"type": "log", "path": log},
+                "checkpoint_dir": str(tmp_path / "ckpt"),
+                "checkpoint_every": 10.0,
+            }
+
+        scan = {"global": {"volume": [1.0, 1.4, 1.9]}}
+        with Experiment(cfg(20.0, scan)) as exp:
+            exp.run()
+        with Experiment(cfg(40.0, {})) as exp:  # resume WITHOUT the scan
+            exp.resume()
+        header, ts = load(log)
+        assert header["config"]["replicate_overrides"] == {
+            "global": {"volume": [1.0, 1.4, 1.9]}
+        }
+        assert ts["alive"].shape[0] == 4  # 20s + 20s of emits
+        written = report(log, out_dir=str(tmp_path / "plots"))
+        assert "scan_response" in written
+
     def test_replicates_checkpoint_resume_bitwise(self, tmp_path):
         def cfg(base, total):
             return {
