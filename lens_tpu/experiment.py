@@ -275,6 +275,13 @@ class Experiment:
             else None
         )
 
+    @property
+    def _ensemble_exec(self):
+        """The object that executes replicate runs: the sharded runner
+        when a replicate mesh is configured, else the plain Ensemble
+        (identical surfaces)."""
+        return self.ensemble_runner or self.ensemble
+
     # -- state construction --------------------------------------------------
 
     def initial_state(self):
@@ -297,7 +304,7 @@ class Experiment:
                 )
             counts = {k: int(v) for k, v in n_cfg.items()}
             if self.ensemble is not None:
-                return (self.ensemble_runner or self.ensemble).initial_state(
+                return self._ensemble_exec.initial_state(
                     counts,
                     key=key,
                     overrides=self.config["overrides"] or None,
@@ -317,7 +324,7 @@ class Experiment:
                 n, key, stripe=stripe, overrides=overrides
             )
         if self.ensemble is not None:
-            return (self.ensemble_runner or self.ensemble).initial_state(
+            return self._ensemble_exec.initial_state(
                 n,
                 key=key,
                 overrides=overrides,
@@ -347,7 +354,7 @@ class Experiment:
         # a sync and serialize the pipelined emission below.
         start_time = start_step * dt
         if self.ensemble is not None:
-            ens = self.ensemble_runner or self.ensemble
+            ens = self._ensemble_exec
             if self.config["timeline"] is not None:
                 return ens.run_timeline(
                     state, self.config["timeline"], duration, dt,

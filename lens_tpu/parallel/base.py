@@ -17,6 +17,25 @@ from typing import Tuple
 import jax
 
 
+def cached_jit(cache: dict, key, build):
+    """Get-or-build a jitted callable in ``cache`` under ``key``.
+
+    A fresh ``jax.jit(lambda ...)`` per call would key jit's own cache on
+    the new lambda's identity and retrace every time — segmented runs
+    call the same program once per segment. The cache dict is owned by
+    the runner INSTANCE (a functools cache on a method would pin the
+    instance and its compiled executables' device buffers in a
+    class-level cache long after the owner is dropped). An unhashable
+    key (e.g. a sequence-form media timeline) pays a per-call trace."""
+    try:
+        fn = cache.get(key)
+    except TypeError:
+        return build()
+    if fn is None:
+        fn = cache[key] = build()
+    return fn
+
+
 class ShardedRunnerBase:
     """Subclasses provide:
 
@@ -153,15 +172,15 @@ class ShardedRunnerBase:
         from lens_tpu.core.schedule import scan_schedule
 
         step = self._cached_step(state, timestep)
-        cache_key = (total_time, timestep, emit_every)
-        run = self._run_cache.get(cache_key)
-        if run is None:
-            run = jax.jit(
+        run = cached_jit(
+            self._run_cache,
+            (float(total_time), float(timestep), int(emit_every)),
+            lambda: jax.jit(
                 lambda s: scan_schedule(
                     step, self._emit_fn, s, total_time, timestep, emit_every
                 )
-            )
-            self._run_cache[cache_key] = run
+            ),
+        )
         return run(state)
 
     def run_timeline(

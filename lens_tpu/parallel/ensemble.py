@@ -25,6 +25,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from lens_tpu.colony.ensemble import Ensemble
+from lens_tpu.parallel.base import cached_jit
 from lens_tpu.parallel.mesh import AGENTS_AXIS, make_mesh
 
 
@@ -88,43 +89,6 @@ class ShardedEnsemble:
             self.ensemble.initial_state(*args, key=key, **kwargs)
         )
 
-    # The jitted callables are cached per argument tuple: a fresh
-    # ``jax.jit(lambda ...)`` each call would key jit's own cache on the
-    # new lambda's identity and retrace (segmented Experiment runs call
-    # run() once per segment — same program every time). Per-INSTANCE
-    # dicts, not functools caches on the methods: a class-level cache
-    # would pin self (and its compiled executables' device buffers) long
-    # after the Experiment is closed.
-    def _jit_run(self, total_time: float, timestep: float, emit_every: int):
-        key = (total_time, timestep, emit_every)
-        fn = self._run_cache.get(key)
-        if fn is None:
-            fn = self._run_cache[key] = jax.jit(
-                lambda s: self.ensemble.run(
-                    s, total_time, timestep, emit_every
-                )
-            )
-        return fn
-
-    def _jit_run_timeline(
-        self,
-        timeline,
-        total_time: float,
-        timestep: float,
-        emit_every: int,
-        start_time: float,
-    ):
-        key = (timeline, total_time, timestep, emit_every, start_time)
-        fn = self._run_cache.get(key)  # raises TypeError if unhashable
-        if fn is None:
-            fn = self._run_cache[key] = jax.jit(
-                lambda s: self.ensemble.run_timeline(
-                    s, timeline, total_time, timestep, emit_every,
-                    start_time,
-                )
-            )
-        return fn
-
     def run(
         self, states, total_time: float, timestep: float, emit_every: int = 1
     ) -> Tuple[Any, dict]:
@@ -132,9 +96,16 @@ class ShardedEnsemble:
         partitioner splits every per-replicate computation across the
         mesh; outputs stay sharded (trajectory leaves [T, R, ...] carry
         the replicate sharding on axis 1)."""
-        return self._jit_run(float(total_time), float(timestep), int(emit_every))(
-            states
+        fn = cached_jit(
+            self._run_cache,
+            (float(total_time), float(timestep), int(emit_every)),
+            lambda: jax.jit(
+                lambda s: self.ensemble.run(
+                    s, total_time, timestep, emit_every
+                )
+            ),
         )
+        return fn(states)
 
     def run_timeline(
         self,
@@ -145,24 +116,22 @@ class ShardedEnsemble:
         emit_every: int = 1,
         start_time: float = 0.0,
     ) -> Tuple[Any, dict]:
-        try:
-            fn = self._jit_run_timeline(
+        fn = cached_jit(
+            self._run_cache,
+            (
                 timeline,
                 float(total_time),
                 float(timestep),
                 int(emit_every),
                 float(start_time),
-            )
-        except TypeError:
-            # sequence-form timelines (lists / dict recipes) are not
-            # hashable — pay a per-call trace for those; the common
-            # string form caches
-            fn = jax.jit(
+            ),
+            lambda: jax.jit(
                 lambda s: self.ensemble.run_timeline(
                     s, timeline, total_time, timestep, emit_every,
                     start_time,
                 )
-            )
+            ),
+        )
         return fn(states)
 
     def emit_state(self, states) -> dict:
