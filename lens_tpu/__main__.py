@@ -213,12 +213,11 @@ def _validate_run_args(args: argparse.Namespace) -> None:
     if args.replicates is not None:
         if args.replicates < 1:
             raise SystemExit(f"--replicates must be >= 1, got {args.replicates}")
-        for flag in ("mesh", "auto_expand"):
-            if getattr(args, flag) is not None:
-                raise SystemExit(
-                    f"--replicates does not compose with --{flag.replace('_', '-')} "
-                    "(see experiment.DEFAULT_CONFIG)"
-                )
+        if args.mesh is not None:
+            raise SystemExit(
+                "--replicates does not compose with --mesh "
+                "(see experiment.DEFAULT_CONFIG)"
+            )
 
 
 def _experiment_config(args: argparse.Namespace) -> dict:

@@ -44,6 +44,7 @@ from typing import Any, Mapping, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from lens_tpu.core.schedule import scan_schedule
 from lens_tpu.utils.dicts import flatten_paths, set_path
@@ -139,6 +140,44 @@ class Ensemble:
             timestep,
             emit_every,
         )
+
+    def expanded(self, states, factor=2) -> Tuple["Ensemble", Any]:
+        """Capacity growth for every replicate (host-side, at a segment
+        boundary — same contract as :meth:`Colony.expanded`).
+
+        Replicates advance in lockstep, so each replicate's slice expands
+        through the wrapped sim's OWN ``expanded`` with identical
+        capacity/lineage-id bookkeeping; the padded slices re-stack into
+        the ensemble layout. Returns ``(ensemble_with_grown_sim,
+        stacked_states)`` — the pre-expansion trajectory of every
+        replicate is bitwise unchanged, exactly as for a single colony.
+        """
+        if not callable(getattr(self.sim, "expanded", None)):
+            raise TypeError(
+                f"{type(self.sim).__name__} has no expanded(); capacity "
+                f"growth needs a Colony/SpatialColony-form sim"
+            )
+        host = jax.device_get(states)
+        grown_sim = None
+        slices = []
+        # Delegating per replicate re-runs the (host-side, cheap)
+        # grown-colony construction R times, but keeps ONE source of
+        # truth for expansion semantics — a batched pad here would have
+        # to mirror Colony.expanded's template/lineage rules forever.
+        for r in range(self.n_replicates):
+            sim_r, s_r = self.sim.expanded(
+                jax.tree.map(lambda x: x[r], host), factor
+            )
+            grown_sim = grown_sim or sim_r
+            slices.append(s_r)
+        # np.stack, not jnp: the stacked grown ensemble must NOT
+        # materialize on one device (a replicate-mesh caller re-shards
+        # it; the transient single-device copy could OOM where both
+        # sharded layouts fit).
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *slices
+        )
+        return Ensemble(grown_sim, self.n_replicates), stacked
 
     def run_timeline(
         self,

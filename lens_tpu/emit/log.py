@@ -169,29 +169,43 @@ def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
 
 
 def _pad_stack(arrays: List[np.ndarray]) -> np.ndarray:
-    """``np.stack`` that tolerates a growing leading (agent) axis.
+    """``np.stack`` that tolerates a growing agent (row) axis.
 
     Capacity expansion (``Colony.expanded``) doubles the agent dimension
     mid-experiment, so records from different segments may disagree in
-    axis 0. Shorter records are padded with zeros (``False`` for the
-    alive mask, so dead-row masking keeps working); trailing axes must
-    still agree.
+    ONE axis: axis 0 for plain records, axis 1 for ensemble records
+    (``[R, rows, ...]`` — the replicate count is fixed for a run).
+    Shorter records are padded with zeros (``False`` for the alive mask,
+    so dead-row masking keeps working); every other axis must agree.
     """
     shapes = {a.shape for a in arrays}
     if len(shapes) == 1:
         return np.stack(arrays)
-    trailing = {a.shape[1:] for a in arrays}
-    if len(trailing) != 1 or any(a.ndim == 0 for a in arrays):
+    ndims = {a.ndim for a in arrays}
+    if len(ndims) != 1 or 0 in ndims:
         raise ValueError(
-            f"cannot stack records with shapes {sorted(shapes)}: only the "
-            f"leading (agent) axis may vary across segments"
+            f"cannot stack records with shapes {sorted(shapes)}: only one "
+            f"axis (the agent rows) may vary across segments"
         )
-    n_max = max(a.shape[0] for a in arrays)
+    # the single axis along which shapes differ = the row axis
+    varying = {
+        ax
+        for ax in range(next(iter(ndims)))
+        if len({s[ax] for s in shapes}) > 1
+    }
+    if len(varying) != 1:
+        raise ValueError(
+            f"cannot stack records with shapes {sorted(shapes)}: only one "
+            f"axis (the agent rows) may vary across segments"
+        )
+    axis = varying.pop()
+    n_max = max(a.shape[axis] for a in arrays)
     padded = []
     for a in arrays:
-        if a.shape[0] < n_max:
-            pad = np.zeros((n_max - a.shape[0],) + a.shape[1:], a.dtype)
-            a = np.concatenate([a, pad], axis=0)
+        if a.shape[axis] < n_max:
+            width = [(0, 0)] * a.ndim
+            width[axis] = (0, n_max - a.shape[axis])
+            a = np.pad(a, width)
         padded.append(a)
     return np.stack(padded)
 

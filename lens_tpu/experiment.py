@@ -83,10 +83,12 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # ``replicate_overrides`` (nested mapping, leaves [N, ...]) turns the
     # replicate axis into a parameter scan. Emission gains a [T, R, ...]
     # layout that analysis.report renders as fan charts. Composes with
-    # checkpoint/resume, (for lattice composites) media timelines, and
+    # checkpoint/resume, (for lattice composites) media timelines,
     # replicate-parallel meshes ({"mesh": {"replicates": N}} splits the
-    # replicate axis over N devices — zero collectives, perfect scaling);
-    # NOT with agent/space meshes or auto_expand (gated at construction).
+    # replicate axis over N devices — zero collectives, perfect scaling),
+    # and auto_expand (every replicate's capacity grows when the TIGHTEST
+    # pool runs low; single-species forms only); NOT with agent/space
+    # meshes (gated at construction).
     "replicates": None,
     "replicate_overrides": {},
 }
@@ -195,10 +197,11 @@ class Experiment:
                 # truthiness would let 0 degrade to an unreplicated run
                 # and a float silently truncate downstream
                 raise ValueError(f"replicates must be an int >= 1, got {r!r}")
-            if self.config["auto_expand"]:
+            if self.config["auto_expand"] and self.multi is not None:
                 raise ValueError(
-                    "'replicates' with 'auto_expand': capacity expansion "
-                    "re-allocates unbatched states"
+                    "'replicates' with 'auto_expand' on a multi-species "
+                    "composite: per-species expansion factors are not "
+                    "wired through the replicate axis"
                 )
             if self.config["mesh"] and not replicate_mesh:
                 raise ValueError(
@@ -235,10 +238,13 @@ class Experiment:
             )
         if (
             self.config["auto_expand"]
-            and self.runner is not None
+            and (self.runner is not None or replicate_mesh)
             and jax.process_count() > 1
         ):
             # fail at construction, not hours in when the colony fills
+            # (covers the replicate mesh too: Ensemble.expanded pulls the
+            # whole state to host with device_get, which rejects
+            # non-addressable shards)
             raise ValueError(
                 "auto_expand on a multi-host mesh is not supported yet "
                 "(expansion gathers the full state to one host)"
@@ -409,6 +415,34 @@ class Experiment:
         factor = int(cfg.get("factor", 2))
         free_frac = float(cfg.get("free_frac", 0.2))
         max_cap = cfg.get("max_capacity")
+
+        if self.ensemble is not None:
+            cs = state.colony if isinstance(state, SpatialState) else state
+            alive = np.asarray(jax.device_get(cs.alive))  # [R, rows]
+            cap = alive.shape[-1]
+            if max_cap is not None and cap * factor > int(max_cap):
+                return state
+            # expand when the TIGHTEST replicate runs low — replicates
+            # share one capacity, so the fullest pool decides
+            if (~alive).sum(axis=-1).min() > free_frac * cap:
+                return state
+            self.ensemble, state = self.ensemble.expanded(state, factor)
+            grown = self.ensemble.sim
+            if self.spatial is not None:
+                self.spatial = grown
+                self.colony = grown.colony
+            else:
+                self.colony = grown
+            if self.ensemble_runner is not None:
+                from lens_tpu.parallel import ShardedEnsemble
+
+                self.ensemble_runner = ShardedEnsemble(
+                    self.ensemble,
+                    self.ensemble_runner.mesh,
+                    self.ensemble_runner.axis,
+                )
+                state = self.ensemble_runner.shard(state)
+            return state
 
         def wants_growth(cs) -> bool:
             cap = int(cs.alive.shape[0])
@@ -656,17 +690,6 @@ class Experiment:
         cap = int(cs.alive.shape[-1])
         if cap == self.colony.capacity:
             return
-        if self.ensemble is not None:
-            # auto_expand is gated off with replicates, so no legitimate
-            # run produced an expanded ensemble checkpoint — a capacity
-            # mismatch here is a config edit, and adopting it would step
-            # the restored state through a stale Ensemble-wrapped colony.
-            raise ValueError(
-                f"checkpoint has {cap} rows per replicate but the config "
-                f"builds capacity {self.colony.capacity}; with "
-                f"'replicates' set, resume with the capacity the run was "
-                f"checkpointed at"
-            )
         meta_path = self._colony_meta_path()
         if not os.path.exists(meta_path):
             raise ValueError(
@@ -702,6 +725,23 @@ class Experiment:
                     self.spatial, self.runner.mesh
                 )
         self.colony = grown
+        if self.ensemble is not None:
+            # the Ensemble closed over the pre-adoption sim; re-wrap so
+            # resumed replicate runs step the grown colony (stale wrap =
+            # wrong id-minting stride, the exact bug adoption prevents)
+            from lens_tpu.colony.ensemble import Ensemble
+
+            self.ensemble = Ensemble(
+                self.spatial or self.colony, self.ensemble.n_replicates
+            )
+            if self.ensemble_runner is not None:
+                from lens_tpu.parallel import ShardedEnsemble
+
+                self.ensemble_runner = ShardedEnsemble(
+                    self.ensemble,
+                    self.ensemble_runner.mesh,
+                    self.ensemble_runner.axis,
+                )
 
     def _check_restored_replicates(self, cs) -> None:
         """A checkpoint's replicate axis must match the resume config:

@@ -240,9 +240,14 @@ class TestReplicatesExperiment:
         with Experiment(cfg(total_time=40.0, replicates=3)) as exp:
             with pytest.raises(ValueError, match="replicates=3"):
                 exp.resume()
+        # capacity edits ADOPT the checkpoint (state is authoritative,
+        # same semantics as unreplicated runs): resume continues at the
+        # checkpointed 16 rows, not the config's 32
         with Experiment(cfg(total_time=40.0, capacity=32)) as exp:
-            with pytest.raises(ValueError, match="16 rows per replicate"):
-                exp.resume()
+            resumed = exp.resume()
+        assert resumed.alive.shape == (2, 16)
+        assert exp.colony.capacity == 16
+        assert exp.ensemble.sim is exp.colony
 
     def test_multispecies_replicates_resume(self, tmp_path):
         """The capacity-adoption probe must read the ROW axis (last), not
@@ -371,6 +376,54 @@ class TestReplicatesExperiment:
         for la, lb in zip(jax.tree.leaves(full), jax.tree.leaves(resumed)):
             np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
+    def test_replicates_auto_expand_grows_every_replicate(self, tmp_path):
+        """Capacity growth composes with the replicate axis: every
+        replicate's colony expands (shared capacity, tightest pool
+        decides), divisions are never suppressed, and lineage ids stay
+        unique per replicate."""
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.05}},
+                "n_agents": 6,
+                "capacity": 8,
+                "total_time": 60.0,
+                "checkpoint_every": 5.0,
+                "auto_expand": {"free_frac": 0.3, "factor": 2},
+                "replicates": 2,
+                "checkpoint_dir": str(tmp_path / "ckpt"),
+            }
+        ) as exp:
+            state = exp.run()
+            ts = exp.emitter.timeseries()
+        alive = np.asarray(state.alive)  # [R, rows]
+        assert alive.shape[0] == 2 and alive.shape[1] > 8
+        assert (alive.sum(axis=1) >= 4 * 6).all()  # every replicate 4x'd
+        assert (np.asarray(ts["division_backlog"]) == 0).all()
+        ids = np.asarray(state.agents["lineage"]["cell_id"])
+        for r in range(2):
+            live = ids[r][alive[r]]
+            assert len(np.unique(live)) == len(live)
+        # resume adopts the expanded capacity (sidecar) and re-wraps the
+        # ensemble: continuing to a longer horizon keeps growing cleanly
+        cfg2 = {
+            "composite": "grow_divide",
+            "config": {"growth": {"rate": 0.05}},
+            "n_agents": 6,
+            "capacity": 8,
+            "total_time": 70.0,
+            "checkpoint_every": 5.0,
+            "auto_expand": {"free_frac": 0.3, "factor": 2},
+            "replicates": 2,
+            "checkpoint_dir": str(tmp_path / "ckpt"),
+            "emitter": {"type": "null"},
+        }
+        with Experiment(cfg2) as exp:
+            resumed = exp.resume()
+        r_alive = np.asarray(resumed.alive)
+        assert r_alive.shape[1] >= alive.shape[1]
+        assert (r_alive.sum(axis=1) >= alive.sum(axis=1)).all()
+
     def test_gates_raise_at_construction(self):
         with pytest.raises(ValueError, match="needs 'replicates' set"):
             Experiment(
@@ -415,8 +468,19 @@ class TestReplicatesExperiment:
         base = {"composite": "toggle_colony", "replicates": 2}
         with pytest.raises(ValueError, match="needs a lattice composite"):
             Experiment(dict(base, timeline="0 minimal"))
-        with pytest.raises(ValueError, match="'replicates' with 'auto_expand'"):
-            Experiment(dict(base, auto_expand={"free_frac": 0.2}))
+        with pytest.raises(ValueError, match="multi-species"):
+            Experiment(
+                {
+                    "composite": "mixed_species_lattice",
+                    "config": {
+                        "capacity": {"ecoli": 8, "scavenger": 8},
+                        "shape": (8, 8),
+                        "size": (8.0, 8.0),
+                    },
+                    "replicates": 2,
+                    "auto_expand": {"free_frac": 0.2},
+                }
+            )
         with pytest.raises(ValueError, match="replicate_overrides without"):
             Experiment(
                 {
