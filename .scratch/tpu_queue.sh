@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-4 on-device measurement queue. Run ONLY when no other process
+# holds the TPU (the axon relay serves one client at a time). Each
+# script probes the backend itself and writes its canonical BENCH_*.json;
+# this wrapper snapshots each into the *_r04.json name the judge reads.
+set -u
+cd "$(dirname "$0")/.."
+run() {
+  local script=$1 src=$2 dst=$3
+  echo "=== $script -> $dst ($(date -u +%H:%M:%S)) ==="
+  timeout 3000 python "$script" 2>&1 | tail -20
+  if [ -f "$src" ]; then cp "$src" "$dst"; else echo "!! $src missing"; fi
+}
+run bench_all.py          BENCH_ALL.json          BENCH_ALL_r04.json
+run bench_diffusion_ab.py BENCH_DIFFUSION_AB.json BENCH_DIFFUSION_AB_r04.json
+run bench_lp_sizes.py     BENCH_LP_SIZES.json     BENCH_LP_SIZES_r04.json
+run bench_agents_sweep.py BENCH_AGENTS_SWEEP.json BENCH_AGENTS_SWEEP_r04.json
+run bench_mfu.py          BENCH_MFU.json          BENCH_MFU_r04.json
+# chip-sized example records (each writes its own committed JSON)
+for ex in ensemble param_scan cross_feeding; do
+  echo "=== examples/$ex.py ($(date -u +%H:%M:%S)) ==="
+  timeout 3000 python "examples/$ex.py" 2>&1 | tail -8
+done
+echo "=== queue done ($(date -u +%H:%M:%S)) ==="

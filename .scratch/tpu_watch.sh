@@ -1,0 +1,28 @@
+#!/bin/bash
+# Poll the axon relay; the moment it answers, run the full on-device
+# queue (benches then tests_tpu). Logs to .scratch/tpu_watch.log.
+# Round-3 lesson: queued on-device work that waits for a human to press
+# the button misses the recovery window — this presses it.
+set -u
+cd "$(dirname "$0")/.."
+LOG=.scratch/tpu_watch.log
+probe() {
+  timeout 120 python -c "import jax; print('PLATFORM=' + jax.devices()[0].platform)" 2>/dev/null \
+    | grep -q "PLATFORM=" && return 0
+  return 1
+}
+echo "watch start $(date -u +%F'T'%T)" >> "$LOG"
+for i in $(seq 1 200); do
+  if probe; then
+    echo "relay UP at $(date -u +%F'T'%T) (probe $i)" >> "$LOG"
+    bash .scratch/tpu_queue.sh >> "$LOG" 2>&1
+    echo "=== tests_tpu ===" >> "$LOG"
+    LENS_TPU_DEVICE_TESTS=1 python -m pytest tests_tpu/ -q -p no:cacheprovider >> "$LOG" 2>&1
+    echo "queue+tests done $(date -u +%F'T'%T)" >> "$LOG"
+    exit 0
+  fi
+  echo "probe $i down $(date -u +%F'T'%T)" >> "$LOG"
+  sleep 300
+done
+echo "gave up $(date -u +%F'T'%T)" >> "$LOG"
+exit 1
