@@ -79,6 +79,15 @@ class Colony:
         Optional path into the agent state tree holding a boolean/0-1
         variable; rows where it is nonzero (and alive) divide this step.
         ``None`` disables division entirely.
+    death_trigger:
+        Optional path to a boolean/0-1 variable; rows where it is
+        nonzero (and alive) DIE this step — the alive bit clears, the
+        row freezes (same dead-row semantics as initial padding), and
+        the freed row returns to the division pool for a future
+        daughter. Death is the other half of the reference lineage's
+        lifecycle (cells burst/starve, and their OS process exits —
+        SURVEY.md §3.3); here it is one mask update, and it RECYCLES
+        capacity instead of leaking it.
     """
 
     def __init__(
@@ -87,6 +96,7 @@ class Colony:
         capacity: int,
         division_trigger: Optional[Path | str] = None,
         id_offset: int = 0,
+        death_trigger: Optional[Path | str] = None,
     ):
         self.compartment = compartment
         self.capacity = int(capacity)
@@ -100,13 +110,18 @@ class Colony:
         self.division_trigger = (
             normalize_path(division_trigger) if division_trigger is not None else None
         )
-        if self.division_trigger is not None and (
-            self.division_trigger not in compartment.updaters
+        self.death_trigger = (
+            normalize_path(death_trigger) if death_trigger is not None else None
+        )
+        for role, trig in (
+            ("division_trigger", self.division_trigger),
+            ("death_trigger", self.death_trigger),
         ):
-            raise ValueError(
-                f"division_trigger {self.division_trigger} is not a schema "
-                f"variable of the compartment"
-            )
+            if trig is not None and trig not in compartment.updaters:
+                raise ValueError(
+                    f"{role} {trig} is not a schema variable of the "
+                    f"compartment"
+                )
 
     # -- construction --------------------------------------------------------
 
@@ -200,8 +215,21 @@ class Colony:
         )
         return cs._replace(agents=agents)
 
+    def step_death(self, cs: ColonyState) -> ColonyState:
+        """Clear the alive bit where the death trigger fired (no-op if
+        disabled). Purely elementwise — shard-safe with no collectives —
+        and freed rows rejoin the division pool immediately."""
+        if self.death_trigger is None:
+            return cs
+        trig = get_path(cs.agents, self.death_trigger)
+        return cs._replace(alive=cs.alive & ~(trig > 0))
+
     def step_division(self, cs: ColonyState) -> ColonyState:
-        """Apply divisions per the trigger variable (no-op if disabled)."""
+        """Apply the lifecycle phase: deaths per the death trigger, then
+        divisions per the division trigger (each a no-op if disabled).
+        Death goes first so a row that both triggers name this step dies
+        rather than divides, and its row frees up for OTHER parents."""
+        cs = self.step_death(cs)
         if self.division_trigger is None:
             return cs
         key, sub = jax.random.split(cs.key)
@@ -279,6 +307,7 @@ class Colony:
             new_cap,
             division_trigger=self.division_trigger,
             id_offset=watermark - (step_now + 1) * 2 * new_cap,
+            death_trigger=self.death_trigger,
         )
         template = grown.initial_state(0).agents
         old_cap = self.capacity

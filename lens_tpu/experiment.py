@@ -174,7 +174,14 @@ class Experiment:
                 and ("global", "divide") in built.updaters
                 else None
             )
-            self.colony = Colony(built, capacity=capacity, division_trigger=trigger)
+            from lens_tpu.models.composites import _death_trigger_of
+
+            self.colony = Colony(
+                built,
+                capacity=capacity,
+                division_trigger=trigger,
+                death_trigger=_death_trigger_of(built),
+            )
         else:
             raise TypeError(
                 f"composite factory {name!r} returned {type(built)!r}"
@@ -715,6 +722,7 @@ class Experiment:
             cap,
             division_trigger=self.colony.division_trigger,
             id_offset=int(meta["id_offset"]),
+            death_trigger=self.colony.death_trigger,
         )
         if self.spatial is not None:
             self.spatial = self.spatial.with_colony(grown)
@@ -829,6 +837,7 @@ class Experiment:
                 caps[name],
                 division_trigger=sp.colony.division_trigger,
                 id_offset=int(meta[name]["id_offset"]),
+                death_trigger=sp.colony.death_trigger,
             )
             species[name] = sp.with_colony(grown)
         self.multi = MultiSpeciesColony(

@@ -20,6 +20,7 @@ from lens_tpu.processes import (
     BrownianMotility,
     Degradation,
     DeriveVolume,
+    DeathTrigger,
     DivideTrigger,
     FBAMetabolism,
     FlagellarMotor,
@@ -46,6 +47,16 @@ def register_composite(fn: Callable[..., Any]) -> Callable[..., Any]:
 
 def _cfg(defaults: dict, config: Mapping | None) -> dict:
     return deep_merge(defaults, config)
+
+
+def _death_trigger_of(compartment: Compartment):
+    """The standard death flag, iff the compartment declares it (a
+    DeathTrigger — or any process — owning ``('global', 'die')``)."""
+    return (
+        ("global", "die")
+        if ("global", "die") in compartment.updaters
+        else None
+    )
 
 
 def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:
@@ -81,6 +92,7 @@ def _spatial_colony(
         compartment,
         capacity=int(c["capacity"]),
         division_trigger=("global", "divide") if c["division"] else None,
+        death_trigger=_death_trigger_of(compartment),
     )
     lattice = _make_lattice(c, molecules, diffusion, initial)
     spatial = SpatialColony(
@@ -134,18 +146,25 @@ def toggle_colony(config: Mapping | None = None) -> Compartment:
 
 @register_composite
 def grow_divide(config: Mapping | None = None) -> Compartment:
-    """Minimal growth+division cell (the division-machinery exerciser)."""
-    c = _cfg({"growth": {}, "divide": {}}, config)
-    return Compartment(
-        processes={
-            "growth": Growth(c["growth"]),
-            "divide_trigger": DivideTrigger(c["divide"]),
-        },
-        topology={
-            "growth": {"global": ("global",)},
-            "divide_trigger": {"global": ("global",)},
-        },
-    )
+    """Minimal growth+division cell (the lifecycle-machinery exerciser).
+
+    Optional ``death`` config adds a DeathTrigger (default: starvation —
+    die when volume shrinks below its threshold), closing the full
+    birth/growth/death loop: freed rows recycle into the division pool.
+    """
+    c = _cfg({"growth": {}, "divide": {}, "death": None}, config)
+    processes = {
+        "growth": Growth(c["growth"]),
+        "divide_trigger": DivideTrigger(c["divide"]),
+    }
+    topology = {
+        "growth": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+    }
+    if c["death"] is not None:
+        processes["death_trigger"] = DeathTrigger(c["death"])
+        topology["death_trigger"] = {"global": ("global",)}
+    return Compartment(processes=processes, topology=topology)
 
 
 @register_composite
@@ -409,6 +428,7 @@ def _field_species(
         compartment,
         capacity=int(capacity),
         division_trigger=("global", "divide") if division else None,
+        death_trigger=_death_trigger_of(compartment),
     )
     return SpatialColony(
         colony,

@@ -221,10 +221,11 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
             0.0,
         )
 
-        # 4. per-shard division per species, then clip onto the domain
+        # 4. per-shard lifecycle per species (death, then division), then
+        # clip onto the domain
         h, w_um = lattice.size
         for name, sp in multi.species.items():
-            cs = stepped[name]
+            cs = sp.colony.step_death(stepped[name])
             if sp.colony.division_trigger is not None:
                 key, sub = jax.random.split(cs.key)
                 sub = jax.random.fold_in(sub, a_idx)

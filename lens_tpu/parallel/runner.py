@@ -167,7 +167,10 @@ class ShardedSpatialColony(ShardedRunnerBase):
             )
         cs = cs._replace(agents=agents)
 
-        # 4. per-shard division, then clip locations onto the domain
+        # 4. per-shard lifecycle (death, then division), then clip
+        # locations onto the domain. Death is elementwise — shard-safe
+        # with no collectives; freed rows rejoin THIS shard's pool.
+        cs = colony.step_death(cs)
         if colony.division_trigger is not None:
             key, sub = jax.random.split(cs.key)
             sub = jax.random.fold_in(sub, a_idx)

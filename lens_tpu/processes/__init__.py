@@ -22,7 +22,11 @@ def register(cls: Type[Process]) -> Type[Process]:
 # Import for registration side effects.
 from lens_tpu.processes.glucose_pts import GlucosePTS  # noqa: E402
 from lens_tpu.processes.toggle_switch import ToggleSwitch  # noqa: E402
-from lens_tpu.processes.growth import DivideTrigger, Growth  # noqa: E402
+from lens_tpu.processes.growth import (  # noqa: E402
+    DeathTrigger,
+    DivideTrigger,
+    Growth,
+)
 from lens_tpu.processes.mm_transport import (  # noqa: E402
     BrownianMotility,
     MichaelisMentenTransport,
@@ -60,6 +64,7 @@ __all__ = [
     "GlucosePTS",
     "ToggleSwitch",
     "Growth",
+    "DeathTrigger",
     "DivideTrigger",
     "MichaelisMentenTransport",
     "BrownianMotility",

@@ -66,3 +66,53 @@ class DivideTrigger(Deriver):
                 "divide": (v >= self.config["threshold"]).astype(jnp.float32)
             }
         }
+
+
+@register
+class DeathTrigger(Deriver):
+    """Sets ``die = 1`` when a watched global variable crosses a
+    threshold (the colony's ``death_trigger`` watches the flag).
+
+    Default shape is starvation — die when ``volume`` shrinks below
+    ``threshold`` — but ``variable``/``when`` configure any global
+    scalar in either direction (e.g. a toxin accumulating past a limit
+    with ``when="above"``). The watched variable's ``_default`` is
+    configurable so shared-path declarations agree with whichever
+    process owns it (core.engine requires identical declarations).
+    """
+
+    name = "death_trigger"
+    defaults = {
+        "variable": "volume",
+        "threshold": 0.5,
+        "when": "below",            # "below" | "above"
+        "variable_default": 1.0,    # must match the owning process
+        "variable_divider": "split",
+    }
+
+    def ports_schema(self):
+        if self.config["when"] not in ("below", "above"):
+            raise ValueError(
+                f'death_trigger "when" must be "below" or "above", got '
+                f'{self.config["when"]!r}'
+            )
+        return {
+            "global": {
+                self.config["variable"]: {
+                    "_default": float(self.config["variable_default"]),
+                    "_divider": str(self.config["variable_divider"]),
+                },
+                "die": {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        v = states["global"][self.config["variable"]]
+        thr = self.config["threshold"]
+        fire = v < thr if self.config["when"] == "below" else v > thr
+        return {"global": {"die": fire.astype(jnp.float32)}}

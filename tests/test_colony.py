@@ -266,3 +266,130 @@ def test_division_backlog_per_shard_visibility():
     assert int(np.asarray(traj2["alive"])[-1].sum()) >= int(
         np.asarray(traj["alive"])[-1].sum()
     )
+
+
+class TestDeath:
+    """The other half of the lifecycle: the death trigger clears alive
+    bits, frozen rows stop evolving, and freed rows RECYCLE into the
+    division pool."""
+
+    def _death_colony(self, capacity=8, n_alive=4, rate=-0.02, **death):
+        from lens_tpu.models.composites import grow_divide
+
+        comp = grow_divide(
+            {"growth": {"rate": rate}, "death": dict(death)}
+        )
+        return Colony(
+            comp,
+            capacity=capacity,
+            division_trigger=("global", "divide"),
+            death_trigger=("global", "die"),
+        )
+
+    def test_starvation_kills_and_freezes(self):
+        colony = self._death_colony()  # shrinking cells, die below 0.5
+        cs = colony.initial_state(4, key=jax.random.PRNGKey(0))
+        cs, traj = jax.jit(lambda s: colony.run(s, 60.0, 1.0))(cs)
+        alive_t = np.asarray(traj["alive"]).sum(axis=1)
+        assert alive_t[0] == 4 and alive_t[-1] == 0  # everyone starved
+        assert (np.diff(alive_t) <= 0).all()         # death is monotone here
+        # dead rows froze at (just below) the death threshold — volume
+        # keeps decaying only while alive
+        vols = np.asarray(traj["global"]["volume"])  # [T, N]
+        death_step = (np.asarray(traj["alive"])[:, 0]).argmin()
+        np.testing.assert_array_equal(
+            vols[death_step:, 0], vols[death_step, 0]
+        )
+
+    def test_freed_rows_recycle_into_division(self):
+        """At FULL capacity, a death frees the row a waiting parent then
+        claims: births continue only because deaths recycle capacity."""
+        from lens_tpu.models.composites import grow_divide
+
+        comp = grow_divide(
+            {"growth": {"rate": 0.05},
+             # die of old age: volume > 1.9 (just below the 2.0 division
+             # threshold would block division; above it culls POST-division
+             # parents' siblings) — use a bloat death at 2.5 so divisions
+             # at 2.0 still happen and big laggards die
+             "death": {"when": "above", "threshold": 2.5}}
+        )
+        colony = Colony(
+            comp, capacity=4,
+            division_trigger=("global", "divide"),
+            death_trigger=("global", "die"),
+        )
+        # full colony with STAGGERED volumes: divisions are suppressed
+        # (no free rows) until the bloat death culls the biggest cell,
+        # whose row the next-biggest (already past the division
+        # threshold) then claims — identical volumes would synchronize
+        # death and kill the whole colony in one step instead
+        cs = colony.initial_state(
+            4,
+            overrides={"global": {"volume": jnp.asarray([1.0, 1.2, 1.4, 1.6])}},
+            key=jax.random.PRNGKey(0),
+        )
+        cs, traj = jax.jit(lambda s: colony.run(s, 40.0, 1.0))(cs)
+        alive_t = np.asarray(traj["alive"]).sum(axis=1)
+        vols = np.asarray(traj["global"]["volume"])
+        live_vols = np.where(np.asarray(traj["alive"]), vols, np.nan)
+        # deaths happened (population dipped) AND divisions reused the
+        # freed rows (fresh volume-1.0 cells appeared after the dip)
+        assert alive_t.min() < 4
+        t_dip = alive_t.argmin()
+        assert np.nanmin(live_vols[t_dip:]) <= 1.1
+        # no live cell ever exceeds the death threshold by more than one
+        # step's growth
+        assert np.nanmax(live_vols) < 2.5 * np.exp(0.05)
+
+    def test_death_beats_division_same_step(self):
+        """A row with both triggers set dies (and does not divide)."""
+        from lens_tpu.core.process import Deriver
+
+        class AlwaysBoth(Deriver):
+            name = "always_both_trigger"
+            defaults = {}
+
+            def ports_schema(self):
+                return {
+                    "global": {
+                        "divide": {"_default": 1.0, "_updater": "set",
+                                   "_divider": "zero"},
+                        "die": {"_default": 1.0, "_updater": "set",
+                                "_divider": "zero"},
+                    },
+                }
+
+            def next_update(self, timestep, states):
+                return {"global": {"divide": jnp.float32(1.0),
+                                   "die": jnp.float32(1.0)}}
+
+        comp = Compartment(
+            processes={"both": AlwaysBoth()},
+            topology={"both": {"global": ("global",)}},
+        )
+        colony = Colony(
+            comp, capacity=8,
+            division_trigger=("global", "divide"),
+            death_trigger=("global", "die"),
+        )
+        cs = colony.initial_state(4, key=jax.random.PRNGKey(0))
+        cs = colony.step(cs, 1.0)
+        assert int(np.asarray(cs.alive).sum()) == 0  # all died, none divided
+
+    def test_experiment_starvation_run(self):
+        from lens_tpu.experiment import Experiment
+
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": -0.02}, "death": {}},
+                "n_agents": 6,
+                "capacity": 16,
+                "total_time": 60.0,
+                "emit_every": 10,
+            }
+        ) as exp:
+            state = exp.run()
+            assert exp.colony.death_trigger == ("global", "die")
+        assert int(np.asarray(exp.n_alive(state))) == 0
