@@ -640,6 +640,66 @@ def plot_ensemble_fan(
     return out_path
 
 
+def lifespan_table(timeseries: Mapping) -> List[Dict[str, Any]]:
+    """Per-cell life episodes from the emitted alive mask.
+
+    With a death trigger, rows RECYCLE: one physical row can host several
+    cells over a run (die, then a daughter claims the slot), so each
+    maximal True-run of ``alive[:, row]`` is one episode. Returns one
+    record per episode: ``{row, t_born, t_died, lifespan, cell_id}`` —
+    ``t_died``/``lifespan`` are None while still alive at the last emit;
+    ``cell_id`` is None without lineage emit. Times are emit times
+    (``__time__``) when present, else emit indices — sparser emission
+    coarsens the estimates accordingly.
+    """
+    alive = np.asarray(timeseries["alive"]).astype(bool)  # [T, N]
+    t = _times(timeseries, alive.shape[0])
+    lin = timeseries.get("lineage")
+    cell_id = np.asarray(lin["cell_id"]) if lin is not None else None
+    episodes: List[Dict[str, Any]] = []
+    for row in range(alive.shape[1]):
+        col = alive[:, row]
+        # episode boundaries: prepend/append False so every run closes
+        edges = np.flatnonzero(np.diff(np.r_[False, col, False]))
+        for start, end in zip(edges[::2], edges[1::2]):
+            died = end < alive.shape[0]
+            episodes.append(
+                {
+                    "row": int(row),
+                    "t_born": float(t[start]),
+                    "t_died": float(t[end]) if died else None,
+                    "lifespan": float(t[end] - t[start]) if died else None,
+                    "cell_id": (
+                        int(cell_id[start, row])
+                        if cell_id is not None
+                        else None
+                    ),
+                }
+            )
+    return episodes
+
+
+def plot_lifespans(
+    timeseries: Mapping, out_path: str = "out/lifespans.png"
+) -> str:
+    """Histogram of completed lifespans (death time - birth time)."""
+    plt = _plt()
+    spans = [
+        e["lifespan"] for e in lifespan_table(timeseries)
+        if e["lifespan"] is not None
+    ]
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.hist(spans, bins=min(30, max(5, len(spans) // 4 + 1)))
+    ax.set_xlabel("lifespan (s)")
+    ax.set_ylabel("cells")
+    ax.set_title(f"completed lifespans (n={len(spans)})")
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    fig.savefig(out_path, dpi=110)
+    plt.close(fig)
+    return out_path
+
+
 def scan_response(
     timeseries: Mapping,
     path: Sequence[str] | None = None,
@@ -784,6 +844,10 @@ def report(
         except (KeyError, TypeError):
             return None
 
+    def _saw_death(tree) -> bool:
+        a = np.asarray(tree["alive"]).astype(bool)
+        return bool((a[:-1] & ~a[1:]).any())
+
     if single:
         written["colony_growth"] = plot_colony_growth(
             ts, out_path=os.path.join(out_dir, "colony_growth.png")
@@ -791,6 +855,10 @@ def report(
         written["timeseries"] = plot_timeseries(
             ts, out_path=os.path.join(out_dir, "timeseries.png")
         )
+        if _saw_death(ts):
+            written["lifespans"] = plot_lifespans(
+                ts, out_path=os.path.join(out_dir, "lifespans.png")
+            )
     for name, sub in species.items():
         written[f"{name}.colony_growth"] = plot_colony_growth(
             sub, out_path=os.path.join(out_dir, f"{name}_colony_growth.png")
@@ -798,6 +866,10 @@ def report(
         written[f"{name}.timeseries"] = plot_timeseries(
             sub, out_path=os.path.join(out_dir, f"{name}_timeseries.png")
         )
+        if _saw_death(sub):
+            written[f"{name}.lifespans"] = plot_lifespans(
+                sub, out_path=os.path.join(out_dir, f"{name}_lifespans.png")
+            )
 
     if "fields" in ts:
         if single:
@@ -874,6 +946,8 @@ __all__ = [
     "plot_ensemble_fan",
     "scan_response",
     "plot_scan_response",
+    "lifespan_table",
+    "plot_lifespans",
     "alive_counts",
     "masked_agent_series",
     "plot_timeseries",

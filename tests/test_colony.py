@@ -393,3 +393,57 @@ class TestDeath:
             state = exp.run()
             assert exp.colony.death_trigger == ("global", "die")
         assert int(np.asarray(exp.n_alive(state))) == 0
+
+
+class TestLifespanAnalysis:
+    def test_episode_reconstruction_with_recycled_rows(self):
+        """A recycled row (cell dies, a daughter later claims the slot)
+        yields TWO episodes; survivors have open-ended lifespans."""
+        from lens_tpu.analysis import lifespan_table
+
+        alive = np.array(
+            [
+                [1, 1, 0],
+                [1, 1, 0],
+                [0, 1, 1],   # row 0 died; row 2 born
+                [0, 1, 1],
+                [1, 1, 1],   # row 0 RECYCLED (new cell)
+            ],
+            dtype=bool,
+        )
+        ts = {"alive": alive, "__time__": np.arange(5) * 10.0}
+        eps = lifespan_table(ts)
+        by_row = {}
+        for e in eps:
+            by_row.setdefault(e["row"], []).append(e)
+        assert len(by_row[0]) == 2                      # two episodes
+        first, second = by_row[0]
+        assert first["t_born"] == 0.0 and first["t_died"] == 20.0
+        assert first["lifespan"] == 20.0
+        assert second["t_born"] == 40.0 and second["lifespan"] is None
+        assert by_row[1][0]["lifespan"] is None          # never died
+        assert by_row[2][0]["t_born"] == 20.0
+
+    def test_report_adds_lifespans_on_death(self, tmp_path):
+        import os
+
+        from lens_tpu.analysis import report
+        from lens_tpu.emit import LogEmitter
+        from lens_tpu.experiment import Experiment
+
+        log = str(tmp_path / "death.lens")
+        with Experiment(
+            {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": -0.02}, "death": {}},
+                "n_agents": 6,
+                "capacity": 16,
+                "total_time": 60.0,
+                "emit_every": 5,
+                "emitter": {"type": "log", "path": log},
+            }
+        ) as exp:
+            exp.run()
+        written = report(log, out_dir=str(tmp_path / "plots"))
+        assert "lifespans" in written
+        assert os.path.getsize(written["lifespans"]) > 1000
