@@ -50,13 +50,29 @@ def _cfg(defaults: dict, config: Mapping | None) -> dict:
 
 
 def _death_trigger_of(compartment: Compartment):
-    """The standard death flag, iff the compartment declares it (a
-    DeathTrigger — or any process — owning ``('global', 'die')``)."""
-    return (
-        ("global", "die")
-        if ("global", "die") in compartment.updaters
-        else None
-    )
+    """The compartment's death flag, if it has one.
+
+    Resolved from the topology of its ``DeathTrigger`` process(es) — the
+    trigger's logical ``global`` port may be wired onto another store
+    (e.g. ``("cell",)`` to watch a nutrient pool), so the flag's path
+    follows the wiring, never name-matching arbitrary schema variables
+    (a gene that happens to be named ``die`` must NOT become a kill
+    switch). Custom death processes fall back to the conventional
+    ``("global", "die")`` path when they declare it.
+    """
+    hits = set()
+    for name, proc in compartment.processes.items():
+        if isinstance(proc, DeathTrigger):
+            store = compartment.topology[name]["global"]
+            hits.add(tuple(store) + ("die",))
+    if not hits and ("global", "die") in compartment.updaters:
+        hits.add(("global", "die"))
+    if len(hits) > 1:
+        raise ValueError(
+            f"compartment wires multiple death flags {sorted(hits)}; a "
+            f"colony watches exactly one death trigger"
+        )
+    return hits.pop() if hits else None
 
 
 def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:
@@ -563,6 +579,11 @@ def rfba_cross_feeding(
                 "growth": {"rate": 0.0003},
                 "divide": {},
                 "motility": {"sigma": 0.5},
+                # optional starvation: {"variable": "ace_internal",
+                # "threshold": x, "when": "below", ...} — the trigger's
+                # global port wires onto ("cell",) so it watches the food
+                # pool; scavenger deaths then track the overflow supply
+                "death": None,
             },
         },
         config,
@@ -578,24 +599,47 @@ def rfba_cross_feeding(
     )
     ecoli = Compartment(processes=ecoli_procs, topology=ecoli_topo)
     s = c["scavenger"]
-    scavenger = Compartment(
-        processes={
-            "transport": MichaelisMentenTransport(s["transport"]),
-            "growth": Growth(s["growth"]),
-            "divide_trigger": DivideTrigger(s["divide"]),
-            "motility": BrownianMotility(s["motility"]),
+    scav_procs = {
+        "transport": MichaelisMentenTransport(s["transport"]),
+        "growth": Growth(s["growth"]),
+        "divide_trigger": DivideTrigger(s["divide"]),
+        "motility": BrownianMotility(s["motility"]),
+    }
+    scav_topo = {
+        "transport": {
+            "external": ("boundary", "external"),
+            "internal": ("cell",),
+            "exchange": ("boundary", "exchange"),
         },
-        topology={
-            "transport": {
-                "external": ("boundary", "external"),
-                "internal": ("cell",),
-                "exchange": ("boundary", "exchange"),
-            },
-            "growth": {"global": ("global",)},
-            "divide_trigger": {"global": ("global",)},
-            "motility": {"boundary": ("boundary",)},
-        },
-    )
+        "growth": {"global": ("global",)},
+        "divide_trigger": {"global": ("global",)},
+        "motility": {"boundary": ("boundary",)},
+    }
+    if s["death"] is not None:
+        death_cfg = _cfg(
+            {"variable": "ace_internal", "threshold": 0.01,
+             "when": "below", "variable_default": 0.0},
+            s["death"],
+        )
+        # The trigger's logical "global" port is wired onto the cell
+        # store, where the transport's food pool lives; the die flag
+        # lands there too (("cell", "die")) and _death_trigger_of
+        # resolves it from this wiring. Guard against a variable no
+        # other process writes — the trigger would watch its own frozen
+        # default and silently never fire.
+        probe = Compartment(
+            processes=dict(scav_procs), topology=dict(scav_topo)
+        )
+        watched = ("cell", str(death_cfg["variable"]))
+        if watched not in probe.updaters:
+            raise ValueError(
+                f"scavenger death watches {watched}, which no scavenger "
+                f"process writes — pick a cell-store variable (e.g. "
+                f"'ace_internal')"
+            )
+        scav_procs["death_trigger"] = DeathTrigger(death_cfg)
+        scav_topo["death_trigger"] = {"global": ("cell",)}
+    scavenger = Compartment(processes=scav_procs, topology=scav_topo)
     lattice = _make_lattice(
         c, list(metabolism.external), c["diffusion"], c["initial"]
     )

@@ -343,3 +343,55 @@ class TestRfbaCrossFeeding:
             ts = exp.emitter.timeseries()
         assert int(np.asarray(exp.n_alive(state))) == 8
         assert np.isfinite(np.asarray(ts["fields"])).all()
+
+    def test_scavenger_starvation_tracks_food_supply(self):
+        """Death wired to the food pool (('cell','die') via topology):
+        scavengers with a small boot yolk survive while the rFBA species
+        overflows acetate, and starve to extinction without it."""
+        import jax
+
+        from lens_tpu.models.composites import rfba_cross_feeding
+
+        def build():
+            return rfba_cross_feeding(
+                {
+                    "capacity": {"ecoli": 8, "scavenger": 8},
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                    "division": False,
+                    "ecoli": {"motility": {"sigma": 0.0}},
+                    "scavenger": {
+                        "motility": {"sigma": 0.0},
+                        "death": {},
+                    },
+                }
+            )
+
+        multi, _ = build()
+        assert multi.species["scavenger"].colony.death_trigger == (
+            "cell", "die",
+        )
+        yolk = {"scavenger": {"cell": {"ace_internal": 0.05}}}
+
+        # fed: overflow keeps the pool above the starvation threshold
+        ms = multi.initial_state(
+            {"ecoli": 8, "scavenger": 8}, jax.random.PRNGKey(0),
+            overrides=yolk,
+        )
+        ms, _ = jax.jit(lambda s: multi.run(s, 80.0, 1.0, emit_every=80))(ms)
+        fed_alive = int(np.asarray(ms.species["scavenger"].alive).sum())
+        assert fed_alive == 8
+
+        # starved: no E. coli, no acetate ever — the yolk drains and the
+        # whole scavenger population dies
+        multi2, _ = build()
+        ms2 = multi2.initial_state(
+            {"ecoli": 0, "scavenger": 8}, jax.random.PRNGKey(0),
+            overrides=yolk,
+        )
+        ms2, traj2 = jax.jit(
+            lambda s: multi2.run(s, 80.0, 1.0, emit_every=20)
+        )(ms2)
+        starved = np.asarray(traj2["scavenger"]["alive"]).sum(axis=1)
+        assert starved[-1] == 0
+        assert (np.diff(starved) <= 0).all()
