@@ -75,6 +75,32 @@ def _death_trigger_of(compartment: Compartment):
     return hits.pop() if hits else None
 
 
+def _add_cell_store_death(
+    processes: Dict, topology: Dict, variable: str, death_over: Mapping
+) -> None:
+    """Wire an optional starvation DeathTrigger watching a CELL-store
+    variable (the trigger's logical ``global`` port maps onto
+    ``("cell",)``, so the die flag lands at ``("cell", "die")`` and
+    ``_death_trigger_of`` resolves it from this wiring). Mutates
+    ``processes``/``topology`` in place. Rejects a watched variable no
+    existing process writes — the trigger would watch its own frozen
+    default and silently never fire."""
+    death_cfg = _cfg(
+        {"variable": variable, "threshold": 0.01, "when": "below",
+         "variable_default": 0.0},
+        death_over,
+    )
+    probe = Compartment(processes=dict(processes), topology=dict(topology))
+    watched = ("cell", str(death_cfg["variable"]))
+    if watched not in probe.updaters:
+        raise ValueError(
+            f"death watches {watched}, which no process writes — pick a "
+            f"cell-store variable (e.g. {variable!r})"
+        )
+    processes["death_trigger"] = DeathTrigger(death_cfg)
+    topology["death_trigger"] = {"global": ("cell",)}
+
+
 def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:
     """The standard lattice from a composite config: ``size`` defaults to
     10 um bins; ``impl`` selects the diffusion scheme ("auto" =
@@ -616,29 +642,7 @@ def rfba_cross_feeding(
         "motility": {"boundary": ("boundary",)},
     }
     if s["death"] is not None:
-        death_cfg = _cfg(
-            {"variable": "ace_internal", "threshold": 0.01,
-             "when": "below", "variable_default": 0.0},
-            s["death"],
-        )
-        # The trigger's logical "global" port is wired onto the cell
-        # store, where the transport's food pool lives; the die flag
-        # lands there too (("cell", "die")) and _death_trigger_of
-        # resolves it from this wiring. Guard against a variable no
-        # other process writes — the trigger would watch its own frozen
-        # default and silently never fire.
-        probe = Compartment(
-            processes=dict(scav_procs), topology=dict(scav_topo)
-        )
-        watched = ("cell", str(death_cfg["variable"]))
-        if watched not in probe.updaters:
-            raise ValueError(
-                f"scavenger death watches {watched}, which no scavenger "
-                f"process writes — pick a cell-store variable (e.g. "
-                f"'ace_internal')"
-            )
-        scav_procs["death_trigger"] = DeathTrigger(death_cfg)
-        scav_topo["death_trigger"] = {"global": ("cell",)}
+        _add_cell_store_death(scav_procs, scav_topo, "ace_internal", s["death"])
     scavenger = Compartment(processes=scav_procs, topology=scav_topo)
     lattice = _make_lattice(
         c, list(metabolism.external), c["diffusion"], c["initial"]
@@ -796,6 +800,10 @@ def ecoli_lattice(
             "divide": {},
             "motility": {"sigma": 0.5},
             "division": True,
+            # optional starvation: die when the internal glucose pool
+            # drains (same pattern as rfba_cross_feeding's scavenger —
+            # the trigger's global port wires onto the cell store)
+            "death": None,
         },
         config,
     )
@@ -815,6 +823,10 @@ def ecoli_lattice(
         "divide_trigger": {"global": ("global",)},
         "motility": {"boundary": ("boundary",)},
     }
+    if c["death"] is not None:
+        _add_cell_store_death(
+            processes, topology, "glucose_internal", c["death"]
+        )
     compartment = Compartment(processes=processes, topology=topology)
     return _spatial_colony(
         compartment,

@@ -633,3 +633,43 @@ class TestShardedEnsemble:
         ens = self._toggle_ensemble(r=6)
         with pytest.raises(ValueError, match="does not divide"):
             ShardedEnsemble(ens)
+
+
+def test_sharded_death_matches_unsharded():
+    """Death is shard-local (one mask update per block): a starving
+    sharded colony tracks the unsharded trajectory exactly, and freed
+    rows stay within their shard's division pool."""
+    def build():
+        return ecoli_lattice(
+            {
+                "capacity": 32,
+                "shape": (16, 16),
+                "size": (16.0, 16.0),
+                "division": False,
+                "motility": {"sigma": 0.0},
+                # almost no glucose: pools drain, everyone starves
+                "initial_glucose": 0.001,
+                "death": {"threshold": 0.02},
+            }
+        )[0]
+
+    spatial = build()
+    key = jax.random.PRNGKey(0)
+    yolk = {"cell": {"glucose_internal": jnp.full(32, 0.05)}}
+    ss0 = spatial.initial_state(32, key, overrides=yolk)
+    ref, ref_traj = spatial.run(ss0, 30.0, 1.0, emit_every=10)
+    ref_alive = np.asarray(ref_traj["alive"]).sum(axis=1)
+    assert ref_alive[-1] == 0 and ref_alive[0] > 0  # they did starve
+
+    mesh = make_mesh(n_agents=4, n_space=2)
+    sharded = ShardedSpatialColony(build(), mesh)
+    ss0_sharded = jax.device_put(
+        ss0, mesh_shardings(mesh, spatial_pspecs(ss0))
+    )
+    out, traj = sharded.run(ss0_sharded, 30.0, 1.0, emit_every=10)
+    np.testing.assert_array_equal(
+        np.asarray(traj["alive"]), np.asarray(ref_traj["alive"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.fields), np.asarray(ref.fields), rtol=1e-5, atol=1e-6
+    )
