@@ -292,3 +292,25 @@ class TestCrossFeedingOnDevice:
         assert float(ms.fields[ace].sum()) > 0.0
         pool = ms.species["scavenger"].agents["cell"]["ace_internal"]
         assert float(pool.max()) > 0.0
+
+
+class TestDeathOnDevice:
+    def test_starving_window_dies_on_chip(self, tpu_device):
+        """A starving flagship window on the chip: the death mask path
+        compiles and the population monotonically collapses (built
+        relay-down; CPU-validated in tests/test_parallel.py)."""
+        from lens_tpu.models.composites import ecoli_lattice
+
+        spatial, _ = ecoli_lattice(
+            {"capacity": 256, "shape": (32, 32), "size": (32.0, 32.0),
+             "division": False, "initial_glucose": 0.001,
+             "death": {"threshold": 0.02}}
+        )
+        yolk = {"cell": {"glucose_internal": jnp.full(256, 0.05)}}
+        ss = spatial.initial_state(256, jax.random.PRNGKey(0), overrides=yolk)
+        ss, traj = jax.block_until_ready(
+            jax.jit(lambda s: spatial.run(s, 30.0, 1.0, emit_every=10))(ss)
+        )
+        alive = np.asarray(traj["alive"]).sum(axis=1)
+        assert alive[-1] < alive[0]
+        assert (np.diff(alive) <= 0).all()
