@@ -26,6 +26,7 @@ from lens_tpu.processes import (
     FlagellarMotor,
     GlucosePTS,
     Growth,
+    Lysis,
     Metabolism,
     MichaelisMentenTransport,
     MWCChemoreceptor,
@@ -87,9 +88,10 @@ def _add_cell_store_death(
     default and silently never fire."""
     death_cfg = _cfg(
         {"variable": variable, "threshold": 0.01, "when": "below",
-         "variable_default": 0.0},
+         "variable_default": 0.0, "lysis": None},
         death_over,
     )
+    lysis = death_cfg.pop("lysis")
     probe = Compartment(processes=dict(processes), topology=dict(topology))
     watched = ("cell", str(death_cfg["variable"]))
     if watched not in probe.updaters:
@@ -99,6 +101,37 @@ def _add_cell_store_death(
         )
     processes["death_trigger"] = DeathTrigger(death_cfg)
     topology["death_trigger"] = {"global": ("cell",)}
+    if lysis is not None:
+        # {"lysis": fraction}: the dying cell's pool returns to its
+        # lattice bin through the ordinary exchange path (field credit
+        # BEFORE the alive bit clears). Inserted after death_trigger —
+        # derivers run in insertion order, so the flag read is this
+        # step's verdict.
+        mol = str(death_cfg["variable"])
+        if mol.endswith("_internal"):
+            mol = mol[: -len("_internal")]
+        # mirror the watched-variable guard: the release must land in an
+        # exchange some transport already owns (and the lattice scatters),
+        # else the pool drains into a dead-end variable and the mass the
+        # config asked to conserve silently vanishes
+        release_to = ("boundary", "exchange", f"{mol}_exchange")
+        if release_to not in probe.updaters:
+            raise ValueError(
+                f"lysis would release to {release_to}, which no transport "
+                f"writes — death['variable'] must be a '<molecule>_internal' "
+                f"pool whose molecule is lattice-wired"
+            )
+        processes["lysis"] = Lysis(
+            {
+                "pool": str(death_cfg["variable"]),
+                "exchange": f"{mol}_exchange",
+                "fraction": float(lysis),
+            }
+        )
+        topology["lysis"] = {
+            "internal": ("cell",),
+            "exchange": ("boundary", "exchange"),
+        }
 
 
 def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:

@@ -26,6 +26,7 @@ from lens_tpu.processes.growth import (  # noqa: E402
     DeathTrigger,
     DivideTrigger,
     Growth,
+    Lysis,
 )
 from lens_tpu.processes.mm_transport import (  # noqa: E402
     BrownianMotility,
@@ -64,6 +65,7 @@ __all__ = [
     "GlucosePTS",
     "ToggleSwitch",
     "Growth",
+    "Lysis",
     "DeathTrigger",
     "DivideTrigger",
     "MichaelisMentenTransport",

@@ -116,3 +116,77 @@ class DeathTrigger(Deriver):
         thr = self.config["threshold"]
         fire = v < thr if self.config["when"] == "below" else v > thr
         return {"global": {"die": fire.astype(jnp.float32)}}
+
+
+@register
+class Lysis(Deriver):
+    """On death, release a cell's internal pool back to its lattice bin.
+
+    Reads the die flag plus an internal nutrient pool; a dying cell
+    loses its whole pool, and ``fraction`` of it enters the exchange
+    port as secretion — the
+    spatial layer then credits the cell's bin exactly as for any other
+    secretion (unsharded, sharded, and multi-species alike), BEFORE the
+    colony clears the alive bit, so the release lands in the field the
+    same step the cell dies. What a dying cell hoarded returns to the
+    commons: with ``fraction=1`` and matching units, death conserves
+    total mass instead of deleting the pool with the frozen row.
+
+    Order matters: insert AFTER the DeathTrigger process (derivers run
+    in insertion order), so the flag read here is this step's verdict.
+    ``fraction`` also converts units when the pool is not in field
+    concentration units (e.g. MichaelisMentenTransport's ``yield_``).
+    """
+
+    name = "lysis"
+    defaults = {
+        "pool": "glucose_internal",
+        "exchange": "glucose_exchange",
+        "flag": "die",
+        "fraction": 1.0,
+    }
+
+    def ports_schema(self):
+        # shared-path declarations must MATCH the owners': the pool and
+        # flag mirror MichaelisMentenTransport / DeathTrigger, the
+        # exchange mirrors every transport's exchange declaration
+        return {
+            "internal": {
+                self.config["pool"]: {
+                    "_default": 0.0,
+                    "_updater": "nonnegative_accumulate",
+                    "_divider": "split",
+                },
+                self.config["flag"]: {
+                    "_default": 0.0,
+                    "_updater": "set",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+            "exchange": {
+                self.config["exchange"]: {
+                    "_default": 0.0,
+                    "_updater": "accumulate",
+                    "_divider": "zero",
+                    "_emit": False,
+                },
+            },
+        }
+
+    def next_update(self, timestep, states):
+        pool = states["internal"][self.config["pool"]]
+        die = states["internal"][self.config["flag"]]
+        # the dying cell loses its WHOLE pool; `fraction` scales what
+        # reaches the field (unit conversion / recovery efficiency)
+        dying = die > 0.0
+        return {
+            "internal": {
+                self.config["pool"]: jnp.where(dying, -pool, 0.0)
+            },
+            "exchange": {
+                self.config["exchange"]: jnp.where(
+                    dying, pool * self.config["fraction"], 0.0
+                )
+            },
+        }

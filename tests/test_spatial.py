@@ -240,3 +240,57 @@ def test_exact_conservation_with_division_and_motility():
         jnp.sum(ss2.colony.agents["cell"]["glucose_internal"] * ss2.colony.alive)
     )
     np.testing.assert_allclose(total0, total1 + internal, rtol=2e-5)
+
+
+class TestLysis:
+    """Death with lysis conserves mass: a dying cell's pool returns to
+    its lattice bin through the ordinary exchange path."""
+
+    def _build(self, lysis):
+        from lens_tpu.models import ecoli_lattice
+
+        death = {"when": "above", "threshold": 0.5}
+        if lysis is not None:
+            death["lysis"] = lysis
+        spatial, _ = ecoli_lattice(
+            {
+                "capacity": 16,
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": False,
+                "motility": {"sigma": 0.0},
+                # yield_=1, k_consume=0: pool units == field mM, nothing
+                # drains — cells eat until the bloat death fires
+                "transport": {"yield_": 1.0, "k_consume": 0.0},
+                "initial_glucose": 2.0,
+                "death": death,
+            }
+        )
+        return spatial
+
+    def _run(self, spatial):
+        ss = spatial.initial_state(16, jax.random.PRNGKey(0))
+        ss, traj = jax.jit(lambda s: spatial.run(s, 40.0, 1.0))(ss)
+        fields_t = np.asarray(traj["fields"]).sum(axis=(1, 2, 3))
+        pools = np.asarray(traj["cell"]["glucose_internal"])
+        alive = np.asarray(traj["alive"])
+        live_pool_t = (pools * alive).sum(axis=1)
+        return ss, fields_t, live_pool_t, alive
+
+    def test_lysis_conserves_total_mass(self):
+        spatial = self._build(lysis=1.0)
+        ss, fields_t, live_pool_t, alive = self._run(spatial)
+        assert alive[-1].sum() == 0          # everyone bloated and died
+        total0 = fields_t[0] + live_pool_t[0]
+        np.testing.assert_allclose(
+            fields_t + live_pool_t, total0, rtol=1e-5
+        )
+        # after the last death everything is back in the field
+        np.testing.assert_allclose(fields_t[-1], total0, rtol=1e-5)
+
+    def test_without_lysis_the_pool_is_lost(self):
+        spatial = self._build(lysis=None)
+        ss, fields_t, live_pool_t, alive = self._run(spatial)
+        assert alive[-1].sum() == 0
+        # the hoarded pools died with their cells: the field ends LIGHTER
+        assert fields_t[-1] < fields_t[0] - 0.4
