@@ -207,7 +207,8 @@ def _validate_run_args(args: argparse.Namespace) -> None:
                 "scan axis"
             )
         try:
-            json.loads(args.replicate_overrides)
+            # parse once; _experiment_config consumes the dict
+            args.replicate_overrides = json.loads(args.replicate_overrides)
         except json.JSONDecodeError as e:
             raise SystemExit(f"--replicate-overrides is not valid JSON: {e}")
     if args.replicates is not None:
@@ -247,11 +248,8 @@ def _experiment_config(args: argparse.Namespace) -> dict:
         "checkpoint_every": args.checkpoint_every,
         "timeline": args.timeline,
         "replicates": args.replicates,
-        "replicate_overrides": (
-            json.loads(args.replicate_overrides)
-            if args.replicate_overrides
-            else {}
-        ),
+        # _validate_run_args already parsed the JSON string to a dict
+        "replicate_overrides": args.replicate_overrides or {},
     }
 
 

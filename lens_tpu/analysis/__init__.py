@@ -644,13 +644,17 @@ def lifespan_table(timeseries: Mapping) -> List[Dict[str, Any]]:
     """Per-cell life episodes from the emitted alive mask.
 
     With a death trigger, rows RECYCLE: one physical row can host several
-    cells over a run (die, then a daughter claims the slot), so each
-    maximal True-run of ``alive[:, row]`` is one episode. Returns one
-    record per episode: ``{row, t_born, t_died, lifespan, cell_id}`` —
-    ``t_died``/``lifespan`` are None while still alive at the last emit;
-    ``cell_id`` is None without lineage emit. Times are emit times
-    (``__time__``) when present, else emit indices — sparser emission
-    coarsens the estimates accordingly.
+    cells over a run (die, then a daughter claims the slot — or divide,
+    where daughter A replaces the parent in place with a fresh cell_id
+    and NO alive gap). Episodes are therefore maximal alive-runs of
+    ``alive[:, row]``, further split at every lineage-id change when the
+    lineage emit is present. Returns one record per episode: ``{row,
+    t_born, t_died, lifespan, cell_id, divided}`` — a ``divided``
+    occupant left by division (no death, no lifespan); ``t_died`` /
+    ``lifespan`` are None while still alive at the last emit; ``cell_id``
+    is None without lineage emit. Times are emit times (``__time__``)
+    when present, else emit indices — sparser emission coarsens the
+    estimates accordingly.
     """
     alive = np.asarray(timeseries["alive"]).astype(bool)  # [T, N]
     t = _times(timeseries, alive.shape[0])
@@ -659,23 +663,40 @@ def lifespan_table(timeseries: Mapping) -> List[Dict[str, Any]]:
     episodes: List[Dict[str, Any]] = []
     for row in range(alive.shape[1]):
         col = alive[:, row]
-        # episode boundaries: prepend/append False so every run closes
+        # alive-run boundaries: prepend/append False so every run closes
         edges = np.flatnonzero(np.diff(np.r_[False, col, False]))
         for start, end in zip(edges[::2], edges[1::2]):
-            died = end < alive.shape[0]
-            episodes.append(
-                {
-                    "row": int(row),
-                    "t_born": float(t[start]),
-                    "t_died": float(t[end]) if died else None,
-                    "lifespan": float(t[end] - t[start]) if died else None,
-                    "cell_id": (
-                        int(cell_id[start, row])
-                        if cell_id is not None
-                        else None
-                    ),
-                }
-            )
+            # Division replaces a row's occupant WITHOUT an alive gap
+            # (daughter A overwrites the parent's row, minting a fresh
+            # cell_id), so with lineage present an alive-run splits at
+            # every id change: the outgoing occupant's episode ends
+            # there (divided, not died — no lifespan), the incomer's
+            # begins.
+            if cell_id is not None:
+                ids = cell_id[start:end, row]
+                cuts = [0, *np.flatnonzero(ids[1:] != ids[:-1]) + 1, end - start]
+            else:
+                cuts = [0, end - start]
+            for a, b in zip(cuts[:-1], cuts[1:]):
+                s, e = start + a, start + b
+                # the run's LAST occupant died iff the run closed before
+                # the record ended; earlier occupants left by division
+                died = e == end and end < alive.shape[0]
+                divided = e < end
+                episodes.append(
+                    {
+                        "row": int(row),
+                        "t_born": float(t[s]),
+                        "t_died": float(t[e]) if died else None,
+                        "lifespan": float(t[e] - t[s]) if died else None,
+                        "cell_id": (
+                            int(cell_id[s, row])
+                            if cell_id is not None
+                            else None
+                        ),
+                        "divided": bool(divided),
+                    }
+                )
     return episodes
 
 

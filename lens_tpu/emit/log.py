@@ -154,6 +154,16 @@ def read_experiment(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
                 # that CREATED the experiment is the provenance — a
                 # resume invocation's config (fresh experiment_id, maybe
                 # missing replicate_overrides) must not overwrite it.
+                # Later headers are KEPT under "later_headers" so a
+                # reused path (a different experiment appended to an old
+                # log — user error, but silent) remains inspectable.
+                h = record["__header__"]
+                header.setdefault("later_headers", []).append(
+                    {
+                        "experiment_id": str(h["experiment_id"]),
+                        "config": json.loads(str(h["config_json"])),
+                    }
+                )
                 continue
             h = record["__header__"]
             header = {

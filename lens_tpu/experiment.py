@@ -143,6 +143,12 @@ class Experiment:
                 raise ValueError(
                     f"mesh replicates must be an int >= 1, got {n_dev!r}"
                 )
+            # Multi-host bring-up must happen BEFORE the gates below read
+            # jax.process_count() (pre-handshake it reads 1 and the
+            # fail-at-construction guards would be dead letters).
+            from lens_tpu.parallel import initialize
+
+            initialize()
         built = composite_registry[name](self.config["config"])
         self.spatial: Optional[SpatialColony] = None
         self.multi = None  # MultiSpeciesColony composites (config 4)
@@ -295,6 +301,18 @@ class Experiment:
         (identical surfaces)."""
         return self.ensemble_runner or self.ensemble
 
+    def _rewrap_ensemble_runner(self):
+        """Rebuild the replicate-parallel runner around the CURRENT
+        ``self.ensemble`` (same mesh/axis) — required after anything that
+        replaces the wrapped sim (capacity growth, checkpoint adoption),
+        else runs would step a stale colony."""
+        from lens_tpu.parallel import ShardedEnsemble
+
+        old = self.ensemble_runner
+        self.ensemble_runner = ShardedEnsemble(
+            self.ensemble, old.mesh, old.axis
+        )
+
     # -- state construction --------------------------------------------------
 
     def initial_state(self):
@@ -404,8 +422,13 @@ class Experiment:
         else:
             cs = state.colony if isinstance(state, SpatialState) else state
         # Replicates advance in lockstep, so under an ensemble the step
-        # counter is [R] with equal entries — read any one.
-        return int(np.asarray(jax.device_get(cs.step)).reshape(-1)[0])
+        # counter is [R] with equal entries — read any one. On a
+        # multi-host replicate mesh the counter is globally sharded;
+        # device_get rejects non-addressable shards, so read a LOCAL one.
+        arr = cs.step
+        if getattr(arr, "is_fully_addressable", True) is False:
+            arr = arr.addressable_shards[0].data
+        return int(np.asarray(jax.device_get(arr)).reshape(-1)[0])
 
     # -- capacity growth -----------------------------------------------------
 
@@ -441,13 +464,7 @@ class Experiment:
             else:
                 self.colony = grown
             if self.ensemble_runner is not None:
-                from lens_tpu.parallel import ShardedEnsemble
-
-                self.ensemble_runner = ShardedEnsemble(
-                    self.ensemble,
-                    self.ensemble_runner.mesh,
-                    self.ensemble_runner.axis,
-                )
+                self._rewrap_ensemble_runner()
                 state = self.ensemble_runner.shard(state)
             return state
 
@@ -743,13 +760,7 @@ class Experiment:
                 self.spatial or self.colony, self.ensemble.n_replicates
             )
             if self.ensemble_runner is not None:
-                from lens_tpu.parallel import ShardedEnsemble
-
-                self.ensemble_runner = ShardedEnsemble(
-                    self.ensemble,
-                    self.ensemble_runner.mesh,
-                    self.ensemble_runner.axis,
-                )
+                self._rewrap_ensemble_runner()
 
     def _check_restored_replicates(self, cs) -> None:
         """A checkpoint's replicate axis must match the resume config:

@@ -76,6 +76,22 @@ def _death_trigger_of(compartment: Compartment):
     return hits.pop() if hits else None
 
 
+def _default_boot_yolk(transport_cfg: Dict, death_over: Mapping) -> Dict:
+    """Starvation death must not fire at t=0: a 'below'-threshold death
+    on a pool that boots empty would kill every initial cell before its
+    first meal. Unless overridden, boot cells with a yolk (5x the
+    threshold) — set on BOTH the transport's ``internal_default`` and
+    the trigger's ``variable_default`` so the shared declaration stays
+    consistent. Returns the adjusted death config."""
+    death = dict(death_over)
+    if death.get("when", "below") == "below":
+        thr = float(death.get("threshold", 0.01))
+        yolk = float(transport_cfg.get("internal_default", 5.0 * thr))
+        transport_cfg["internal_default"] = yolk
+        death.setdefault("variable_default", yolk)
+    return death
+
+
 def _add_cell_store_death(
     processes: Dict, topology: Dict, variable: str, death_over: Mapping
 ) -> None:
@@ -658,6 +674,8 @@ def rfba_cross_feeding(
     )
     ecoli = Compartment(processes=ecoli_procs, topology=ecoli_topo)
     s = c["scavenger"]
+    if s["death"] is not None:
+        s["death"] = _default_boot_yolk(s["transport"], s["death"])
     scav_procs = {
         "transport": MichaelisMentenTransport(s["transport"]),
         "growth": Growth(s["growth"]),
@@ -840,6 +858,8 @@ def ecoli_lattice(
         },
         config,
     )
+    if c["death"] is not None:
+        c["death"] = _default_boot_yolk(c["transport"], c["death"])
     processes = {
         "transport": MichaelisMentenTransport(c["transport"]),
         "growth": Growth(c["growth"]),

@@ -36,11 +36,15 @@ class MichaelisMentenTransport(Process):
         "yield_": 0.1,    # internal pool produced per unit taken up
         "k_consume": 0.05,  # 1/s first-order drain of the internal pool
         "molecule": "glucose",
-        # Schema default for the external concentration. Shared-path
-        # declarations must agree across processes (core.engine), so
-        # composites wiring several env-reading processes onto one
-        # boundary variable set this consistently.
+        # Schema defaults for the external concentration and the internal
+        # pool. Shared-path declarations must agree across processes
+        # (core.engine), so composites wiring several processes onto one
+        # variable set these consistently. A nonzero ``internal_default``
+        # boots every cell with a yolk — REQUIRED when a starvation
+        # DeathTrigger watches the pool, else newborn boot cells (pool 0)
+        # die at t=0 before their first meal.
         "external_default": 10.0,
+        "internal_default": 0.0,
     }
 
     def ports_schema(self):
@@ -55,7 +59,7 @@ class MichaelisMentenTransport(Process):
             },
             "internal": {
                 f"{mol}_internal": {
-                    "_default": 0.0,
+                    "_default": float(self.config["internal_default"]),
                     "_updater": "nonnegative_accumulate",
                     "_divider": "split",
                 },

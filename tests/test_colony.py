@@ -424,6 +424,28 @@ class TestLifespanAnalysis:
         assert by_row[1][0]["lifespan"] is None          # never died
         assert by_row[2][0]["t_born"] == 20.0
 
+    def test_division_splits_episodes_without_alive_gap(self):
+        """Daughter A replaces the parent IN PLACE (no alive gap, fresh
+        cell_id): the run must split at the id change — the parent's
+        episode ends by division (no lifespan), the daughter's begins."""
+        from lens_tpu.analysis import lifespan_table
+
+        alive = np.ones((5, 1), dtype=bool)
+        alive[4, 0] = False  # the daughter dies at the end
+        lineage = {"cell_id": np.array([[0], [0], [10], [10], [10]])}
+        ts = {
+            "alive": alive,
+            "lineage": lineage,
+            "__time__": np.arange(5) * 10.0,
+        }
+        eps = lifespan_table(ts)
+        assert len(eps) == 2
+        parent, daughter = eps
+        assert parent["cell_id"] == 0 and parent["divided"]
+        assert parent["t_born"] == 0.0 and parent["lifespan"] is None
+        assert daughter["cell_id"] == 10 and not daughter["divided"]
+        assert daughter["t_born"] == 20.0 and daughter["lifespan"] == 20.0
+
     def test_report_adds_lifespans_on_death(self, tmp_path):
         import os
 

@@ -443,3 +443,28 @@ class TestRfbaCrossFeeding:
         # secretion + returned yolks) — strictly more than overflow alone
         # would leave if the yolks had been deleted with the rows
         assert float(np.asarray(ms.fields[ace]).sum()) > 0.0
+
+    def test_default_death_config_does_not_kill_at_boot(self):
+        """death: {} must be survivable out of the box: boot cells get a
+        default yolk (5x threshold) so the starvation trigger cannot
+        fire before the first meal."""
+        import jax
+
+        from lens_tpu.models.composites import rfba_cross_feeding
+
+        multi, _ = rfba_cross_feeding(
+            {
+                "capacity": {"ecoli": 4, "scavenger": 4},
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": False,
+                "scavenger": {"death": {}},
+            }
+        )
+        ms = multi.initial_state(
+            {"ecoli": 4, "scavenger": 4}, jax.random.PRNGKey(0)
+        )
+        pool0 = np.asarray(ms.species["scavenger"].agents["cell"]["ace_internal"])
+        assert (pool0[:4] >= 0.05 - 1e-9).all()  # the yolk
+        ms = jax.jit(lambda s: multi.step(s, 1.0))(ms)
+        assert int(np.asarray(ms.species["scavenger"].alive).sum()) == 4
