@@ -1,24 +1,35 @@
 #!/bin/bash
-# Round-4 on-device measurement queue. Run ONLY when no other process
+# Round-5 on-device measurement queue. Run ONLY when no other process
 # holds the TPU (the axon relay serves one client at a time). Each
-# script probes the backend itself and writes its canonical BENCH_*.json;
-# this wrapper snapshots each into the *_r04.json name the judge reads.
+# script probes the backend itself and writes its canonical *.json;
+# this wrapper snapshots each into the *_r05.json name the judge reads.
+#
+# Order = evidence value per minute of chip time (VERDICT r4 item 1):
+# fresh headline configs first (incl. the 3b>=10k proof and the new 3c),
+# then MFU with real peak, then the diffusion A/B that decides `auto`,
+# then the REAL north star (VERDICT item 3 — cheap on chip: ~360M
+# agent-steps), then sweeps, then chip-scale example records, then
+# tests_tpu (run by the watcher after this script).
 set -u
 cd "$(dirname "$0")/.."
 run() {
   local script=$1 src=$2 dst=$3
-  echo "=== $script -> $dst ($(date -u +%H:%M:%S)) ==="
-  timeout 3000 python "$script" 2>&1 | tail -20
-  if [ -f "$src" ]; then cp "$src" "$dst"; else echo "!! $src missing"; fi
+  shift 3
+  echo "=== $script $* -> $dst ($(date -u +%H:%M:%S)) ==="
+  rm -f "$src"   # never snapshot a stale pre-existing record as fresh
+  timeout 4000 python "$script" "$@" 2>&1 | tail -20
+  if [ ! -f "$src" ]; then echo "!! $src missing (script failed/timed out)"
+  elif [ "$src" != "$dst" ]; then cp "$src" "$dst"; fi
 }
-run bench_all.py          BENCH_ALL.json          BENCH_ALL_r04.json
-run bench_diffusion_ab.py BENCH_DIFFUSION_AB.json BENCH_DIFFUSION_AB_r04.json
-run bench_lp_sizes.py     BENCH_LP_SIZES.json     BENCH_LP_SIZES_r04.json
-run bench_agents_sweep.py BENCH_AGENTS_SWEEP.json BENCH_AGENTS_SWEEP_r04.json
-run bench_mfu.py          BENCH_MFU.json          BENCH_MFU_r04.json
-# chip-sized example records (each writes its own committed JSON)
-for ex in ensemble param_scan cross_feeding; do
+run bench_all.py          BENCH_ALL.json          BENCH_ALL_r05.json
+run bench_mfu.py          BENCH_MFU.json          BENCH_MFU_r05.json
+run bench_diffusion_ab.py BENCH_DIFFUSION_AB.json BENCH_DIFFUSION_AB_r05.json
+run examples/north_star.py NORTH_STAR.json        NORTH_STAR.json
+run bench_lp_sizes.py     BENCH_LP_SIZES.json     BENCH_LP_SIZES_r05.json
+run bench_agents_sweep.py BENCH_AGENTS_SWEEP.json BENCH_AGENTS_SWEEP_r05.json
+# chip-scale example records (each writes its own committed JSON)
+for ex in full_core_colony ensemble param_scan cross_feeding chemotaxis; do
   echo "=== examples/$ex.py ($(date -u +%H:%M:%S)) ==="
-  timeout 3000 python "examples/$ex.py" 2>&1 | tail -8
+  timeout 4000 python "examples/$ex.py" 2>&1 | tail -8
 done
 echo "=== queue done ($(date -u +%H:%M:%S)) ==="

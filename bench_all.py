@@ -172,6 +172,44 @@ def config_3b():
     }
 
 
+def config_3c():
+    """Config 3b at FULL network scale: each agent solves the canonical
+    e_coli_core LP (72 metabolites x 95 reactions) and steps the 285-gene
+    expression table, every second, with division — the wcEcoli-direction
+    frontier (VERDICT r4 missing #3). 256 agents: the per-agent cost is
+    ~35x config 3b's, so the population is kept small enough that a CPU
+    fallback run still finishes inside the queue's per-script budget."""
+    import jax
+
+    from lens_tpu.models.composites import rfba_lattice
+
+    n = 256
+    spatial, _ = rfba_lattice(
+        {
+            "capacity": n,
+            "shape": (64, 64),
+            "metabolism": {"network": "ecoli_core_full"},
+            "expression": {"genes": "ecoli_core_full"},
+        }
+    )
+
+    def build():
+        state = spatial.initial_state(n, jax.random.PRNGKey(0))
+        window = jax.jit(
+            lambda s: spatial.run(s, WINDOW_S, 1.0, emit_every=int(WINDOW_S))[0]
+        )
+        return state, window
+
+    rate, elapsed = _measure(build, n)
+    return {
+        "config": "3c",
+        "scenario": "256 agents, FULL e_coli_core rFBA LP (72x95) + "
+        "285-gene expression per agent per step, 64x64 lattice, division",
+        "metric": "agent-steps/sec",
+        "value": round(rate, 1),
+    }
+
+
 def config_4():
     """100k-cell MIXED-SPECIES colony: two distinct process sets (ODE
     kinetics vs hybrid Gillespie+ODE) on one 256x256 two-molecule lattice
@@ -286,6 +324,7 @@ CONFIGS = {
     "2e": config_2e,
     3: config_3,
     "3b": config_3b,
+    "3c": config_3c,
     4: config_4,
     "xf": config_xf,
 }
