@@ -263,6 +263,42 @@ class Colony:
 
     # -- capacity growth -----------------------------------------------------
 
+    def expanded_meta(self, step_now: int, factor: int = 2) -> "Colony":
+        """The metadata half of :meth:`expanded`: the grown ``Colony``
+        (new capacity + lineage ``id_offset`` watermark), touching no
+        arrays. Split out so the sharded expansion path
+        (:func:`lens_tpu.parallel.mesh.expand_colony_rows_on_mesh`) can
+        grow the state ON DEVICE, per shard, without the host gather
+        that :meth:`expanded` implies for a mesh-sharded state.
+
+        ``step_now`` is the colony's current step counter — the only
+        piece of state the watermark needs (one scalar, locally
+        addressable on every host of a multi-host mesh).
+        """
+        if factor < 2:
+            raise ValueError(f"expansion factor must be >= 2, got {factor}")
+        new_cap = self.capacity * int(factor)
+        watermark = self.id_offset + (step_now + 1) * 2 * self.capacity
+        # Lineage ids are int32 and the minting stride is 2*capacity per
+        # step, so every expansion accelerates the march toward overflow.
+        # Fail LOUDLY here (host-side, cheap) instead of letting ids wrap
+        # negative and silently corrupt offline lineage reconstruction.
+        headroom_steps = (2**31 - 1 - watermark) // (2 * new_cap)
+        if headroom_steps < 10_000:
+            raise ValueError(
+                f"capacity expansion to {new_cap} rows leaves only "
+                f"{headroom_steps} steps of int32 lineage-id headroom "
+                f"(id watermark {watermark}); cap the colony size "
+                f"(auto_expand max_capacity) or disable division lineage"
+            )
+        return Colony(
+            self.compartment,
+            new_cap,
+            division_trigger=self.division_trigger,
+            id_offset=watermark - (step_now + 1) * 2 * new_cap,
+            death_trigger=self.death_trigger,
+        )
+
     def expanded(
         self, cs: ColonyState, factor: int = 2
     ) -> Tuple["Colony", ColonyState]:
@@ -285,30 +321,8 @@ class Colony:
           ``cs.step``), so ids minted at the new stride can never
           collide with any pre-expansion id.
         """
-        if factor < 2:
-            raise ValueError(f"expansion factor must be >= 2, got {factor}")
-        new_cap = self.capacity * int(factor)
-        step_now = int(cs.step)
-        watermark = self.id_offset + (step_now + 1) * 2 * self.capacity
-        # Lineage ids are int32 and the minting stride is 2*capacity per
-        # step, so every expansion accelerates the march toward overflow.
-        # Fail LOUDLY here (host-side, cheap) instead of letting ids wrap
-        # negative and silently corrupt offline lineage reconstruction.
-        headroom_steps = (2**31 - 1 - watermark) // (2 * new_cap)
-        if headroom_steps < 10_000:
-            raise ValueError(
-                f"capacity expansion to {new_cap} rows leaves only "
-                f"{headroom_steps} steps of int32 lineage-id headroom "
-                f"(id watermark {watermark}); cap the colony size "
-                f"(auto_expand max_capacity) or disable division lineage"
-            )
-        grown = Colony(
-            self.compartment,
-            new_cap,
-            division_trigger=self.division_trigger,
-            id_offset=watermark - (step_now + 1) * 2 * new_cap,
-            death_trigger=self.death_trigger,
-        )
+        grown = self.expanded_meta(int(cs.step), factor)
+        new_cap = grown.capacity
         template = grown.initial_state(0).agents
         old_cap = self.capacity
 

@@ -111,6 +111,11 @@ def _jsonable(node):
     return str(node)
 
 
+#: Module-level so the jit cache is hit across segment boundaries (a
+#: fresh lambda per call would retrace the reduction every segment).
+_count_free = jax.jit(lambda alive: (~alive).sum())
+
+
 class Experiment:
     """One configured, runnable simulation (the reference's "experiment").
 
@@ -472,7 +477,10 @@ class Experiment:
             cap = int(cs.alive.shape[0])
             if max_cap is not None and cap * factor > int(max_cap):
                 return False
-            free = int(np.sum(~np.asarray(jax.device_get(cs.alive))))
+            # jitted global reduction, not device_get(alive): the scalar
+            # result is replicated, so the read works on a multi-host
+            # mesh where the full alive mask is not locally addressable
+            free = int(_count_free(cs.alive))
             return free <= free_frac * cap
 
         if self.multi is not None:
@@ -496,37 +504,29 @@ class Experiment:
         return state
 
     def _expand_sharded(self, state, factor: int):
-        """Capacity growth under a device mesh: pull the state to host,
-        expand, deal the fresh rows evenly across the agent shards (the
-        end-appended layout would dump every free slot into the tail
-        shards), rebuild the runner at the new capacity, re-place."""
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "auto_expand on a multi-host mesh is not supported yet "
-                "(expansion gathers the full state to one host)"
-            )
+        """Capacity growth under a device mesh, entirely on device: each
+        agent shard pads its own block with its share of fresh rows
+        (:func:`~lens_tpu.parallel.mesh.expand_colony_rows_on_mesh` —
+        bitwise-equal to the old gather + interleave + re-place sequence,
+        tested, but with no host gather and no collectives), then the
+        runner is rebuilt at the new capacity. Multi-host safe: the only
+        host-side reads are two scalars (the step counter, locally
+        addressable on every host, and the alive count already read by
+        ``_maybe_expand``); the watermark/id_offset logic is global by
+        construction, so every host derives the identical grown colony."""
         from lens_tpu.parallel import ShardedSpatialColony
-        from lens_tpu.parallel.mesh import (
-            AGENTS_AXIS,
-            interleave_expanded_rows,
-            mesh_shardings,
-            spatial_pspecs,
-        )
+        from lens_tpu.parallel.mesh import expand_colony_rows_on_mesh
 
         old_cap = self.colony.capacity
-        host = jax.device_get(state)
-        self.spatial, grown = self.spatial.expanded(host, factor)
-        self.colony = self.spatial.colony
+        grown_colony = self.colony.expanded_meta(self._state_step(state), factor)
         mesh = self.runner.mesh
-        grown = grown._replace(
-            colony=interleave_expanded_rows(
-                grown.colony, old_cap, mesh.shape[AGENTS_AXIS]
-            )
+        new_cs = expand_colony_rows_on_mesh(
+            state.colony, grown_colony, old_cap, mesh
         )
+        self.spatial = self.spatial.with_colony(grown_colony)
+        self.colony = grown_colony
         self.runner = ShardedSpatialColony(self.spatial, mesh)
-        return jax.device_put(
-            grown, mesh_shardings(mesh, spatial_pspecs(grown))
-        )
+        return state._replace(colony=new_cs)
 
     def _colony_meta_path(self) -> str:
         import os

@@ -14,6 +14,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AGENTS_AXIS = "agents"
@@ -167,6 +168,81 @@ def interleave_expanded_rows(colony_state, old_cap: int, n_blocks: int):
         agents=jax.tree.map(take, colony_state.agents),
         alive=take(colony_state.alive),
     )
+
+
+def expand_colony_rows_on_mesh(colony_state, grown_colony, old_cap: int,
+                               mesh: Mesh):
+    """Capacity expansion of a mesh-sharded ColonyState, entirely on
+    device: every agent shard pads ITS OWN block with its share of fresh
+    template rows — no host gather, no collectives, no cross-shard data
+    movement. This is the multi-host-safe replacement for the
+    ``device_get -> Colony.expanded -> interleave_expanded_rows ->
+    device_put`` sequence, and is bitwise-equal to it (tested): the
+    composition of end-appended padding with the interleave permutation
+    IS the shard-local layout ``[old block b | block b's fresh rows]``.
+
+    ``grown_colony`` comes from :meth:`Colony.expanded_meta` (it carries
+    the new capacity and the lineage id watermark); fresh rows are schema
+    defaults except ``lineage.row_id``/``cell_id``, which continue the
+    global arange exactly as ``Colony.expanded`` pads them
+    (``template[old_cap:]``), so ids stay globally unique across shards.
+
+    Returns the expanded ColonyState, sharded on ``mesh`` per
+    :func:`colony_pspecs`.
+    """
+    from lens_tpu.colony.colony import Colony
+
+    n_blocks = mesh.shape[AGENTS_AXIS]
+    new_cap = grown_colony.capacity
+    if old_cap % n_blocks or new_cap % n_blocks:
+        raise ValueError(
+            f"capacities {old_cap}->{new_cap} not divisible by "
+            f"{n_blocks} agent shards"
+        )
+    b_fresh = (new_cap - old_cap) // n_blocks
+    # A shard-block-sized template: schema defaults are capacity-
+    # independent; the arange-valued lineage leaves are shifted per
+    # shard inside the block program below.
+    tmpl = Colony(
+        grown_colony.compartment,
+        b_fresh,
+        division_trigger=grown_colony.division_trigger,
+        death_trigger=grown_colony.death_trigger,
+    ).initial_state(0).agents
+
+    in_specs = colony_pspecs(colony_state)
+    out_specs = in_specs
+
+    def pad_block(cs_blk):
+        fresh = tmpl
+        if "lineage" in fresh:
+            shift = jnp.int32(old_cap) + lax.axis_index(
+                AGENTS_AXIS
+            ).astype(jnp.int32) * jnp.int32(b_fresh)
+            fresh = dict(
+                fresh,
+                lineage=dict(
+                    fresh["lineage"],
+                    row_id=fresh["lineage"]["row_id"] + shift,
+                    cell_id=fresh["lineage"]["cell_id"] + shift,
+                ),
+            )
+        agents = jax.tree.map(
+            lambda old, t: jnp.concatenate([old, t.astype(old.dtype)], axis=0),
+            cs_blk.agents,
+            fresh,
+        )
+        alive = jnp.concatenate(
+            [cs_blk.alive, jnp.zeros(b_fresh, bool)]
+        )
+        return cs_blk._replace(agents=agents, alive=alive)
+
+    grow = jax.jit(
+        jax.shard_map(
+            pad_block, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
+        )
+    )
+    return grow(colony_state)
 
 
 def validate_divisible(capacity: int, field_h: int, mesh: Mesh) -> None:

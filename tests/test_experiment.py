@@ -983,6 +983,70 @@ class TestAutoExpandWithMesh:
         ]
         assert len(np.unique(ids)) == len(ids)
 
+    def test_on_mesh_expansion_bitwise_equals_gather_path(self):
+        """The shard-local on-device expansion (multi-host-safe: no host
+        gather, no collectives) is BITWISE the state the old
+        ``device_get -> Colony.expanded -> interleave_expanded_rows ->
+        device_put`` sequence produced — end-appended padding composed
+        with the interleave permutation IS the per-shard layout
+        ``[old block | block's fresh rows]``."""
+        from lens_tpu.models.composites import ecoli_lattice
+        from lens_tpu.parallel import ShardedSpatialColony
+        from lens_tpu.parallel.mesh import (
+            AGENTS_AXIS,
+            expand_colony_rows_on_mesh,
+            interleave_expanded_rows,
+            make_mesh,
+        )
+
+        spatial, _ = ecoli_lattice(
+            {
+                "capacity": 32,
+                "shape": (8, 8),
+                "size": (8.0, 8.0),
+                "division": True,
+                "growth": {"rate": 0.05},
+                "motility": {"sigma": 0.0},
+            }
+        )
+        mesh = make_mesh(4, 1)
+        runner = ShardedSpatialColony(spatial, mesh)
+        state = runner.initial_state(8, jax.random.PRNGKey(3))
+        state = runner.run(state, 10.0, 1.0, emit_every=10)[0]
+
+        old_cap = spatial.colony.capacity
+        n_blocks = mesh.shape[AGENTS_AXIS]
+        # reference: the old host-side sequence
+        host = jax.device_get(state)
+        sp_ref, grown_ref = spatial.expanded(host, 2)
+        ref = interleave_expanded_rows(grown_ref.colony, old_cap, n_blocks)
+        # the on-device shard-local path
+        step_now = int(np.asarray(jax.device_get(state.colony.step)))
+        grown_colony = spatial.colony.expanded_meta(step_now, 2)
+        new = expand_colony_rows_on_mesh(
+            state.colony, grown_colony, old_cap, mesh
+        )
+        assert grown_colony.capacity == sp_ref.colony.capacity
+        assert grown_colony.id_offset == sp_ref.colony.id_offset
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            ref.agents,
+            new.agents,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.alive), np.asarray(new.alive)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.key), np.asarray(new.key)
+        )
+        assert int(np.asarray(new.step)) == step_now
+        # the new path keeps the mesh sharding without a re-place
+        assert new.agents["lineage"]["cell_id"].sharding.is_equivalent_to(
+            jax.NamedSharding(mesh, jax.P(AGENTS_AXIS)), ndim=1
+        )
+
 
 class TestCLIAutoExpand:
     def test_run_command_with_auto_expand(self, capsys):
