@@ -77,6 +77,19 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # evenly across agent shards); multi-host meshes not yet.
     # {"free_frac": 0.2, "factor": 2, "max_capacity": None}
     "auto_expand": None,
+    # Segment-boundary division-pool rebalance (sharded runs only):
+    # division pools are shard-local, so an inherited-fast lineage can
+    # saturate its shard's pool while other shards hold free rows —
+    # measured 52% population deficit vs unsharded in the adversarial
+    # regime (tests/test_parallel.py::TestHeterogeneousDivergence). When
+    # True (default), each segment boundary checks two global scalars
+    # (division backlog, free rows); iff BOTH are nonzero the rows are
+    # re-dealt round-robin by alive-rank (parallel.mesh.
+    # rebalance_colony_rows) so every shard regains an equal share of
+    # free rows. A no-op in balanced runs (the gate never fires) and on
+    # unsharded/ensemble/multi-species paths. Needs checkpoint_every
+    # (segments) to react mid-run, like auto_expand.
+    "rebalance": True,
     # Replicate ensembles (colony.Ensemble): N independent copies of the
     # built sim stepped as ONE device program — the reference runs
     # replicates as N separate experiment clusters (SURVEY.md §3.3).
@@ -114,6 +127,12 @@ def _jsonable(node):
 #: Module-level so the jit cache is hit across segment boundaries (a
 #: fresh lambda per call would retrace the reduction every segment).
 _count_free = jax.jit(lambda alive: (~alive).sum())
+
+#: Division backlog (alive rows whose trigger fired but division was
+#: suppressed) + free rows, as replicated scalars — the rebalance gate.
+_backlog_and_free = jax.jit(
+    lambda alive, trig: ((alive & (trig > 0)).sum(), (~alive).sum())
+)
 
 
 class Experiment:
@@ -256,16 +275,18 @@ class Experiment:
             )
         if (
             self.config["auto_expand"]
-            and (self.runner is not None or replicate_mesh)
+            and replicate_mesh
             and jax.process_count() > 1
         ):
-            # fail at construction, not hours in when the colony fills
-            # (covers the replicate mesh too: Ensemble.expanded pulls the
-            # whole state to host with device_get, which rejects
-            # non-addressable shards)
+            # fail at construction, not hours in when the colony fills.
+            # The agent-mesh runner path expands shard-locally on device
+            # (multi-host safe — see _expand_sharded); the REPLICATE mesh
+            # still gathers: Ensemble.expanded pulls the whole state to
+            # host with device_get, which rejects non-addressable shards.
             raise ValueError(
-                "auto_expand on a multi-host mesh is not supported yet "
-                "(expansion gathers the full state to one host)"
+                "auto_expand on a multi-host REPLICATE mesh is not "
+                "supported yet (Ensemble expansion gathers the full "
+                "state to one host)"
             )
         self.ensemble_runner = None
         if self.config["replicates"] is not None:
@@ -503,6 +524,49 @@ class Experiment:
             self.colony, state = self.colony.expanded(state, factor)
         return state
 
+    def _maybe_rebalance(self, state):
+        """Segment-boundary division-pool rebalance (sharded runner only).
+
+        Reads two replicated scalars (multi-host-safe, like
+        ``_maybe_expand``): the division backlog (alive rows whose
+        trigger fired but were suppressed) and the global free-row
+        count. Iff both are nonzero — a shard is starved while capacity
+        exists elsewhere — rows are re-dealt round-robin by alive-rank.
+        See ``parallel.mesh.rebalance_colony_rows`` for why this is
+        biology-neutral and why it cannot be shard-local.
+        """
+        if (
+            not self.config["rebalance"]
+            or self.runner is None
+            or self.colony.division_trigger is None
+        ):
+            return state
+        from lens_tpu.parallel.mesh import (
+            AGENTS_AXIS,
+            colony_pspecs,
+            mesh_shardings,
+            rebalance_colony_rows,
+        )
+        from lens_tpu.utils.dicts import get_path
+
+        cs = state.colony
+        trig = get_path(cs.agents, self.colony.division_trigger)
+        backlog, free = _backlog_and_free(cs.alive, trig)
+        if int(backlog) == 0 or int(free) == 0:
+            return state
+        mesh = self.runner.mesh
+        n_blocks = mesh.shape[AGENTS_AXIS]
+        # one jitted program per Experiment (jit's own cache handles a
+        # post-expansion shape change; a fresh jit() per call would not)
+        fn = getattr(self, "_rebalance_jit", None)
+        if fn is None:
+            fn = self._rebalance_jit = jax.jit(
+                rebalance_colony_rows,
+                static_argnums=1,
+                out_shardings=mesh_shardings(mesh, colony_pspecs(cs)),
+            )
+        return state._replace(colony=fn(cs, n_blocks))
+
     def _expand_sharded(self, state, factor: int):
         """Capacity growth under a device mesh, entirely on device: each
         agent shard pads its own block with its share of fresh rows
@@ -617,8 +681,12 @@ class Experiment:
                         )
                     if is_coordinator():
                         self.emitter.emit_trajectory(trajectory, times=times)
-                # Expansion BEFORE the checkpoint: the saved state already
-                # has the new capacity, so resume continues expanded.
+                # Rebalance before expansion: starved shards may only
+                # need the free rows other shards already hold, in which
+                # case growth can wait. Both before the checkpoint: the
+                # saved state already has the new layout/capacity, so
+                # resume continues from it.
+                state = self._maybe_rebalance(state)
                 state = self._maybe_expand(state)
                 if self.checkpointer is not None:
                     # Unguarded on purpose: orbax multi-host saves need

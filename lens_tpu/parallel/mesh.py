@@ -170,6 +170,42 @@ def interleave_expanded_rows(colony_state, old_cap: int, n_blocks: int):
     )
 
 
+def rebalance_colony_rows(colony_state, n_blocks: int):
+    """Re-deal ALL rows round-robin by alive-rank so every agent shard
+    ends up with an equal (±1) share of alive AND free rows.
+
+    Division pools are shard-local by design (free rows never cross a
+    shard boundary), which a lineage with an inherited fast phenotype can
+    exploit into real divergence: its daughters recycle rows in the
+    parent's shard until that pool saturates, suppressing divisions the
+    unsharded colony would perform (measured: a 3x-rate founder lineage
+    on one of 8 shards starved at 16/128 rows and the population ran 52%
+    behind unsharded — tests/test_parallel.py). This permutation is the
+    cure: stable-sort rows alive-first (order preserved within each
+    class), deal them round-robin across blocks. Like striping and
+    expansion interleaving it is biology-neutral — row identity is
+    ``lineage.cell_id``, which rides the permutation; row INDEX was never
+    a cross-time identity in a dividing colony.
+
+    Cross-shard by nature (rows move between devices), so run it rarely —
+    the Experiment applies it at segment boundaries, and only when the
+    backlog/free-row telemetry says a shard is starved while global
+    capacity remains (``Experiment._maybe_rebalance``).
+    """
+    cap = colony_state.alive.shape[0]
+    if cap % n_blocks:
+        raise ValueError(f"capacity {cap} not divisible by {n_blocks} blocks")
+    block = cap // n_blocks
+    order = jnp.argsort(~colony_state.alive, stable=True)
+    p = jnp.arange(cap)
+    src = order[(p % block) * n_blocks + p // block]
+    take = lambda leaf: leaf[src]
+    return colony_state._replace(
+        agents=jax.tree.map(take, colony_state.agents),
+        alive=take(colony_state.alive),
+    )
+
+
 def expand_colony_rows_on_mesh(colony_state, grown_colony, old_cap: int,
                                mesh: Mesh):
     """Capacity expansion of a mesh-sharded ColonyState, entirely on

@@ -18,13 +18,24 @@ from lens_tpu.processes import register
 
 @register
 class Growth(Process):
-    """Exponential volume growth: V(t+dt) = V(t) * exp(rate * dt)."""
+    """Exponential volume growth: V(t+dt) = V(t) * exp(rate * dt).
+
+    ``per_agent_rates: True`` promotes the rate to a per-agent schema
+    variable ``global/growth_rate`` (default = the config ``rate``; seed
+    a spread via ``initial_state`` overrides). Daughters INHERIT the
+    parent's rate (``_divider: copy``), so lineages carry their growth
+    phenotype — the classic heterogeneous-lineage regime, and the one
+    place sharded division pools can genuinely desynchronize (a fast
+    lineage's daughters all recycle rows in the parent's shard; see
+    tests/test_parallel.py::test_sharded_division_heterogeneous_rates).
+    """
 
     name = "growth"
-    defaults = {"rate": 0.0005}  # 1/s  (~23 min doubling, E. coli-ish)
+    defaults = {"rate": 0.0005, "per_agent_rates": False}
+    # 1/s  (~23 min doubling, E. coli-ish)
 
     def ports_schema(self):
-        return {
+        schema = {
             "global": {
                 "volume": {
                     "_default": 1.0,
@@ -33,10 +44,24 @@ class Growth(Process):
                 },
             },
         }
+        if self.config["per_agent_rates"]:
+            schema["global"]["growth_rate"] = {
+                "_default": float(self.config["rate"]),
+                "_updater": "set",
+                "_divider": "copy",
+            }
+        return schema
 
     def next_update(self, timestep, states):
-        v = states["global"]["volume"]
-        return {"global": {"volume": v * (jnp.exp(self.config["rate"] * timestep) - 1.0)}}
+        g = states["global"]
+        rate = (
+            g["growth_rate"]
+            if self.config["per_agent_rates"]
+            else self.config["rate"]
+        )
+        return {
+            "global": {"volume": g["volume"] * (jnp.exp(rate * timestep) - 1.0)}
+        }
 
 
 @register
