@@ -81,7 +81,7 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # division pools are shard-local, so an inherited-fast lineage can
     # saturate its shard's pool while other shards hold free rows —
     # measured 52% population deficit vs unsharded in the adversarial
-    # regime (tests/test_parallel.py::TestHeterogeneousDivergence). When
+    # regime (tests/test_experiment.py::TestHeterogeneousDivergence). When
     # True (default), each segment boundary checks two global scalars
     # (division backlog, free rows); iff BOTH are nonzero the rows are
     # re-dealt round-robin by alive-rank (parallel.mesh.

@@ -1,0 +1,477 @@
+"""Batched first-order LP solver (PDLP-style PDHG) — FBA beyond the
+dense-Cholesky wall.
+
+``ops.linprog`` solves each cell's FBA exactly with a dense interior-point
+method whose per-iteration cost is O(M^2 R + M^3/3): forming and Cholesky-
+factoring the normal equations ``A D A^T``. At the reference-lineage scale
+(e_coli_core, 72x180) that is the right tool — ~10 iterations, tiny
+matrices, batched factorizations. But the reference's raison d'etre is
+wcEcoli-class networks (thousands of reactions — SURVEY.md §2 "wcEcoli
+bridge"), where M^3 per agent per step is the wall *no* factorization
+layout fixes on a TPU: sparse Cholesky is sequential scatter/gather (the
+opposite of the MXU), and the normal matrix fills in anyway.
+
+This module is the scaling step (VERDICT r4 "missing" #3 / task 4,
+option c): a **restarted, primal-weighted PDHG** ("PDLP": Applegate et
+al. 2021, arXiv:2106.04756 — public algorithm) whose per-iteration work
+is TWO matvecs with the static constraint matrix. Batched over a colony,
+those are ``[N, R] @ [R, M]`` dense matmuls — exactly the MXU's shape,
+with none of the batched-small-Cholesky awkwardness. Cost per iteration
+is O(M R) dense (O(nnz) sparse), so the crossover vs the IPM arrives as
+soon as the extra first-order iterations are cheaper than the cubic
+factorization — measured in ``bench_lp_scale.py``, which is the evidence
+for when to prefer which solver.
+
+Same problem form as ``linprog_box`` (the FBA form)::
+
+    minimize    c @ x
+    subject to  A @ x = b,   lb <= x <= ub
+
+Same contract too: fixed shapes, capped iterations, a ``lax.while_loop``
+that exits when every (vmapped) problem is accepted at the SAME relative
+KKT tolerances the result reports, warm-startable from the previous
+step's solution (temporal coherence: environments change slowly). No
+data-dependent Python control flow anywhere.
+
+Algorithm (per problem; ``vmap`` batches it):
+
+- Ruiz equilibration of ``A`` (10 passes — deterministic in ``A``, so
+  warm starts stay coordinate-consistent across calls), then
+  **Pock-Chambolle diagonal preconditioning** (alpha = 1): per-variable
+  primal steps ``tau_j = w / sum_i |A_ij|`` and per-constraint dual
+  steps ``sigma_i = w^-1 / sum_j |A_ij|``, which satisfy the PDHG step
+  condition by construction. Measured on the regulated e_coli_core
+  (24x59): scalar spectral-norm steps stall above gap ~1e-1 at 65k
+  iterations; the diagonal steps converge to 1e-5 in ~4k.
+- PDHG with reflection: ``x+ = clip(x - tau (c - A^T y), lb, ub)``;
+  ``y+ = y + sigma (b - A(2 x+ - x))`` with primal weight ``w``.
+- Every ``restart_every`` iterations the KKT score (max of relative
+  primal residual and relative duality gap) is evaluated at BOTH the
+  current iterate and the in-window average; the better one becomes the
+  restart point (adaptive restart-to-average), and the primal weight is
+  re-balanced from the window's primal/dual movement ratio
+  ``w <- sqrt(w * ||dy|| / ||dx||)`` (PDLP's theta = 1/2 rule). The
+  window length matters: too-frequent restarts (64) destabilize the
+  weight adaptation and stall; 512 converged every packaged network
+  (measured sweep in the round-5 records).
+- The duality gap uses the exact box-LP dual: for reduced costs
+  ``r = c - A^T y``, the dual objective is
+  ``b @ y + sum(min(r * lb, r * ub))`` — the bound multipliers are the
+  positive/negative parts of ``r``, so dual feasibility is exact by
+  construction and the gap + primal residual alone certify optimality.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class PDLPWarm(NamedTuple):
+    """Carryable warm-start state: previous solution in ORIGINAL primal
+    coordinates, equilibrated-system duals, the adapted primal weight,
+    and a flag (``<= 0`` means "ignore me" — cold start)."""
+
+    x: jnp.ndarray      # [R] primal, original coordinates
+    y: jnp.ndarray      # [M] duals of the equilibrated system
+    omega: jnp.ndarray  # scalar primal weight carried across solves
+    flag: jnp.ndarray   # scalar; > 0 iff the previous solve converged
+
+
+def warm_size_pdlp(n_constraints: int, n_variables: int) -> int:
+    """Length of the packed warm-start vector."""
+    return n_variables + n_constraints + 2
+
+
+def pack_warm_pdlp(ws: PDLPWarm) -> jnp.ndarray:
+    return jnp.concatenate(
+        [ws.x, ws.y, jnp.reshape(ws.omega, (1,)), jnp.reshape(ws.flag, (1,))]
+    )
+
+
+def unpack_warm_pdlp(
+    vec: jnp.ndarray, n_constraints: int, n_variables: int
+) -> PDLPWarm:
+    r, m = n_variables, n_constraints
+    return PDLPWarm(
+        x=vec[:r], y=vec[r : r + m], omega=vec[r + m], flag=vec[r + m + 1]
+    )
+
+
+class PDLPResult(NamedTuple):
+    """Solution of one LP (or a batch, under vmap)."""
+
+    x: jnp.ndarray           # [R] primal solution, ORIGINAL coordinates
+    objective: jnp.ndarray   # scalar c @ x
+    primal_residual: jnp.ndarray  # ||A x - b||_inf (equilibrated, relative)
+    dual_gap: jnp.ndarray    # relative primal-dual objective gap
+    converged: jnp.ndarray   # bool: primal residual AND gap below tol
+    iterations: jnp.ndarray  # int32 PDHG iterations actually run
+    warm: PDLPWarm           # final iterate for seeding the next solve
+
+
+def _ruiz_scales(absA, xp, passes: int = 10):
+    """Two-sided Ruiz equilibration scales toward unit row/col inf-norms
+    (same scheme as ``linprog._linprog_box_impl``). ``xp`` is the array
+    module — ``jnp`` for the in-trace dense path, ``numpy`` for the
+    host-side sparse precompute — so there is ONE definition of the
+    scaling both solver forms (and their warm-start layouts) depend on
+    being deterministic in ``A``.
+
+    Returns ``(row_scale, col_scale)``.
+    """
+    m, r = absA.shape
+    row_scale = xp.ones((m,), absA.dtype)
+    col_scale = xp.ones((r,), absA.dtype)
+    for _ in range(passes):
+        scaled = absA * row_scale[:, None] * col_scale[None, :]
+        row_scale = row_scale / xp.sqrt(
+            xp.maximum(xp.max(scaled, axis=1), 1e-12)
+        )
+        scaled = absA * row_scale[:, None] * col_scale[None, :]
+        col_scale = col_scale / xp.sqrt(
+            xp.maximum(xp.max(scaled, axis=0), 1e-12)
+        )
+    return row_scale, col_scale
+
+
+def _ruiz(A, b, c, lb, ub, passes: int = 10):
+    """Apply Ruiz equilibration in-trace (dense path)."""
+    row_scale, col_scale = _ruiz_scales(jnp.abs(A), jnp, passes)
+    A = A * row_scale[:, None] * col_scale[None, :]
+    return (
+        A,
+        b * row_scale,
+        c * col_scale,
+        lb / col_scale,
+        ub / col_scale,
+        row_scale,
+        col_scale,
+    )
+
+
+class _PDState(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    x_anchor: jnp.ndarray   # restart point (movement reference)
+    y_anchor: jnp.ndarray
+    omega: jnp.ndarray
+    k: jnp.ndarray          # iterations run
+    done: jnp.ndarray       # accepted at tol
+    res_p: jnp.ndarray      # last KKT numbers (for the report)
+    gap: jnp.ndarray
+
+
+def pdlp_box(
+    c: jnp.ndarray,
+    A: jnp.ndarray,
+    b: jnp.ndarray,
+    lb: jnp.ndarray,
+    ub: jnp.ndarray,
+    n_iter: int = 16384,
+    tol: float = 1e-4,
+    restart_every: int = 512,
+    warm: PDLPWarm | None = None,
+    sparse: bool | str = "auto",
+) -> PDLPResult:
+    """Solve ``min c@x  s.t. A@x = b, lb <= x <= ub`` by restarted PDHG.
+
+    Single-problem arguments (``A`` is [M, R]); batch with ``jax.vmap``
+    over ``(c, b, lb, ub)`` as needed — ``A`` static turns the per-
+    iteration matvecs into one ``[N, R] @ [R, M]`` batch matmul.
+
+    ``sparse``: exploit ``A``'s sparsity pattern with fixed-shape
+    segment-sum matvecs — O(nnz) per iteration instead of O(M R).
+    Stoichiometric matrices are extremely sparse (~3% at 72x180,
+    ~99% zero for block/tiled networks), and PDHG touches ``A`` ONLY
+    through matvecs, so this is where the first-order solver actually
+    earns its keep at scale (bench_lp_scale.py records dense-IPM vs
+    dense-PDLP vs sparse-PDLP). ``"auto"`` uses it when ``A`` is a
+    concrete (non-traced) matrix with density <= 0.25; the pattern,
+    equilibration, and step sizes are then precomputed host-side in
+    numpy, shrinking the XLA program too. ``True`` forces it (errors on
+    a traced ``A``); ``False`` keeps dense matmuls (the MXU-friendly
+    form for small dense networks).
+
+    ``n_iter`` caps TOTAL PDHG iterations (rounded up to whole restart
+    windows); the loop exits early once accepted at ``tol`` (relative
+    primal residual AND relative duality gap — acceptance is evaluated at
+    restart boundaries, so reported iterations quantize to
+    ``restart_every``). Infeasible problems come back ``converged=False``
+    with a large residual; no exceptions inside jit.
+    """
+    import numpy as np
+
+    m = A.shape[0]
+    concrete = not isinstance(A, jax.core.Tracer)
+    if sparse is True and not concrete:
+        raise ValueError(
+            "pdlp_box(sparse=True) needs a concrete (non-traced) A: the "
+            "sparsity pattern is a static shape"
+        )
+    use_sparse = bool(m) and concrete and (
+        sparse is True
+        or (
+            sparse == "auto"
+            and np.count_nonzero(np.asarray(A)) <= 0.25 * A.shape[0] * A.shape[1]
+        )
+    )
+    with jax.default_matmul_precision("float32"):
+        if use_sparse:
+            return _pdlp_sparse_impl(
+                c, A, b, lb, ub, n_iter, tol, restart_every, warm
+            )
+        return _pdlp_box_impl(
+            c, A, b, lb, ub, n_iter, tol, restart_every, warm
+        )
+
+
+def _pdlp_sparse_impl(c, A, b, lb, ub, n_iter, tol, restart_every, warm):
+    """Host-side (numpy) equilibration + COO pattern extraction, then the
+    shared PDHG core with segment-sum matvecs. ``A`` must be concrete;
+    ``b``/``c``/``lb``/``ub`` may be traced (they are scaled in-trace)."""
+    import numpy as np
+
+    dtype = jnp.float32
+    An = np.asarray(A, np.float64)
+    m, r = An.shape
+    # Ruiz on host, float64 — the SAME _ruiz_scales the dense path runs
+    # in-trace, so scaling stays deterministic in A and warm starts stay
+    # coordinate-consistent across calls and across solver forms
+    rs, cs = _ruiz_scales(np.abs(An), np)
+    As = An * rs[:, None] * cs[None, :]
+    rows, cols = np.nonzero(As)
+    # two orderings so both matvecs run with sorted segment ids
+    by_row = np.lexsort((cols, rows))
+    by_col = np.lexsort((rows, cols))
+    vals_r = jnp.asarray(As[rows, cols][by_row], dtype)
+    rows_r = jnp.asarray(rows[by_row])
+    cols_r = jnp.asarray(cols[by_row])
+    vals_c = jnp.asarray(As[rows, cols][by_col], dtype)
+    rows_c = jnp.asarray(rows[by_col])
+    cols_c = jnp.asarray(cols[by_col])
+
+    def Ax(x):
+        return jax.ops.segment_sum(
+            vals_r * x[cols_r], rows_r, num_segments=m,
+            indices_are_sorted=True,
+        )
+
+    def ATy(y):
+        return jax.ops.segment_sum(
+            vals_c * y[rows_c], cols_c, num_segments=r,
+            indices_are_sorted=True,
+        )
+
+    abs_sum0 = np.abs(As).sum(axis=0)  # per column
+    abs_sum1 = np.abs(As).sum(axis=1)  # per row
+    tau_d = jnp.asarray(1.0 / np.maximum(abs_sum0, 1e-12), dtype)
+    sig_d = jnp.asarray(1.0 / np.maximum(abs_sum1, 1e-12), dtype)
+    row_scale = jnp.asarray(rs, dtype)
+    col_scale = jnp.asarray(cs, dtype)
+    b = jnp.asarray(b, dtype) * row_scale
+    c = jnp.asarray(c, dtype) * col_scale
+    lb = jnp.asarray(lb, dtype) / col_scale
+    ub = jnp.asarray(ub, dtype) / col_scale
+    # an inverted box is an INFEASIBLE problem, not a clampable one:
+    # solve the pinned version for shape-stability but report failure
+    box_ok = jnp.all(ub >= lb)
+    lb = jnp.minimum(lb, ub)
+    return _pdlp_core(
+        c, b, lb, ub, col_scale, tau_d, sig_d, Ax, ATy, m, r,
+        n_iter, tol, restart_every, warm, dtype, box_ok,
+    )
+
+
+def _pdlp_box_impl(c, A, b, lb, ub, n_iter, tol, restart_every, warm):
+    dtype = jnp.result_type(c.dtype, jnp.float32)
+    c = jnp.asarray(c, dtype)
+    A = jnp.asarray(A, dtype)
+    b = jnp.asarray(b, dtype)
+    lb = jnp.asarray(lb, dtype)
+    ub = jnp.asarray(ub, dtype)
+    m, r = A.shape
+
+    box_ok = jnp.all(ub >= lb)
+    if m:
+        A, b, c, lb, ub, _row_scale, col_scale = _ruiz(A, b, c, lb, ub)
+        # an inverted box is an INFEASIBLE problem: solve the pinned
+        # version for shape-stability, report converged=False (box_ok)
+        lb = jnp.minimum(lb, ub)
+        # Pock-Chambolle (alpha = 1) diagonal step sizes; the primal
+        # weight multiplies/divides these per restart round.
+        tau_d = 1.0 / jnp.maximum(jnp.sum(jnp.abs(A), axis=0), 1e-12)
+        sig_d = 1.0 / jnp.maximum(jnp.sum(jnp.abs(A), axis=1), 1e-12)
+    else:
+        # pure box LP: no equalities to scale against; one gradient step
+        # on the (linear) objective followed by the clip is exact, so any
+        # finite step works — normalize by the objective scale.
+        col_scale = jnp.ones((r,), dtype)
+        tau_d = jnp.full((r,), 0.9, dtype) / (1.0 + jnp.max(jnp.abs(c)))
+        sig_d = jnp.zeros((0,), dtype)
+
+    Ax = (lambda x: A @ x) if m else (lambda x: jnp.zeros((0,), dtype))
+    ATy = (lambda y: A.T @ y) if m else (lambda y: jnp.zeros((r,), dtype))
+    return _pdlp_core(
+        c, b, lb, ub, col_scale, tau_d, sig_d, Ax, ATy, m, r,
+        n_iter, tol, restart_every, warm, dtype, box_ok,
+    )
+
+
+def _pdlp_core(c, b, lb, ub, col_scale, tau_d, sig_d, Ax, ATy, m, r,
+               n_iter, tol, restart_every, warm, dtype, box_ok):
+    tol = jnp.asarray(tol, dtype)
+    b_scale = 1.0 + jnp.max(jnp.abs(b)) if m else jnp.asarray(1.0, dtype)
+
+    def kkt(x, y):
+        """(relative primal residual, relative gap) at (x, y)."""
+        rp = (jnp.max(jnp.abs(Ax(x) - b)) if m else jnp.asarray(0.0, dtype))
+        red = c - (ATy(y) if m else 0.0)
+        pobj = c @ x
+        dobj = (b @ y if m else 0.0) + jnp.sum(
+            jnp.minimum(red * lb, red * ub)
+        )
+        gap = jnp.abs(pobj - dobj) / (1.0 + jnp.abs(pobj) + jnp.abs(dobj))
+        return rp / b_scale, gap
+
+    x0 = jnp.clip(jnp.zeros((r,), dtype), lb, ub)
+    y0 = jnp.zeros((m,), dtype)
+    omega0 = jnp.asarray(1.0, dtype)
+    if warm is not None:
+        use = jnp.asarray(warm.flag, dtype) > 0
+        x0 = jnp.where(
+            use, jnp.clip(jnp.asarray(warm.x, dtype) / col_scale, lb, ub), x0
+        )
+        y0 = jnp.where(use, jnp.asarray(warm.y, dtype), y0)
+        omega0 = jnp.where(
+            use, jnp.clip(jnp.asarray(warm.omega, dtype), 1e-3, 1e3), omega0
+        )
+
+    n_rounds = -(-int(n_iter) // int(restart_every))
+
+    def round_body(st: _PDState) -> _PDState:
+        tau = tau_d / st.omega
+        sigma = sig_d * st.omega
+
+        def pdhg(_, carry):
+            x, y, xs, ys = carry
+            x_new = jnp.clip(x - tau * (c - (ATy(y) if m else 0.0)), lb, ub)
+            y_new = y + sigma * (b - Ax(2.0 * x_new - x)) if m else y
+            return x_new, y_new, xs + x_new, ys + y_new
+
+        zx = jnp.zeros_like(st.x)
+        zy = jnp.zeros_like(st.y)
+        x_end, y_end, xs, ys = lax.fori_loop(
+            0, restart_every, pdhg, (st.x, st.y, zx, zy)
+        )
+        inv = 1.0 / jnp.asarray(restart_every, dtype)
+        x_avg, y_avg = xs * inv, ys * inv
+
+        # adaptive restart-to-average: continue from whichever candidate
+        # scores better on the SAME acceptance metric
+        rp_end, gap_end = kkt(x_end, y_end)
+        rp_avg, gap_avg = kkt(x_avg, y_avg)
+        score_end = jnp.maximum(rp_end, gap_end)
+        score_avg = jnp.maximum(rp_avg, gap_avg)
+        take_avg = score_avg < score_end
+        x_next = jnp.where(take_avg, x_avg, x_end)
+        y_next = jnp.where(take_avg, y_avg, y_end)
+        rp = jnp.where(take_avg, rp_avg, rp_end)
+        gap = jnp.where(take_avg, gap_avg, gap_end)
+
+        # primal-weight rebalance from the window's movement ratio
+        # (theta = 1/2: w <- sqrt(w * ||dy|| / ||dx||), clipped)
+        dx = jnp.linalg.norm(x_next - st.x_anchor)
+        dy = jnp.linalg.norm(y_next - st.y_anchor)
+        ratio = jnp.clip(dy / jnp.maximum(dx, 1e-12), 1e-6, 1e6)
+        omega = jnp.where(
+            (dx > 1e-12) & (dy > 1e-12),
+            jnp.clip(jnp.sqrt(st.omega * ratio), 1e-3, 1e3),
+            st.omega,
+        )
+
+        accepted = (rp <= tol) & (gap <= tol)
+        keep = lambda new, old: jnp.where(st.done, old, new)
+        return _PDState(
+            x=keep(x_next, st.x),
+            y=keep(y_next, st.y),
+            x_anchor=keep(x_next, st.x_anchor),
+            y_anchor=keep(y_next, st.y_anchor),
+            omega=keep(omega, st.omega),
+            k=st.k + jnp.where(st.done, 0, restart_every).astype(jnp.int32),
+            done=st.done | accepted,
+            res_p=keep(rp, st.res_p),
+            gap=keep(gap, st.gap),
+        )
+
+    rp0, gap0 = kkt(x0, y0)
+    init = _PDState(
+        x=x0,
+        y=y0,
+        x_anchor=x0,
+        y_anchor=y0,
+        omega=omega0,
+        k=jnp.int32(0),
+        done=(rp0 <= tol) & (gap0 <= tol),
+        res_p=rp0,
+        gap=gap0,
+    )
+    final = lax.while_loop(
+        lambda st: (~st.done) & (st.k < n_rounds * restart_every),
+        round_body,
+        init,
+    )
+
+    x_orig = final.x * col_scale
+    converged = final.done & box_ok
+    return PDLPResult(
+        x=x_orig,
+        objective=jnp.asarray(c / col_scale, dtype) @ x_orig,
+        primal_residual=final.res_p,
+        dual_gap=final.gap,
+        converged=converged,
+        iterations=final.k,
+        warm=PDLPWarm(
+            x=x_orig, y=final.y, omega=final.omega,
+            flag=converged.astype(dtype),
+        ),
+    )
+
+
+def flux_balance_pdlp(
+    stoichiometry: jnp.ndarray,
+    objective: jnp.ndarray,
+    lb: jnp.ndarray,
+    ub: jnp.ndarray,
+    n_iter: int = 16384,
+    tol: float = 1e-4,
+    leak: float = 0.0,
+    warm: PDLPWarm | None = None,
+    sparse: bool | str = "auto",
+) -> PDLPResult:
+    """FBA via PDLP: ``max objective @ v  s.t. S @ v = 0, lb <= v <= ub``.
+
+    Drop-in analogue of :func:`lens_tpu.ops.linprog.flux_balance` (same
+    leak-slack relaxation, same batching contract) built on the
+    first-order solver — the path for networks past the dense-IPM
+    crossover (see ``bench_lp_scale.py`` for where that is). Under
+    ``sparse="auto"`` a concrete stoichiometry (the normal case — it is
+    a static network constant even inside a jitted process step) gets
+    O(nnz) segment-sum matvecs.
+    """
+    S = jnp.asarray(stoichiometry)
+    m, r = S.shape
+    c = -jnp.asarray(objective)
+    if leak > 0.0 and m:
+        S = jnp.concatenate([S, jnp.eye(m, dtype=S.dtype)], axis=1)
+        c = jnp.concatenate([c, jnp.zeros(m, c.dtype)])
+        lb = jnp.concatenate([jnp.asarray(lb), jnp.full(m, -leak, S.dtype)])
+        ub = jnp.concatenate([jnp.asarray(ub), jnp.full(m, leak, S.dtype)])
+    res = pdlp_box(
+        c, S, jnp.zeros(m, S.dtype), lb, ub,
+        n_iter=n_iter, tol=tol, warm=warm, sparse=sparse,
+    )
+    return res._replace(objective=-res.objective, x=res.x[:r])

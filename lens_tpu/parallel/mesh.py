@@ -180,7 +180,8 @@ def rebalance_colony_rows(colony_state, n_blocks: int):
     parent's shard until that pool saturates, suppressing divisions the
     unsharded colony would perform (measured: a 3x-rate founder lineage
     on one of 8 shards starved at 16/128 rows and the population ran 52%
-    behind unsharded — tests/test_parallel.py). This permutation is the
+    behind unsharded — tests/test_experiment.py::
+    TestHeterogeneousDivergence). This permutation is the
     cure: stable-sort rows alive-first (order preserved within each
     class), deal them round-robin across blocks. Like striping and
     expansion interleaving it is biology-neutral — row identity is

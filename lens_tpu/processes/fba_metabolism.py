@@ -179,6 +179,25 @@ class FBAMetabolism(Process):
         # a hint only (acceptance tests are unchanged), dropped
         # automatically when the solve fails or the port is not wired.
         "lp_warm_start": True,
+        # Which batched LP engine solves the per-agent FBA:
+        # - "ipm" (default): the dense Mehrotra interior-point method
+        #   (ops.linprog) — O(M^2 R + M^3/3) per iteration, ~10
+        #   iterations; the right tool through reference scale (72x180).
+        # - "pdlp": the first-order restarted PDHG (ops.pdlp) — O(M R)
+        #   matvecs per iteration, thousands of iterations; the scaling
+        #   path for networks past the dense-Cholesky crossover
+        #   (bench_lp_scale.py records where that is). Warm-state layout
+        #   differs, so a checkpoint taken with one solver does not
+        #   resume with the other.
+        "lp_solver": "ipm",
+        # Iteration CAP for the pdlp solver only (its iterations are
+        # matvec-cheap; the cap covers cold starts — warm-started steps
+        # exit far earlier). Sized ABOVE the measured cold-start
+        # envelope (13k-25k iterations on the tiled-network sweep,
+        # BENCH_LP_SCALE_CPU_r05.json): an undersized cap is sticky —
+        # a failed solve leaves warm.flag = 0, so the next step repeats
+        # the same doomed cold solve and the agent silently never grows.
+        "pdlp_iterations": 32768,
     }
 
     def __init__(self, config=None):
@@ -273,7 +292,17 @@ class FBAMetabolism(Process):
         # sized for the FULL problem.
         n_lp_vars = n_r + (n_m if self.config["lp_leak"] > 0.0 else 0)
         self._n_lp_vars = n_lp_vars
-        self._warm_len = warm_size(n_m, n_lp_vars)
+        solver = self.config["lp_solver"]
+        if solver not in ("ipm", "pdlp"):
+            raise ValueError(
+                f"lp_solver must be 'ipm' or 'pdlp', got {solver!r}"
+            )
+        if solver == "pdlp":
+            from lens_tpu.ops.pdlp import warm_size_pdlp
+
+            self._warm_len = warm_size_pdlp(n_m, n_lp_vars)
+        else:
+            self._warm_len = warm_size(n_m, n_lp_vars)
 
     # -- declarative surface --------------------------------------------------
 
@@ -402,19 +431,32 @@ class FBAMetabolism(Process):
         # warm-started from the previous step's iterate when the lp_state
         # port is wired (tests that hand-build states without it fall back
         # to the cold start — identical answers, more iterations).
+        pdlp = self.config["lp_solver"] == "pdlp"
+        if pdlp:
+            from lens_tpu.ops.pdlp import (
+                flux_balance_pdlp,
+                pack_warm_pdlp,
+                unpack_warm_pdlp,
+            )
         warm = None
         if self.config["lp_warm_start"] and "lp_state" in states:
-            warm = unpack_warm(
+            unpack = unpack_warm_pdlp if pdlp else unpack_warm
+            warm = unpack(
                 states["lp_state"]["warm"],
                 len(self.internal),
                 self._n_lp_vars,
             )
-        sol = flux_balance(
+        solve = flux_balance_pdlp if pdlp else flux_balance
+        sol = solve(
             self.stoichiometry,
             self.objective,
             lb,
             ub,
-            n_iter=self.config["lp_iterations"],
+            n_iter=(
+                self.config["pdlp_iterations"]
+                if pdlp
+                else self.config["lp_iterations"]
+            ),
             tol=self.config["lp_tol"],
             leak=self.config["lp_leak"],
             warm=warm,
@@ -428,7 +470,9 @@ class FBAMetabolism(Process):
         net_uptake = self.exchange_matrix @ v          # [E], + = imported
         growth = v[self.biomass_index]
         update = {} if warm is None else {
-            "lp_state": {"warm": pack_warm(sol.warm)}
+            "lp_state": {
+                "warm": (pack_warm_pdlp if pdlp else pack_warm)(sol.warm)
+            }
         }
         return update | {
             "exchange": {
@@ -442,10 +486,13 @@ class FBAMetabolism(Process):
                 "reaction_fluxes": v,
                 "growth_rate": growth,
                 "lp_converged": ok.astype(jnp.float32),
-                # IPM iterations before this agent's solve froze (the
-                # while-loop cap is config "lp_iterations"): emitted so a
-                # creeping network/conditioning problem shows up as rising
-                # iteration counts long before convergence failures do.
+                # Solver iterations before this agent's solve froze —
+                # IPM Newton steps (cap: config "lp_iterations") or,
+                # under lp_solver="pdlp", PDHG iterations quantized to
+                # restart windows (cap: "pdlp_iterations"). Emitted so a
+                # creeping network/conditioning problem shows up as
+                # rising iteration counts long before convergence
+                # failures do.
                 "lp_iterations": sol.iterations.astype(jnp.float32),
             },
         }

@@ -27,7 +27,7 @@ class Growth(Process):
     phenotype — the classic heterogeneous-lineage regime, and the one
     place sharded division pools can genuinely desynchronize (a fast
     lineage's daughters all recycle rows in the parent's shard; see
-    tests/test_parallel.py::test_sharded_division_heterogeneous_rates).
+    tests/test_experiment.py::TestHeterogeneousDivergence).
     """
 
     name = "growth"

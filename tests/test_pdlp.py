@@ -1,0 +1,282 @@
+"""First-order PDLP solver vs the scipy HiGHS oracle and the dense IPM.
+
+``ops.pdlp`` is the beyond-dense scaling step (SURVEY.md §2 "wcEcoli
+bridge" direction): correctness is pinned the same way ``ops.linprog``'s
+is — independent CPU oracle on randomized problems, agreement with the
+IPM on the packaged FBA networks, plus structural tests (vmap batching,
+warm starts, infeasibility, early-exit determinism).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from lens_tpu.ops.linprog import flux_balance
+from lens_tpu.ops.pdlp import (
+    PDLPWarm,
+    flux_balance_pdlp,
+    pack_warm_pdlp,
+    pdlp_box,
+    unpack_warm_pdlp,
+    warm_size_pdlp,
+)
+
+
+def random_feasible_lp(rng, m=4, r=9):
+    A = rng.normal(size=(m, r))
+    lb = -rng.uniform(0.5, 3.0, size=r)
+    ub = rng.uniform(0.5, 3.0, size=r)
+    x0 = rng.uniform(0.25, 0.75, size=r) * (ub - lb) + lb
+    b = A @ x0
+    c = rng.normal(size=r)
+    return c, A, b, lb, ub
+
+
+def oracle(c, A, b, lb, ub):
+    res = scipy.optimize.linprog(
+        c, A_eq=A, b_eq=b, bounds=list(zip(lb, ub)), method="highs"
+    )
+    assert res.success, res.message
+    return res
+
+
+def network_problem(name):
+    """(S, objective, lb, ub) for a packaged FBA network in a glucose-rich
+    aerobic environment (same base as bench_lp_sizes.py)."""
+    from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+    p = FBAMetabolism({"network": name})
+    base = {"glc": 10.0, "o2": 50.0, "nh4": 50.0, "ace": 2.0}
+    env = jnp.asarray(
+        [base.get(mol, 0.0) for mol in p.external], jnp.float32
+    )
+    lb, ub = p.regulated_bounds(env, 1.0)
+    return p.stoichiometry, p.objective, lb, ub
+
+
+class TestAgainstScipy:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_problems_match_highs(self, seed):
+        rng = np.random.default_rng(seed)
+        c, A, b, lb, ub = random_feasible_lp(rng)
+        ref = oracle(c, A, b, lb, ub)
+        res = pdlp_box(
+            jnp.asarray(c), jnp.asarray(A), jnp.asarray(b),
+            jnp.asarray(lb), jnp.asarray(ub), tol=1e-5,
+        )
+        assert bool(res.converged), (res.primal_residual, res.dual_gap)
+        scale = 1.0 + abs(ref.fun)
+        assert abs(float(res.objective) - ref.fun) / scale < 5e-4
+        np.testing.assert_allclose(A @ np.asarray(res.x), b, atol=2e-3)
+        assert np.all(np.asarray(res.x) >= lb - 1e-4)
+        assert np.all(np.asarray(res.x) <= ub + 1e-4)
+
+    def test_pure_box_lp(self):
+        # No equalities: optimum at the bound selected by the sign of c.
+        c = jnp.asarray([1.0, -2.0, 0.5])
+        res = pdlp_box(
+            c, jnp.zeros((0, 3)), jnp.zeros((0,)),
+            jnp.asarray([-1.0, -1.0, -1.0]), jnp.asarray([2.0, 2.0, 2.0]),
+        )
+        assert bool(res.converged)
+        np.testing.assert_allclose(
+            np.asarray(res.x), [-1.0, 2.0, -1.0], atol=1e-3
+        )
+
+    def test_inverted_box_reports_not_converged(self):
+        # lb > ub is an infeasible problem, not a clampable one — the
+        # solver must not report success on the silently pinned version
+        res = pdlp_box(
+            jnp.asarray([1.0, 1.0]),
+            jnp.asarray([[1.0, -1.0]]),
+            jnp.asarray([0.0]),
+            jnp.asarray([0.0, 2.0]),
+            jnp.asarray([1.0, 1.0]),  # ub[1] < lb[1]
+            n_iter=512,
+        )
+        assert not bool(res.converged)
+        assert float(res.warm.flag) == 0.0
+
+    def test_infeasible_reports_not_converged(self):
+        # x1 + x2 = 10 with 0 <= x <= 1: unsatisfiable.
+        res = pdlp_box(
+            jnp.asarray([1.0, 1.0]),
+            jnp.asarray([[1.0, 1.0]]),
+            jnp.asarray([10.0]),
+            jnp.zeros(2),
+            jnp.ones(2),
+            n_iter=1024,
+        )
+        assert not bool(res.converged)
+        assert float(res.primal_residual) > 0.1
+
+
+class TestFBANetworks:
+    """Agreement with the dense IPM on the packaged FBA networks — the
+    crossover bench (bench_lp_scale.py) assumes the two solvers answer
+    the same question at their shared tolerances."""
+
+    @pytest.mark.parametrize("name", ["core_skeleton", "ecoli_core"])
+    def test_matches_ipm_objective(self, name):
+        S, obj, lb, ub = network_problem(name)
+        ipm = flux_balance(S, obj, lb, ub, n_iter=45, tol=1e-5)
+        pd = flux_balance_pdlp(S, obj, lb, ub, n_iter=16384, tol=1e-5)
+        assert bool(ipm.converged) and bool(pd.converged), (
+            ipm.converged, pd.converged, pd.primal_residual, pd.dual_gap,
+        )
+        scale = 1.0 + abs(float(ipm.objective))
+        assert (
+            abs(float(pd.objective) - float(ipm.objective)) / scale < 2e-3
+        )
+
+    def test_vmap_batches_over_bounds(self):
+        S, obj, lb, ub = network_problem("core_skeleton")
+        scales = jnp.asarray([0.5, 1.0, 2.0])
+        sol = jax.vmap(
+            lambda s: flux_balance_pdlp(S, obj, lb * s, ub * s, tol=1e-5)
+        )(scales)
+        assert bool(sol.converged.all()), np.asarray(sol.primal_residual)
+        # FBA optima scale linearly with the box on this network
+        objs = np.asarray(sol.objective)
+        np.testing.assert_allclose(objs[1] * 0.5, objs[0], rtol=5e-3)
+        np.testing.assert_allclose(objs[1] * 2.0, objs[2], rtol=5e-3)
+
+
+class TestSparseMatvecs:
+    """sparse="auto"/True: O(nnz) segment-sum matvecs must answer exactly
+    the same question as the dense matmuls."""
+
+    def test_sparse_matches_dense_on_core_network(self):
+        S, obj, lb, ub = network_problem("ecoli_core")
+        dense = flux_balance_pdlp(
+            S, obj, lb, ub, n_iter=16384, tol=1e-5, sparse=False
+        )
+        sp = flux_balance_pdlp(
+            S, obj, lb, ub, n_iter=16384, tol=1e-5, sparse=True
+        )
+        assert bool(dense.converged) and bool(sp.converged)
+        scale = 1.0 + abs(float(dense.objective))
+        assert (
+            abs(float(sp.objective) - float(dense.objective)) / scale < 1e-3
+        )
+
+    def test_sparse_under_vmap_and_jit(self):
+        S, obj, lb, ub = network_problem("core_skeleton")
+        scales = jnp.asarray([0.5, 1.0, 2.0])
+        sol = jax.jit(
+            jax.vmap(
+                lambda s: flux_balance_pdlp(
+                    S, obj, lb * s, ub * s, tol=1e-5, sparse=True
+                )
+            )
+        )(scales)
+        assert bool(sol.converged.all())
+
+    def test_sparse_true_rejects_traced_matrix(self):
+        c = jnp.zeros(3)
+        b = jnp.zeros(2)
+        lo = -jnp.ones(3)
+        hi = jnp.ones(3)
+        with pytest.raises(ValueError, match="concrete"):
+            jax.jit(
+                lambda A: pdlp_box(c, A, b, lo, hi, sparse=True).x
+            )(jnp.ones((2, 3)))
+
+
+class TestProcessIntegration:
+    """`lp_solver: "pdlp"` in FBAMetabolism: same phenotype as the IPM,
+    warm state threaded in the PDLP layout."""
+
+    def _stepped(self, solver, n_steps=3):
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        p = FBAMetabolism({
+            "network": "ecoli_core", "lp_leak": 1.5e-3, "lp_tol": 1e-4,
+            "lp_iterations": 60, "lp_solver": solver,
+        })
+        s = p.initial_state()
+        env = {"glc": 10.0, "o2": 50.0, "nh4": 50.0}
+        for mol in p.external:
+            s["external"][mol] = jnp.asarray(float(env.get(mol, 0.0)))
+        outs = []
+        for _ in range(n_steps):
+            u = p.next_update(1.0, s)
+            s["lp_state"]["warm"] = u["lp_state"]["warm"]
+            outs.append(u)
+        return outs
+
+    def test_pdlp_solver_matches_ipm_phenotype(self):
+        ipm = self._stepped("ipm")
+        pd = self._stepped("pdlp")
+        for a, b in zip(ipm, pd):
+            assert float(a["fluxes"]["lp_converged"]) == 1.0
+            assert float(b["fluxes"]["lp_converged"]) == 1.0
+            np.testing.assert_allclose(
+                float(b["fluxes"]["growth_rate"]),
+                float(a["fluxes"]["growth_rate"]),
+                rtol=5e-3, atol=1e-4,
+            )
+        # warm threading pays: later steps exit far below the cold cap
+        assert float(pd[-1]["fluxes"]["lp_iterations"]) < 0.5 * float(
+            pd[0]["fluxes"]["lp_iterations"]
+        )
+
+    def test_solver_name_validated(self):
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        with pytest.raises(ValueError, match="lp_solver"):
+            FBAMetabolism({"lp_solver": "simplex"})
+
+
+class TestWarmStart:
+    def test_warm_cuts_iterations(self):
+        S, obj, lb, ub = network_problem("ecoli_core")
+        cold = flux_balance_pdlp(S, obj, lb, ub, n_iter=16384, tol=1e-5)
+        assert bool(cold.converged)
+        # a small environment drift: 5% tighter uptake box
+        warm = flux_balance_pdlp(
+            S, obj, lb * 0.95, ub * 0.95, n_iter=16384, tol=1e-5,
+            warm=cold.warm,
+        )
+        rewarm_cold = flux_balance_pdlp(
+            S, obj, lb * 0.95, ub * 0.95, n_iter=16384, tol=1e-5,
+        )
+        assert bool(warm.converged) and bool(rewarm_cold.converged)
+        assert int(warm.iterations) < int(rewarm_cold.iterations), (
+            int(warm.iterations), int(rewarm_cold.iterations),
+        )
+        scale = 1.0 + abs(float(rewarm_cold.objective))
+        assert (
+            abs(float(warm.objective) - float(rewarm_cold.objective)) / scale
+            < 2e-3
+        )
+
+    def test_flag_zero_reproduces_cold_bitwise(self):
+        rng = np.random.default_rng(5)
+        c, A, b, lb, ub = random_feasible_lp(rng)
+        args = map(jnp.asarray, (c, A, b, lb, ub))
+        c, A, b, lb, ub = args
+        cold = pdlp_box(c, A, b, lb, ub)
+        ignored = PDLPWarm(
+            x=jnp.ones_like(c), y=jnp.zeros(A.shape[0]),
+            omega=jnp.asarray(7.0), flag=jnp.asarray(0.0),
+        )
+        again = pdlp_box(c, A, b, lb, ub, warm=ignored)
+        np.testing.assert_array_equal(np.asarray(cold.x), np.asarray(again.x))
+        assert int(cold.iterations) == int(again.iterations)
+
+    def test_pack_unpack_roundtrip(self):
+        m, r = 4, 9
+        ws = PDLPWarm(
+            x=jnp.arange(r, dtype=jnp.float32),
+            y=jnp.arange(r, r + m, dtype=jnp.float32),
+            omega=jnp.asarray(2.5),
+            flag=jnp.asarray(1.0),
+        )
+        vec = pack_warm_pdlp(ws)
+        assert vec.shape == (warm_size_pdlp(m, r),)
+        back = unpack_warm_pdlp(vec, m, r)
+        for a, c2 in zip(ws, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(c2))
