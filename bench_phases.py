@@ -1,0 +1,135 @@
+"""Per-phase wall-clock breakdown of the flagship windows (VERDICT r4
+missing #6: "bench_mfu names no bottleneck").
+
+The exchange-window step is gather -> biology -> scatter -> diffuse
+(SURVEY.md §3.2's two hot loops plus the coupling). This bench times
+three jitted programs per flagship config over the same simulated
+window, each fenced with ``block_until_ready``:
+
+- ``full``      — the real ``SpatialColony.run`` window;
+- ``biology``   — the same colony stepped WITHOUT the lattice
+  (``Colony.run``: vmapped processes + division bookkeeping only);
+- ``diffusion`` — the lattice field program alone
+  (``lax.scan`` of ``Lattice.step_fields`` over the window's steps,
+  all substeps included).
+
+``coupling = full - biology - diffusion`` then bounds the
+gather/scatter/exchange overhead (it also absorbs measurement noise and
+fusion differences — XLA may fuse phases inside ``full`` that the
+isolated programs cannot, so small negative values mean "coupling is
+free, the phases fuse"). The TPU run of this file is the trace-level
+answer to "where does the window's time go"; the CPU record is the
+methodology anchor.
+
+Writes BENCH_PHASES.json; one JSON line per config.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from lens_tpu.utils.platform import guard_accelerator_or_exit
+
+WINDOW_S = 32.0
+
+
+def _timed(fn, *args, reps=3):
+    import jax
+
+    out = jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jax.block_until_ready(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def _config_rows(name, spatial, n, window_s):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ss = spatial.initial_state(n, jax.random.PRNGKey(0))
+    steps = int(round(window_s))
+
+    full = jax.jit(
+        lambda s: spatial.run(s, window_s, 1.0, emit_every=steps)[0]
+    )
+    biology = jax.jit(
+        lambda c: spatial.colony.run(c, window_s, 1.0, emit_every=steps)[0]
+    )
+    diffusion = jax.jit(
+        lambda f: lax.scan(
+            lambda carry, _: (spatial.lattice.step_fields(carry), None),
+            f,
+            None,
+            length=steps,
+        )[0]
+    )
+
+    t_full = _timed(full, ss)
+    t_bio = _timed(biology, ss.colony)
+    t_dif = _timed(diffusion, ss.fields)
+    coupling = t_full - t_bio - t_dif
+    row = {
+        "config": name,
+        "agents": n,
+        "window_s": window_s,
+        "full_s": round(t_full, 4),
+        "biology_s": round(t_bio, 4),
+        "diffusion_s": round(t_dif, 4),
+        "coupling_s": round(coupling, 4),
+        "biology_share": round(t_bio / t_full, 3),
+        "diffusion_share": round(t_dif / t_full, 3),
+        "bottleneck": max(
+            ("biology", t_bio), ("diffusion", t_dif), ("coupling", coupling),
+            key=lambda kv: kv[1],
+        )[0],
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    guard_accelerator_or_exit()
+    import jax
+
+    from lens_tpu.models.composites import ecoli_lattice, rfba_lattice
+
+    backend = jax.default_backend()
+    window_s = WINDOW_S if backend != "cpu" else 8.0
+    rows = []
+
+    spatial2, _ = ecoli_lattice({"capacity": 10240})
+    rows.append(_config_rows("2", spatial2, 10240, window_s))
+
+    spatial3, _ = rfba_lattice(
+        {
+            "capacity": 1024,
+            "shape": (64, 64),
+            "metabolism": {"network": "ecoli_core"},
+            "expression": {"genes": "ecoli_core"},
+        }
+    )
+    rows.append(_config_rows("3b", spatial3, 1024, window_s))
+
+    with open("BENCH_PHASES.json", "w") as f:
+        json.dump(
+            {
+                "backend": backend,
+                "device_kind": jax.devices()[0].device_kind,
+                "note": (
+                    "fenced jitted programs over the same window; "
+                    "coupling = full - biology - diffusion bounds the "
+                    "gather/scatter/exchange cost and absorbs fusion "
+                    "differences (small negative = phases fuse for free)"
+                ),
+                "rows": rows,
+            },
+            f,
+            indent=1,
+        )
+
+
+if __name__ == "__main__":
+    main()

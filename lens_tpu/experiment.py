@@ -134,6 +134,12 @@ _backlog_and_free = jax.jit(
     lambda alive, trig: ((alive & (trig > 0)).sum(), (~alive).sum())
 )
 
+#: Free rows of the TIGHTEST replicate (alive is [R, rows]) — the
+#: ensemble auto_expand gate, as a replicated scalar.
+_min_free_per_replicate = jax.jit(
+    lambda alive: (~alive).sum(axis=-1).min()
+)
+
 
 class Experiment:
     """One configured, runnable simulation (the reference's "experiment").
@@ -273,21 +279,10 @@ class Experiment:
                     n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
                 ),
             )
-        if (
-            self.config["auto_expand"]
-            and replicate_mesh
-            and jax.process_count() > 1
-        ):
-            # fail at construction, not hours in when the colony fills.
-            # The agent-mesh runner path expands shard-locally on device
-            # (multi-host safe — see _expand_sharded); the REPLICATE mesh
-            # still gathers: Ensemble.expanded pulls the whole state to
-            # host with device_get, which rejects non-addressable shards.
-            raise ValueError(
-                "auto_expand on a multi-host REPLICATE mesh is not "
-                "supported yet (Ensemble expansion gathers the full "
-                "state to one host)"
-            )
+        # auto_expand is multi-host-safe on BOTH mesh forms: the
+        # agent-mesh runner expands shard-locally on device
+        # (_expand_sharded) and the replicate mesh pads device-locally
+        # (ShardedEnsemble.expanded) — no construction guard needed.
         self.ensemble_runner = None
         if self.config["replicates"] is not None:
             from lens_tpu.colony.ensemble import Ensemble
@@ -474,15 +469,22 @@ class Experiment:
 
         if self.ensemble is not None:
             cs = state.colony if isinstance(state, SpatialState) else state
-            alive = np.asarray(jax.device_get(cs.alive))  # [R, rows]
-            cap = alive.shape[-1]
+            cap = int(cs.alive.shape[-1])
             if max_cap is not None and cap * factor > int(max_cap):
                 return state
             # expand when the TIGHTEST replicate runs low — replicates
-            # share one capacity, so the fullest pool decides
-            if (~alive).sum(axis=-1).min() > free_frac * cap:
+            # share one capacity, so the fullest pool decides. Jitted
+            # reduction (replicated scalar), not device_get(alive):
+            # multi-host replicate meshes cannot address the full mask.
+            if int(_min_free_per_replicate(cs.alive)) > free_frac * cap:
                 return state
-            self.ensemble, state = self.ensemble.expanded(state, factor)
+            if self.ensemble_runner is not None:
+                # device-local pad, sharding preserved — no host gather
+                self.ensemble, state = self.ensemble_runner.expanded(
+                    state, factor
+                )
+            else:
+                self.ensemble, state = self.ensemble.expanded(state, factor)
             grown = self.ensemble.sim
             if self.spatial is not None:
                 self.spatial = grown
@@ -490,8 +492,10 @@ class Experiment:
             else:
                 self.colony = grown
             if self.ensemble_runner is not None:
+                # re-wrap only: the device-local expanded() above already
+                # returned a correctly sharded state, and shard() would
+                # host-materialize non-addressable shards on multi-host
                 self._rewrap_ensemble_runner()
-                state = self.ensemble_runner.shard(state)
             return state
 
         def wants_growth(cs) -> bool:

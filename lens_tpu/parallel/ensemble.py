@@ -137,6 +137,89 @@ class ShardedEnsemble:
     def emit_state(self, states) -> dict:
         return self.ensemble.emit_state(states)
 
+    def expanded(self, states, factor: int = 2) -> Tuple[Ensemble, Any]:
+        """Device-local capacity growth for a SHARDED ensemble — the
+        multi-host-safe counterpart of :meth:`Ensemble.expanded`.
+
+        Replicates advance in lockstep and expansion appends identical
+        template rows to every replicate, so the whole pad is one
+        jitted, sharding-constrained concat along the row axis: no host
+        gather (``Ensemble.expanded``'s ``device_get`` rejects
+        non-addressable shards on a multi-host replicate mesh), no
+        transient single-device copy. Bitwise-equal to the host path
+        (tested) because both produce the end-appended
+        ``Colony.expanded`` layout — the replicate mesh never shards the
+        agent axis, so no interleave is needed.
+
+        Returns ``(grown_ensemble, padded_sharded_states)``; callers
+        re-wrap their runner around the grown ensemble as with the host
+        path.
+        """
+        import numpy as np
+
+        from lens_tpu.colony.colony import Colony
+
+        ens = self.ensemble
+        sim = ens.sim
+        colony = getattr(sim, "colony", sim)
+        if not isinstance(colony, Colony):
+            raise TypeError(
+                f"{type(sim).__name__} has no Colony; capacity growth "
+                f"needs a Colony/SpatialColony-form sim"
+            )
+        spatial_form = hasattr(states, "colony")
+        cs = states.colony if spatial_form else states
+        # lockstep [R] step counter — read a locally addressable entry
+        arr = cs.step
+        if getattr(arr, "is_fully_addressable", True) is False:
+            arr = arr.addressable_shards[0].data
+        step_now = int(np.asarray(jax.device_get(arr)).reshape(-1)[0])
+        grown_colony = colony.expanded_meta(step_now, factor)
+        old_cap = colony.capacity
+        b_fresh = grown_colony.capacity - old_cap
+        # the ONE source of truth for template/lineage rules: exactly the
+        # template[old_cap:] slice Colony.expanded pads with
+        tmpl = jax.tree.map(
+            lambda t: t[old_cap:], grown_colony.initial_state(0).agents
+        )
+        R = ens.n_replicates
+
+        def pad(states):
+            cs = states.colony if spatial_form else states
+
+            def pad_leaf(leaf, t):
+                import jax.numpy as jnp
+
+                t = jnp.broadcast_to(
+                    jnp.asarray(t).astype(leaf.dtype), (R,) + t.shape
+                )
+                out = jnp.concatenate([leaf, t], axis=1)
+                return jax.lax.with_sharding_constraint(
+                    out, self._leaf_sharding(out)
+                )
+
+            import jax.numpy as jnp
+
+            agents = jax.tree.map(pad_leaf, cs.agents, tmpl)
+            alive = jax.lax.with_sharding_constraint(
+                jnp.concatenate(
+                    [cs.alive, jnp.zeros((R, b_fresh), bool)], axis=1
+                ),
+                self._leaf_sharding(cs.alive),
+            )
+            new_cs = cs._replace(agents=agents, alive=alive)
+            return (
+                states._replace(colony=new_cs) if spatial_form else new_cs
+            )
+
+        padded = jax.jit(pad)(states)
+        grown_sim = (
+            sim.with_colony(grown_colony)
+            if hasattr(sim, "with_colony")
+            else grown_colony
+        )
+        return Ensemble(grown_sim, R), padded
+
     @property
     def n_replicates(self) -> int:
         return self.ensemble.n_replicates

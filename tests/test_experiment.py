@@ -424,6 +424,35 @@ class TestReplicatesExperiment:
         assert r_alive.shape[1] >= alive.shape[1]
         assert (r_alive.sum(axis=1) >= alive.sum(axis=1)).all()
 
+    def test_replicate_mesh_auto_expand_matches_unsharded(self, tmp_path):
+        """auto_expand on a replicate MESH takes the device-local pad
+        (ShardedEnsemble.expanded — no host gather, multi-host-safe) and
+        must be BITWISE the unsharded ensemble's host-path expansion."""
+
+        def cfg(mesh):
+            return {
+                "composite": "grow_divide",
+                "config": {"growth": {"rate": 0.05}},
+                "n_agents": 6,
+                "capacity": 8,
+                "total_time": 60.0,
+                "checkpoint_every": 5.0,
+                "auto_expand": {"free_frac": 0.3, "factor": 2},
+                "replicates": 8,
+                "emitter": {"type": "null"},
+                "mesh": mesh,
+                "seed": 7,
+            }
+
+        with Experiment(cfg(None)) as exp:
+            ref = exp.run()
+        with Experiment(cfg({"replicates": 8})) as exp:
+            out = exp.run()
+        assert int(out.alive.shape[1]) > 8  # expansion actually fired
+        assert len(out.alive.sharding.device_set) == 8  # still split
+        for la, lb in zip(jax.tree.leaves(ref), jax.tree.leaves(out)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
     def test_gates_raise_at_construction(self):
         with pytest.raises(ValueError, match="needs 'replicates' set"):
             Experiment(
