@@ -57,9 +57,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "checkpoint_every": None,        # sim-seconds per segment (None: one segment)
     "timeline": None,                # media timeline (spatial models only)
     "overrides": {},                 # initial-state overrides
-    # Device mesh for sharded execution (spatial models only):
-    # {"agents": N, "space": M} -> shard_map over a global (N x M) mesh
-    # via parallel.ShardedSpatialColony; None -> single-program jit.
+    # Device mesh for sharded execution (lattice composites — spatial
+    # AND multi-species): {"agents": N, "space": M} -> shard_map over a
+    # global (N x M) mesh via parallel.ShardedSpatialColony /
+    # ShardedMultiSpeciesColony; None -> single-program jit.
     # Multi-host bring-up (parallel.initialize) happens automatically.
     # Optional "stripe" (default True) deals initially-alive rows
     # round-robin across agent shards (per-shard division pools start
@@ -188,14 +189,6 @@ class Experiment:
             # (MultiSpeciesColony, {name: Compartment})
             self.multi, self.compartment = built
             self.colony = None
-            if self.config["mesh"] and not replicate_mesh:
-                raise ValueError(
-                    "config 'mesh' with a multi-species composite: use "
-                    "parallel.ShardedMultiSpeciesColony directly (the "
-                    "Experiment mesh path wraps single-species spatial "
-                    "models); mesh={'replicates': N} works via "
-                    "'replicates'"
-                )
         elif isinstance(built, tuple):  # (SpatialColony, Compartment)
             self.spatial, self.compartment = built
             self.colony = self.spatial.colony
@@ -261,11 +254,24 @@ class Experiment:
             )
         self.runner = None
         if self.config["mesh"] and not replicate_mesh:
-            if self.spatial is None:
+            if self.spatial is None and self.multi is None:
                 raise ValueError(
-                    "config 'mesh' needs a spatial composite (lattice model)"
+                    "config 'mesh' needs a lattice composite (spatial "
+                    "or multi-species model)"
+                )
+            if self.multi is not None and self.config["auto_expand"]:
+                # the multi-species expansion path is host-side
+                # (multi.expanded gathers per species) — incompatible
+                # with a mesh run. Fail BEFORE distributed bring-up:
+                # initialize() can block on multi-host peers and a
+                # doomed config must not get that far.
+                raise ValueError(
+                    "auto_expand with a multi-species mesh is not "
+                    "supported yet (per-species expansion gathers to "
+                    "host); raise capacities or drop the mesh"
                 )
             from lens_tpu.parallel import (
+                ShardedMultiSpeciesColony,
                 ShardedSpatialColony,
                 global_mesh,
                 initialize,
@@ -273,12 +279,13 @@ class Experiment:
 
             initialize()  # multi-host no-op on one host
             m = self.config["mesh"]
-            self.runner = ShardedSpatialColony(
-                self.spatial,
-                global_mesh(
-                    n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
-                ),
+            gm = global_mesh(
+                n_agents=int(m["agents"]), n_space=int(m.get("space", 1))
             )
+            if self.multi is not None:
+                self.runner = ShardedMultiSpeciesColony(self.multi, gm)
+            else:
+                self.runner = ShardedSpatialColony(self.spatial, gm)
         # auto_expand is multi-host-safe on BOTH mesh forms: the
         # agent-mesh runner expands shard-locally on device
         # (_expand_sharded) and the replicate mesh pads device-locally
@@ -362,6 +369,14 @@ class Experiment:
                     overrides=self.config["overrides"] or None,
                     replicate_overrides=self.config["replicate_overrides"]
                     or None,
+                )
+            if self.runner is not None:
+                stripe = bool(self.config["mesh"].get("stripe", True))
+                return self.runner.initial_state(
+                    counts,
+                    key,
+                    stripe=stripe,
+                    overrides=self.config["overrides"] or None,
                 )
             return self.multi.initial_state(
                 counts,
@@ -542,6 +557,7 @@ class Experiment:
         if (
             not self.config["rebalance"]
             or self.runner is None
+            or self.colony is None  # multi-species runner: no rebalance yet
             or self.colony.division_trigger is None
         ):
             return state
@@ -926,6 +942,15 @@ class Experiment:
         self.multi = MultiSpeciesColony(
             species, self.multi.lattice, share_bins=self.multi.share_bins
         )
+        if self.runner is not None:
+            # the runner closed over the pre-adoption multi; a stale wrap
+            # would mint lineage ids at the pre-expansion stride (the
+            # same bug the single-species adoption path guards against)
+            from lens_tpu.parallel import ShardedMultiSpeciesColony
+
+            self.runner = ShardedMultiSpeciesColony(
+                self.multi, self.runner.mesh
+            )
 
     def close(self) -> None:
         self.emitter.close()

@@ -1211,6 +1211,54 @@ class TestMultiSpeciesExperiment:
         assert (np.asarray(ts["ecoli"]["division_backlog"]) == 0).all()
         assert "fields" in ts
 
+    def test_mesh_runs_multi_species_and_matches_unsharded(self):
+        """Config 'mesh' + a multi-species composite wires the
+        ShardedMultiSpeciesColony runner through the L5 layer. On a
+        deterministic variant (no division, sigma=0, stochastic
+        expression off) the sharded Experiment must reproduce the
+        unsharded one exactly."""
+        def cfg(mesh):
+            return {
+                "composite": "mixed_species_lattice",
+                "config": {
+                    "capacity": {"ecoli": 16, "scavenger": 16},
+                    "shape": (8, 8),
+                    "size": (8.0, 8.0),
+                    "division": False,
+                    "ecoli": {"motility": {"sigma": 0.0}},
+                    "scavenger": {"motility": {"sigma": 0.0},
+                                  "expression": None},
+                },
+                "n_agents": {"ecoli": 16, "scavenger": 16},
+                "total_time": 10.0,
+                "seed": 7,
+                # stripe off: row-for-row comparability to unsharded
+                "mesh": dict(mesh, stripe=False) if mesh else None,
+            }
+
+        with Experiment(cfg(None)) as exp:
+            ref = exp.run()
+        with Experiment(cfg({"agents": 4, "space": 2})) as exp:
+            assert exp.runner is not None
+            out = exp.run()
+        np.testing.assert_allclose(
+            np.asarray(out.fields), np.asarray(ref.fields),
+            rtol=1e-5, atol=1e-6,
+        )
+        for name in ref.species:
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+                ),
+                ref.species[name].agents,
+                out.species[name].agents,
+            )
+
+    def test_mesh_with_auto_expand_rejected_at_construction(self):
+        cfg = self.config(mesh={"agents": 4, "space": 2})
+        with pytest.raises(ValueError, match="multi-species mesh"):
+            Experiment(cfg)
+
     def test_checkpoint_resume_after_expansion(self, tmp_path):
         with Experiment(self.config(tmp_path)) as exp:
             full = exp.run()
