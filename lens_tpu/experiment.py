@@ -259,17 +259,6 @@ class Experiment:
                     "config 'mesh' needs a lattice composite (spatial "
                     "or multi-species model)"
                 )
-            if self.multi is not None and self.config["auto_expand"]:
-                # the multi-species expansion path is host-side
-                # (multi.expanded gathers per species) — incompatible
-                # with a mesh run. Fail BEFORE distributed bring-up:
-                # initialize() can block on multi-host peers and a
-                # doomed config must not get that far.
-                raise ValueError(
-                    "auto_expand with a multi-species mesh is not "
-                    "supported yet (per-species expansion gathers to "
-                    "host); raise capacities or drop the mesh"
-                )
             from lens_tpu.parallel import (
                 ShardedMultiSpeciesColony,
                 ShardedSpatialColony,
@@ -529,7 +518,10 @@ class Experiment:
                 for name in self.multi.species
             }
             if any(f > 1 for f in factors.values()):
-                self.multi, state = self.multi.expanded(state, factors)
+                if self.runner is not None:
+                    state = self._expand_sharded_multi(state, factors)
+                else:
+                    self.multi, state = self.multi.expanded(state, factors)
             return state
         cs = state.colony if isinstance(state, SpatialState) else state
         if not wants_growth(cs):
@@ -586,6 +578,40 @@ class Experiment:
                 out_shardings=mesh_shardings(mesh, colony_pspecs(cs)),
             )
         return state._replace(colony=fn(cs, n_blocks))
+
+    def _expand_sharded_multi(self, state, factors):
+        """Per-species capacity growth under a device mesh — the
+        multi-species counterpart of ``_expand_sharded``: each growing
+        species pads shard-locally on device
+        (:func:`~lens_tpu.parallel.mesh.expand_colony_rows_on_mesh`),
+        the shared lattice fields are untouched, and the runner is
+        rebuilt around the grown MultiSpeciesColony. Multi-host-safe for
+        the same reasons as the single-species path."""
+        from lens_tpu.environment.multispecies import MultiSpeciesColony
+        from lens_tpu.parallel import ShardedMultiSpeciesColony
+        from lens_tpu.parallel.mesh import expand_colony_rows_on_mesh
+
+        mesh = self.runner.mesh
+        step_now = self._state_step(state)
+        new_species = {}
+        new_states = {}
+        for name, sp in self.multi.species.items():
+            f = int(factors.get(name, 1))
+            cs = state.species[name]
+            if f <= 1:
+                new_species[name] = sp
+                new_states[name] = cs
+                continue
+            grown_colony = sp.colony.expanded_meta(step_now, f)
+            new_states[name] = expand_colony_rows_on_mesh(
+                cs, grown_colony, sp.colony.capacity, mesh
+            )
+            new_species[name] = sp.with_colony(grown_colony)
+        self.multi = MultiSpeciesColony(
+            new_species, self.multi.lattice, share_bins=self.multi.share_bins
+        )
+        self.runner = ShardedMultiSpeciesColony(self.multi, mesh)
+        return state._replace(species=new_states)
 
     def _expand_sharded(self, state, factor: int):
         """Capacity growth under a device mesh, entirely on device: each

@@ -1254,10 +1254,29 @@ class TestMultiSpeciesExperiment:
                 out.species[name].agents,
             )
 
-    def test_mesh_with_auto_expand_rejected_at_construction(self):
+    def test_mesh_auto_expand_grows_per_species_shard_locally(self):
+        """auto_expand composes with the multi-species mesh: each growing
+        species pads shard-locally on device (no host gather), fast
+        species expand while slow ones keep their capacity, populations
+        multiply, and lineage ids stay unique."""
         cfg = self.config(mesh={"agents": 4, "space": 2})
-        with pytest.raises(ValueError, match="multi-species mesh"):
-            Experiment(cfg)
+        # capacities divisible by the 4 agent shards at every factor
+        cfg["config"]["capacity"] = {"ecoli": 8, "scavenger": 8}
+        with Experiment(cfg) as exp:
+            state = exp.run()
+            assert exp.runner is not None
+        caps = {n: int(cs.alive.shape[0]) for n, cs in state.species.items()}
+        assert caps["ecoli"] > 8, caps
+        alive = {n: int(np.asarray(cs.alive).sum())
+                 for n, cs in state.species.items()}
+        assert alive["ecoli"] >= 4 * 6 - 4, alive
+        # expanded state kept the mesh split on the agent axis
+        assert len(state.species["ecoli"].alive.sharding.device_set) >= 4
+        for n, cs in state.species.items():
+            ids = np.asarray(cs.agents["lineage"]["cell_id"])[
+                np.asarray(cs.alive)
+            ]
+            assert len(np.unique(ids)) == len(ids), n
 
     def test_checkpoint_resume_after_expansion(self, tmp_path):
         with Experiment(self.config(tmp_path)) as exp:
@@ -1286,6 +1305,35 @@ class TestMultiSpeciesExperiment:
                 np.asarray(resumed.species[name].agents["global"]["volume"]),
                 err_msg=name,
             )
+
+    def test_sharded_checkpoint_resume_after_expansion(self, tmp_path):
+        """The newly-reachable intersection: mesh + multi-species +
+        auto_expand + checkpoint. Resume adopts the sidecar capacities,
+        rebuilds the ShardedMultiSpeciesColony around the grown multi
+        (stale wrap = colliding lineage ids), and the resumed run's
+        lifecycle invariants hold."""
+        def cfg(base, total):
+            c = self.config(tmp_path, total_time=total,
+                            mesh={"agents": 4, "space": 2})
+            c["checkpoint_dir"] = str(base)
+            return c
+
+        with Experiment(cfg(tmp_path / "a", 15.0)) as exp:
+            mid = exp.run()
+        assert int(mid.species["ecoli"].alive.shape[0]) > 8  # expanded
+        with Experiment(cfg(tmp_path / "a", 30.0)) as exp:
+            resumed = exp.resume()
+            assert exp.runner is not None
+            caps = {n: sp.colony.capacity
+                    for n, sp in exp.multi.species.items()}
+            assert caps["ecoli"] == int(
+                resumed.species["ecoli"].alive.shape[0]
+            )
+        for n, cs in resumed.species.items():
+            alive = np.asarray(cs.alive)
+            assert alive.sum() >= np.asarray(mid.species[n].alive).sum(), n
+            ids = np.asarray(cs.agents["lineage"]["cell_id"])[alive]
+            assert len(np.unique(ids)) == len(ids), n
 
     def test_scalar_n_agents_rejected(self):
         with pytest.raises(ValueError, match="per-species dict"):
