@@ -87,9 +87,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # (division backlog, free rows); iff BOTH are nonzero the rows are
     # re-dealt round-robin by alive-rank (parallel.mesh.
     # rebalance_colony_rows) so every shard regains an equal share of
-    # free rows. A no-op in balanced runs (the gate never fires) and on
-    # unsharded/ensemble/multi-species paths. Needs checkpoint_every
-    # (segments) to react mid-run, like auto_expand.
+    # free rows — per species on a multi-species mesh. A no-op in
+    # balanced runs (the gate never fires) and on unsharded/ensemble
+    # paths. Needs checkpoint_every (segments) to react mid-run, like
+    # auto_expand.
     "rebalance": True,
     # Replicate ensembles (colony.Ensemble): N independent copies of the
     # built sim stepped as ONE device program — the reference runs
@@ -546,38 +547,65 @@ class Experiment:
         See ``parallel.mesh.rebalance_colony_rows`` for why this is
         biology-neutral and why it cannot be shard-local.
         """
-        if (
-            not self.config["rebalance"]
-            or self.runner is None
-            or self.colony is None  # multi-species runner: no rebalance yet
-            or self.colony.division_trigger is None
-        ):
+        if not self.config["rebalance"] or self.runner is None:
             return state
-        from lens_tpu.parallel.mesh import (
-            AGENTS_AXIS,
-            colony_pspecs,
-            mesh_shardings,
-            rebalance_colony_rows,
-        )
+        from lens_tpu.parallel.mesh import AGENTS_AXIS
         from lens_tpu.utils.dicts import get_path
 
-        cs = state.colony
-        trig = get_path(cs.agents, self.colony.division_trigger)
-        backlog, free = _backlog_and_free(cs.alive, trig)
-        if int(backlog) == 0 or int(free) == 0:
-            return state
         mesh = self.runner.mesh
         n_blocks = mesh.shape[AGENTS_AXIS]
-        # one jitted program per Experiment (jit's own cache handles a
-        # post-expansion shape change; a fresh jit() per call would not)
+
+        def balanced(cs, trigger_path):
+            if trigger_path is None:
+                return cs
+            trig = get_path(cs.agents, trigger_path)
+            backlog, free = _backlog_and_free(cs.alive, trig)
+            if int(backlog) == 0 or int(free) == 0:
+                return cs
+            return self._rebalance_fn()(cs, n_blocks)
+
+        if self.multi is not None:
+            # per-species pools, per-species re-deals (species have
+            # independent row spaces; the shared fields are untouched)
+            return state._replace(
+                species={
+                    name: balanced(
+                        state.species[name], sp.colony.division_trigger
+                    )
+                    for name, sp in self.multi.species.items()
+                }
+            )
+        if self.colony.division_trigger is None:
+            return state
+        return state._replace(
+            colony=balanced(state.colony, self.colony.division_trigger)
+        )
+
+    def _rebalance_fn(self):
+        """One jitted re-deal program per Experiment (jit's own cache
+        handles shape/species changes; a fresh jit() per call would
+        retrace every segment). The output carries an explicit
+        agent-axis sharding constraint so the re-dealt state keeps the
+        runner's layout regardless of how the partitioner lowers the
+        cross-shard gather."""
         fn = getattr(self, "_rebalance_jit", None)
         if fn is None:
-            fn = self._rebalance_jit = jax.jit(
+            from lens_tpu.parallel.mesh import (
+                colony_pspecs,
+                mesh_shardings,
                 rebalance_colony_rows,
-                static_argnums=1,
-                out_shardings=mesh_shardings(mesh, colony_pspecs(cs)),
             )
-        return state._replace(colony=fn(cs, n_blocks))
+
+            mesh = self.runner.mesh
+
+            def reb(cs, n_blocks):
+                out = rebalance_colony_rows(cs, n_blocks)
+                return jax.lax.with_sharding_constraint(
+                    out, mesh_shardings(mesh, colony_pspecs(out))
+                )
+
+            fn = self._rebalance_jit = jax.jit(reb, static_argnums=1)
+        return fn
 
     def _expand_sharded_multi(self, state, factors):
         """Per-species capacity growth under a device mesh — the

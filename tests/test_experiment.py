@@ -1306,6 +1306,48 @@ class TestMultiSpeciesExperiment:
                 err_msg=name,
             )
 
+    def test_multi_species_rebalance_redeal_fires_per_species(self):
+        """The per-species re-deal path with the gate actually FIRING:
+        one species' alive rows packed into a single shard block with
+        every row triggered (backlog > 0, free > 0) gets re-dealt
+        across shards; the other species (gate quiet) is untouched
+        bitwise."""
+        from lens_tpu.utils.dicts import get_path, set_path
+
+        cfg = self.config(mesh={"agents": 4, "space": 2})
+        cfg["config"]["capacity"] = {"ecoli": 16, "scavenger": 16}
+        with Experiment(cfg) as exp:
+            state = exp.initial_state()
+            ecoli = state.species["ecoli"]
+            # all 4 alive rows in shard block 0 (16 rows / 4 shards),
+            # all triggered to divide -> starved pool, global free rows
+            alive = np.zeros(16, bool)
+            alive[:4] = True
+            trig_path = exp.multi.species["ecoli"].colony.division_trigger
+            agents = set_path(
+                ecoli.agents,
+                trig_path,
+                jnp.ones_like(get_path(ecoli.agents, trig_path)),
+            )
+            st = state._replace(
+                species=dict(
+                    state.species,
+                    ecoli=ecoli._replace(
+                        agents=agents, alive=jnp.asarray(alive)
+                    ),
+                )
+            )
+            out = exp._maybe_rebalance(st)
+        per_block = np.asarray(out.species["ecoli"].alive).reshape(4, 4)
+        assert (per_block.sum(axis=1) == 1).all(), per_block
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            st.species["scavenger"],
+            out.species["scavenger"],
+        )
+
     def test_sharded_checkpoint_resume_after_expansion(self, tmp_path):
         """The newly-reachable intersection: mesh + multi-species +
         auto_expand + checkpoint. Resume adopts the sidecar capacities,
