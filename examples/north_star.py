@@ -64,6 +64,12 @@ def main() -> None:
 
     n_segments = int(round(total / seg))
     emit_every = max(int(seg) // 10, 1)   # ~10 emits per segment
+    # ONE jitted segment program reused across the loop. Calling the raw
+    # multi.run per segment retraces (scan_schedule's closures are fresh
+    # per call) — measured on the full scenario: every 300 sim-s segment
+    # paid the full ~43 min XLA-CPU compile again. The Experiment layer
+    # caches its programs the same way (parallel.base.cached_jit).
+    window = jax.jit(lambda s: multi.run(s, seg, 1.0, emit_every=emit_every))
     t_wall0 = time.perf_counter()
     alive_series = []
     glc_series = []
@@ -71,7 +77,7 @@ def main() -> None:
     trajs = []
     for k in range(n_segments):
         t0 = time.perf_counter()
-        state, traj = multi.run(state, seg, 1.0, emit_every=emit_every)
+        state, traj = window(state)
         jax.block_until_ready(state)
         wall = time.perf_counter() - t0
         alive = {
