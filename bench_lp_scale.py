@@ -95,6 +95,7 @@ def main():
     # (equal answer quality, the apples-to-apples crossover; dense PDLP
     # is dominated by sparse and skipped to bound the run).
     cases = [(1e-4, 1, 256), (1e-4, 2, 256), (1e-4, 4, 64), (1e-4, 8, 16),
+             (1e-4, 16, 4),
              (1e-5, 1, 256), (1e-5, 2, 256), (1e-5, 4, 64), (1e-5, 8, 16)]
     single_opt = None
     for tol, k, batch in cases:
@@ -152,7 +153,9 @@ def main():
                 )),
             },
         }
-        if tol < 1e-4:
+        if tol < 1e-4 or k > 8:
+            # dense PDLP is dominated by sparse everywhere measured;
+            # at k=16 its O(M R) matvecs alone would run tens of minutes
             solvers.pop("pdlp_dense")
         n_rep = 3 if k <= 2 else 1
         for solver, fns in solvers.items():
