@@ -23,9 +23,11 @@ run() {
 }
 run bench_all.py          BENCH_ALL.json          BENCH_ALL_r05.json
 run bench_mfu.py          BENCH_MFU.json          BENCH_MFU_r05.json
+run bench_phases.py       BENCH_PHASES.json       BENCH_PHASES_r05.json
 run bench_diffusion_ab.py BENCH_DIFFUSION_AB.json BENCH_DIFFUSION_AB_r05.json
 run examples/north_star.py NORTH_STAR.json        NORTH_STAR.json
 run bench_lp_sizes.py     BENCH_LP_SIZES.json     BENCH_LP_SIZES_r05.json
+run bench_lp_scale.py     BENCH_LP_SCALE.json     BENCH_LP_SCALE_r05.json
 run bench_agents_sweep.py BENCH_AGENTS_SWEEP.json BENCH_AGENTS_SWEEP_r05.json
 # chip-scale example records (each writes its own committed JSON)
 for ex in full_core_colony ensemble param_scan cross_feeding chemotaxis; do
