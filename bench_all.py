@@ -6,8 +6,16 @@ jax provides, measuring steady-state throughput after one warm-up window
 (compile + cache). Output: one JSON object per line to stdout, plus
 ``BENCH_ALL.json`` with the full report.
 
-    python bench_all.py            # all configs
-    python bench_all.py 0 4        # a subset
+    python bench_all.py                    # all configs
+    python bench_all.py 0 4                # a subset
+    python bench_all.py --sampler=exact 4  # pin the Poisson sampler
+
+``--sampler=exact|hybrid`` threads the expression-stack sampler knob
+(ops.sampling) into the composites that carry stochastic expression
+(configs 3b/3p/3c/4) — the A/B lever for the round-6 hybrid-sampler
+fast path. Default: composite defaults (hybrid since round 6). It also
+reaches config 1's toggle_colony, where it is INERT under the default
+ODE integrator (the toggle reads it only under method="tau_leap").
 """
 
 from __future__ import annotations
@@ -21,6 +29,13 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/lens_tpu_jax_cache")
 
 WINDOW_S = 32.0  # sim-seconds measured per config (dt = 1s)
+
+#: set by --sampler=...; None = composite defaults
+_SAMPLER: str | None = None
+
+
+def _sampler_cfg() -> dict:
+    return {"sampler": _SAMPLER} if _SAMPLER else {}
 
 
 def _measure(build_window, n_agents):
@@ -63,7 +78,7 @@ def config_1():
     from lens_tpu.models.composites import toggle_colony
 
     n = 1024
-    colony = Colony(toggle_colony({}), capacity=n)
+    colony = Colony(toggle_colony(_sampler_cfg()), capacity=n)
 
     def build():
         state = colony.initial_state(n, key=jax.random.PRNGKey(0))
@@ -150,6 +165,7 @@ def _rfba_bench(key, n, metabolism, genes, scenario):
             "shape": (64, 64),
             "metabolism": metabolism,
             "expression": {"genes": genes},
+            **_sampler_cfg(),
         }
     )
 
@@ -223,6 +239,7 @@ def config_4():
         {
             "capacity": {"ecoli": 51200, "scavenger": 51200},
             "shape": (256, 256),
+            **_sampler_cfg(),
         }
     )
 
@@ -367,10 +384,18 @@ def main() -> None:
     def _key(a: str):
         return int(a) if a.isdigit() else a
 
-    wanted = [_key(a) for a in sys.argv[1:]] or list(CONFIGS)
+    global _SAMPLER
+    args = []
+    for a in sys.argv[1:]:
+        if a.startswith("--sampler="):
+            _SAMPLER = a.split("=", 1)[1]
+        else:
+            args.append(a)
+    wanted = [_key(a) for a in args] or list(CONFIGS)
     report = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
+        "sampler": _SAMPLER or "default",
         "results": [],
     }
     for k in wanted:

@@ -51,12 +51,20 @@ WINDOW_S = 32.0          # TPU window; CPU runs shrink it (see main)
 KINETIC_FLOPS = 540.0
 #: per-gene-per-step FLOPs of the tau-leap expression block. XLA-DERIVED
 #: the same way (difference of the 3b biology step with and without the
-#: expression process): 3016 per gene — dominated by the threefry-based
-#: Poisson draws (4 reaction channels x ~750 FLOPs/draw), NOT the
-#: propensity arithmetic the old constant (40) modeled: a 75x
-#: undercount. The RNG cost being ~15% of config 3b's per-agent budget
-#: is a real profile fact, not noise.
-GENE_FLOPS = 3000.0
+#: expression process): 3959.6 per gene under the HYBRID Poisson sampler
+#: (ops.sampling, the round-6 default). Counter caveat discovered while
+#: re-deriving: tau_leap_window scans its substeps INTERNALLY, so even
+#: the "isolated step" counts the substep body once (not x substeps) —
+#: and the two samplers sit on opposite sides of that counter. The
+#: hybrid's fixed-trip inversion is an unrolled loop (fully counted)
+#: plus a bulk uniform block OUTSIDE the scan (fully counted); the old
+#: exact sampler's rejection loops were lax.while bodies (counted
+#: ONCE). That is why this constant ROSE from the round-5 value (3016,
+#: exact sampler) while the measured expression wall-clock dropped
+#: ~8.5x (BENCH_PHASES_CPU_r06.json): the constant follows XLA's
+#: counted-once convention, the bench records follow the wall clock.
+#: Re-derive with `python bench_mfu.py --validate`.
+GENE_FLOPS = 3960.0
 
 
 def _stencil_flops(lattice, steps):

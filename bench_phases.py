@@ -21,6 +21,12 @@ free, the phases fuse"). The TPU run of this file is the trace-level
 answer to "where does the window's time go"; the CPU record is the
 methodology anchor.
 
+A fourth program family isolates the EXPRESSION phase of config 4 (the
+north-star scenario): the scavenger species' biology window with the
+stochastic-expression process under each Poisson sampler
+(``ops.sampling``) and with it dropped — the subtraction prices the
+phase and the exact/hybrid ratio records the sampler fast-path win.
+
 Writes BENCH_PHASES.json; one JSON line per config.
 """
 
@@ -90,6 +96,58 @@ def _config_rows(name, spatial, n, window_s):
     return row
 
 
+def _config4_expression_ab(window_s):
+    """Expression-phase A/B for config 4 (the north-star scenario).
+
+    The scavenger species carries the colony's only stochastic
+    expression process, so its BIOLOGY-only window isolates the phase:
+    time it with expression under each sampler (ops.sampling) and with
+    the expression process dropped; ``expression_<sampler> = with -
+    without`` is the phase cost, and the exact/hybrid ratio is the
+    fast-path win the round-6 tentpole claims.
+    """
+    import jax
+
+    from lens_tpu.models.composites import mixed_species_lattice
+
+    n = 51200  # the config-4 scavenger capacity (BASELINE.json)
+    times = {}
+    for label, overrides in (
+        ("none", {"scavenger": {"expression": None}}),
+        ("exact", {"sampler": "exact"}),
+        ("hybrid", {}),  # composite default
+    ):
+        multi, _ = mixed_species_lattice(
+            {
+                "capacity": {"ecoli": 64, "scavenger": n},
+                "shape": (256, 256),
+                **overrides,
+            }
+        )
+        colony = multi.species["scavenger"].colony
+        cs = colony.initial_state(n, key=jax.random.PRNGKey(0))
+        steps = int(round(window_s))
+        biology = jax.jit(
+            lambda s, c=colony: c.run(s, window_s, 1.0, emit_every=steps)[0]
+        )
+        times[label] = _timed(biology, cs)
+    expr_exact = times["exact"] - times["none"]
+    expr_hybrid = times["hybrid"] - times["none"]
+    row = {
+        "config": "4-expression",
+        "agents": n,
+        "window_s": window_s,
+        "biology_none_s": round(times["none"], 4),
+        "biology_exact_s": round(times["exact"], 4),
+        "biology_hybrid_s": round(times["hybrid"], 4),
+        "expression_exact_s": round(expr_exact, 4),
+        "expression_hybrid_s": round(expr_hybrid, 4),
+        "expression_speedup": round(expr_exact / max(expr_hybrid, 1e-9), 2),
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def main():
     guard_accelerator_or_exit()
     import jax
@@ -99,6 +157,8 @@ def main():
     backend = jax.default_backend()
     window_s = WINDOW_S if backend != "cpu" else 8.0
     rows = []
+
+    rows.append(_config4_expression_ab(window_s))
 
     spatial2, _ = ecoli_lattice({"capacity": 10240})
     rows.append(_config_rows("2", spatial2, 10240, window_s))

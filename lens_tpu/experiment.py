@@ -74,8 +74,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # rebuilt at ``factor`` x capacity (Colony.expanded — pre-expansion
     # trajectory bitwise unchanged, lineage ids collision-free).
     # None disables. Requires checkpoint_every (segments) to react
-    # mid-run. Composes with a single-host "mesh" (fresh rows are dealt
-    # evenly across agent shards); multi-host meshes not yet.
+    # mid-run. Composes with agent/space meshes on single- AND
+    # multi-host runs (each shard pads its own block on device —
+    # ``_expand_sharded``/``_expand_sharded_multi``) and with replicate
+    # meshes (device-local pad, ``ShardedEnsemble.expanded``).
     # {"free_frac": 0.2, "factor": 2, "max_capacity": None}
     "auto_expand": None,
     # Segment-boundary division-pool rebalance (sharded runs only):
@@ -106,6 +108,16 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # meshes (gated at construction).
     "replicates": None,
     "replicate_overrides": {},
+    # Poisson event sampler for the stochastic-expression stack
+    # (ops.sampling): None defers to the composite/process defaults
+    # ("hybrid", the batched fast path); "exact" pins every expression
+    # process in the composite to jax.random.poisson — bitwise-
+    # compatible with checkpoints recorded before the fast path (the
+    # two samplers consume the PRNG key differently, so the knob that
+    # produced a checkpoint must also resume it). Threaded into the
+    # composite config as its top-level "sampler" key; an explicit
+    # per-process sampler in "config" still wins.
+    "sampler": None,
 }
 
 
@@ -181,6 +193,13 @@ class Experiment:
             from lens_tpu.parallel import initialize
 
             initialize()
+        if self.config["sampler"] is not None:
+            # experiment-level sampler knob -> composite top-level key
+            # (composites _thread_sampler it into their expression
+            # processes; a sampler already set in "config" wins)
+            self.config["config"] = deep_merge(
+                {"sampler": self.config["sampler"]}, self.config["config"]
+            )
         built = composite_registry[name](self.config["config"])
         self.spatial: Optional[SpatialColony] = None
         self.multi = None  # MultiSpeciesColony composites (config 4)
@@ -671,9 +690,58 @@ class Experiment:
 
         return os.path.join(self.config["checkpoint_dir"], "colony_meta.json")
 
+    def _lp_solver_map(self) -> Dict[str, str]:
+        """{process path: lp_solver} for every FBAMetabolism in the built
+        model (multi-species paths are "<species>/<process>"). Recorded
+        in the sidecar because switching solvers changes the packed
+        lp_state warm-vector LENGTH — a checkpoint taken with one solver
+        cannot restore through the other, and without this record the
+        failure surfaces as an opaque shape mismatch deep in restore."""
+        from lens_tpu.processes.fba_metabolism import FBAMetabolism
+
+        def solvers(compartment, prefix=""):
+            return {
+                prefix + pname: str(proc.config["lp_solver"])
+                for pname, proc in compartment.processes.items()
+                if isinstance(proc, FBAMetabolism)
+            }
+
+        if self.multi is not None:
+            out: Dict[str, str] = {}
+            for sname, sp in self.multi.species.items():
+                out.update(solvers(sp.colony.compartment, f"{sname}/"))
+            return out
+        return solvers(self.compartment)
+
+    def _sampler_map(self) -> Dict[str, str]:
+        """{process path: sampler} for every STOCHASTIC process carrying
+        a Poisson-sampler knob (ops.sampling). Recorded in the sidecar
+        because the two samplers consume the PRNG key differently: a
+        sampler-switched resume restores cleanly but silently continues
+        on a DIFFERENT trajectory than the run that wrote the
+        checkpoint — the same silent-mismatch class the lp_solver
+        record guards against, minus even the shape error."""
+
+        def samplers(compartment, prefix=""):
+            return {
+                prefix + pname: proc.config["sampler"]
+                for pname, proc in compartment.processes.items()
+                if getattr(proc, "stochastic", False)
+                and isinstance(proc.config.get("sampler"), str)
+            }
+
+        if self.multi is not None:
+            out: Dict[str, str] = {}
+            for sname, sp in self.multi.species.items():
+                out.update(samplers(sp.colony.compartment, f"{sname}/"))
+            return out
+        return samplers(self.compartment)
+
     def _save_colony_meta(self) -> None:
         """Sidecar for resume: expansion changes capacity and the lineage
-        id offset, neither of which is derivable from the config alone."""
+        id offset, neither of which is derivable from the config alone;
+        ``lp_solvers`` records which LP engine shaped any packed
+        warm-start state (see ``_lp_solver_map``)."""
         from lens_tpu.parallel.distributed import is_coordinator
 
         if not is_coordinator():
@@ -693,6 +761,8 @@ class Experiment:
                 "capacity": self.colony.capacity,
                 "id_offset": self.colony.id_offset,
             }
+        meta["lp_solvers"] = self._lp_solver_map()
+        meta["samplers"] = self._sampler_map()
         with open(self._colony_meta_path(), "w") as f:
             json.dump(meta, f)
 
@@ -818,6 +888,7 @@ class Experiment:
         """
         if self.checkpointer is None:
             raise ValueError("resume() needs checkpoint_dir in the config")
+        self._check_resume_sidecar()
         state = self.checkpointer.restore()
         self._adopt_restored_capacity(state)
         if self.ensemble_runner is not None:
@@ -834,6 +905,69 @@ class Experiment:
             return self.run(state, verbose=verbose)
         finally:
             self.config["total_time"] = original
+
+    def _check_resume_sidecar(self) -> None:
+        """Fail a mismatched resume BEFORE restore, descriptively.
+
+        Two recorded hazards: a switched ``lp_solver`` (the packed
+        lp_state warm vector is sized per solver, so restoring through
+        the wrong one dies as an opaque shape mismatch deep in orbax)
+        and a switched Poisson ``sampler`` (restores cleanly but the
+        trajectory silently diverges from the run that wrote the
+        checkpoint — see ``_sampler_map``). An absent ``lp_solvers``
+        key passes through (either solver may have written it); an
+        absent ``samplers`` key defaults to "exact", the only stream
+        that existed before the record."""
+        import os
+
+        meta_path = self._colony_meta_path()
+        if not os.path.exists(meta_path):
+            return
+        with open(meta_path) as f:
+            meta = json.load(f)
+
+        def mismatches(saved, current):
+            return {
+                path: (was, current[path])
+                for path, was in (saved or {}).items()
+                if path in current and current[path] != was
+            }
+
+        bad = mismatches(meta.get("lp_solvers"), self._lp_solver_map())
+        if bad:
+            detail = "; ".join(
+                f"{path}: checkpoint={was!r}, config={now!r}"
+                for path, (was, now) in sorted(bad.items())
+            )
+            raise ValueError(
+                f"lp_solver mismatch at resume ({detail}) — the packed "
+                f"lp_state warm-start layout differs between solvers, so "
+                f"this checkpoint cannot restore under the configured "
+                f"solver; set metabolism lp_solver back to the "
+                f"checkpoint's value (or start a fresh run)"
+            )
+        current_samplers = self._sampler_map()
+        saved_samplers = meta.get("samplers")
+        if saved_samplers is None:
+            # Pre-round-6 sidecar: the exact (jax.random.poisson) stream
+            # was the only implementation, so an absent record MEANS
+            # "exact" — without this default, every old checkpoint would
+            # silently resume on the new hybrid default stream, the
+            # precise hazard this check exists to fail loudly on.
+            saved_samplers = {path: "exact" for path in current_samplers}
+        bad = mismatches(saved_samplers, current_samplers)
+        if bad:
+            detail = "; ".join(
+                f"{path}: checkpoint={was!r}, config={now!r}"
+                for path, (was, now) in sorted(bad.items())
+            )
+            raise ValueError(
+                f"Poisson sampler mismatch at resume ({detail}) — the "
+                f"samplers consume the PRNG key differently, so the "
+                f"resumed trajectory would silently diverge from the run "
+                f"that wrote this checkpoint; set 'sampler' back to the "
+                f"checkpoint's value (or start a fresh run to switch)"
+            )
 
     def _adopt_restored_capacity(self, state) -> None:
         """A checkpoint written after auto-expansion has more rows than

@@ -50,6 +50,21 @@ def _cfg(defaults: dict, config: Mapping | None) -> dict:
     return deep_merge(defaults, config)
 
 
+def _thread_sampler(c: dict, *process_cfgs: dict) -> None:
+    """Composite-level ``sampler`` knob -> the named process configs
+    (``setdefault``: an explicit per-process sampler wins). ``None``
+    leaves process defaults alone — the expression processes default to
+    "hybrid" themselves; the knob exists so one experiment-config key
+    can pin the WHOLE composite to "exact" (oracle runs, resuming
+    pre-fast-path checkpoints) without spelunking nested configs."""
+    sampler = c.get("sampler")
+    if sampler is None:
+        return
+    for cfg in process_cfgs:
+        if cfg is not None:
+            cfg.setdefault("sampler", sampler)
+
+
 def _death_trigger_of(compartment: Compartment):
     """The compartment's death flag, if it has one.
 
@@ -220,7 +235,11 @@ def minimal_ode(config: Mapping | None = None) -> Compartment:
 @register_composite
 def toggle_colony(config: Mapping | None = None) -> Compartment:
     """Config 1: 4-species toggle-switch expression cell (no lattice)."""
-    c = _cfg({"toggle_switch": {}, "growth": {}, "divide": {}}, config)
+    c = _cfg(
+        {"toggle_switch": {}, "growth": {}, "divide": {}, "sampler": None},
+        config,
+    )
+    _thread_sampler(c, c["toggle_switch"])
     return Compartment(
         processes={
             "toggle_switch": ToggleSwitch(c["toggle_switch"]),
@@ -272,9 +291,11 @@ def hybrid_cell(config: Mapping | None = None) -> Compartment:
     ``Colony.initial_state`` (see StochasticExpression docstring).
     """
     c = _cfg(
-        {"expression": {}, "glucose_pts": {}, "growth": {}, "divide": {}},
+        {"expression": {}, "glucose_pts": {}, "growth": {}, "divide": {},
+         "sampler": None},
         config,
     )
+    _thread_sampler(c, c["expression"])
     return Compartment(
         processes={
             "expression": StochasticExpression(c["expression"]),
@@ -561,9 +582,11 @@ def rfba_lattice(
             "divide": {},
             "motility": {"sigma": 0.5},
             "division": True,
+            "sampler": None,
         },
         config,
     )
+    _thread_sampler(c, c["expression"])
     c["metabolism"], c["diffusion"], c["initial"] = _rfba_network_fill(
         c["metabolism"], c["diffusion"], c["initial"]
     )
@@ -755,9 +778,11 @@ def mixed_species_lattice(
                 "divide": {},
                 "motility": {"sigma": 0.5},
             },
+            "sampler": None,
         },
         config,
     )
+    _thread_sampler(c, c["scavenger"]["expression"])
     from lens_tpu.environment.multispecies import MultiSpeciesColony
 
     lattice = _make_lattice(

@@ -1,3 +1,18 @@
 from lens_tpu.ops.integrate import odeint_window, rk4_step, heun_step, euler_step
+from lens_tpu.ops.sampling import (
+    poisson_from_uniform,
+    poisson_hybrid,
+    sample_poisson,
+    uniform_block,
+)
 
-__all__ = ["odeint_window", "rk4_step", "heun_step", "euler_step"]
+__all__ = [
+    "odeint_window",
+    "rk4_step",
+    "heun_step",
+    "euler_step",
+    "poisson_from_uniform",
+    "poisson_hybrid",
+    "sample_poisson",
+    "uniform_block",
+]

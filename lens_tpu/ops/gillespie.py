@@ -19,6 +19,22 @@ against exact SSA and analytic stationary moments.
 
 ``ssa_exact`` is a host-side numpy oracle (the reference-fidelity
 implementation tests compare against); never call it in device code.
+
+**Samplers.** The Poisson event draw is the measured hot spot of the
+expression stack (~750 FLOPs/draw of threefry-based rejection in
+``jax.random.poisson`` — ``bench_mfu.py`` round 5), so both entry
+points take a ``sampler`` argument (``ops.sampling``):
+
+- ``"exact"`` (ops-level default): ``jax.random.poisson`` with the
+  original per-substep key split — bitwise-identical RNG consumption
+  to the pre-fast-path code, for oracle tests and resuming checkpoints
+  recorded under it.
+- ``"hybrid"``: the batched quantile-transform sampler. The window
+  draws ONE fused ``[n_substeps, R]`` uniform threefry block up front
+  and pushes slices through the hybrid inverse CDF — exact inversion
+  below ``threshold`` mean events, normal+Cornish–Fisher above (error
+  budget in ``ops.sampling``; well under the tau-leap bias this module
+  already accepts). The expression processes default to this path.
 """
 
 from __future__ import annotations
@@ -29,24 +45,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lens_tpu.ops.sampling import (
+    DEFAULT_THRESHOLD,
+    check_sampler,
+    poisson_from_uniform,
+    uniform_block,
+)
+
 Array = jax.Array
 PropensityFn = Callable[[Array], Array]  # counts [S] -> propensities [R]
 
 
-def tau_leap_step(
-    key: Array,
-    counts: Array,
-    stoich: Array,
-    propensity_fn: PropensityFn,
-    tau: Array | float,
-) -> Array:
-    """One tau-leap: counts [S] -> counts [S]. Pure, jit/vmap-safe.
-
-    stoich: [R, S] net change per firing of each reaction.
-    """
-    a = propensity_fn(counts)  # [R]
-    events = jax.random.poisson(key, jnp.maximum(a, 0.0) * tau)  # [R] int
-    events = events.astype(jnp.float32)
+def _fire(counts: Array, stoich: Array, events: Array) -> Array:
+    """Apply capped/clamped reaction firings: counts [S] -> counts [S]."""
     # Cap each channel by what its consumed species allow (pre-leap).
     consumed = jnp.maximum(-stoich, 0.0)  # [R, S] units consumed per firing
     supportable = jnp.where(
@@ -63,6 +74,31 @@ def tau_leap_step(
     return jnp.maximum(new, 0.0)
 
 
+def tau_leap_step(
+    key: Array,
+    counts: Array,
+    stoich: Array,
+    propensity_fn: PropensityFn,
+    tau: Array | float,
+    sampler: str = "exact",
+    threshold: float = DEFAULT_THRESHOLD,
+) -> Array:
+    """One tau-leap: counts [S] -> counts [S]. Pure, jit/vmap-safe.
+
+    stoich: [R, S] net change per firing of each reaction.
+    """
+    check_sampler(sampler)
+    a = propensity_fn(counts)  # [R]
+    lam = jnp.maximum(a, 0.0) * tau
+    if sampler == "exact":
+        events = jax.random.poisson(key, lam).astype(jnp.float32)  # [R]
+    else:
+        events = poisson_from_uniform(
+            uniform_block(key, lam.shape), lam, threshold
+        )
+    return _fire(counts, stoich, events)
+
+
 def tau_leap_window(
     key: Array,
     counts: Array,
@@ -70,15 +106,35 @@ def tau_leap_window(
     propensity_fn: PropensityFn,
     timestep: Array | float,
     n_substeps: int,
+    sampler: str = "exact",
+    threshold: float = DEFAULT_THRESHOLD,
 ) -> Array:
-    """Advance ``timestep`` in ``n_substeps`` leaps via lax.scan."""
+    """Advance ``timestep`` in ``n_substeps`` leaps via lax.scan.
+
+    Under ``sampler="hybrid"`` the WHOLE window's randomness is one
+    fused uniform block ``[n_substeps, R]`` drawn before the scan (one
+    threefry batch per window per agent — and one per colony once the
+    caller vmaps), scanned over alongside the counts.
+    """
+    check_sampler(sampler)
     tau = timestep / n_substeps
-    keys = jax.random.split(key, n_substeps)
+    if sampler == "exact":
+        keys = jax.random.split(key, n_substeps)
 
-    def body(c, k):
-        return tau_leap_step(k, c, stoich, propensity_fn, tau), None
+        def body(c, k):
+            return tau_leap_step(k, c, stoich, propensity_fn, tau), None
 
-    out, _ = jax.lax.scan(body, counts, keys)
+        out, _ = jax.lax.scan(body, counts, keys)
+        return out
+
+    n_reactions = stoich.shape[0]
+    u = uniform_block(key, (n_substeps, n_reactions))
+
+    def body(c, u_t):
+        lam = jnp.maximum(propensity_fn(c), 0.0) * tau
+        return _fire(c, stoich, poisson_from_uniform(u_t, lam, threshold)), None
+
+    out, _ = jax.lax.scan(body, counts, u)
     return out
 
 

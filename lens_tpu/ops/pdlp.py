@@ -171,7 +171,7 @@ def pdlp_box(
     b: jnp.ndarray,
     lb: jnp.ndarray,
     ub: jnp.ndarray,
-    n_iter: int = 16384,
+    n_iter: int = 32768,
     tol: float = 1e-4,
     restart_every: int = 512,
     warm: PDLPWarm | None = None,
@@ -202,6 +202,15 @@ def pdlp_box(
     restart boundaries, so reported iterations quantize to
     ``restart_every``). Infeasible problems come back ``converged=False``
     with a large residual; no exceptions inside jit.
+
+    The default cap sits ABOVE the measured cold-start envelope
+    (13k–25k iterations on the tiled-network sweep,
+    ``BENCH_LP_SCALE_CPU_r05.json``) because an undersized cap is
+    STICKY: a failed solve returns ``warm.flag = 0``, so a warm-start
+    caller discards the iterate and repeats the same doomed cold solve
+    every step — the problem never converges and the caller silently
+    stalls. Size any override against the cold start, not the (far
+    cheaper) warm-started steady state.
     """
     import numpy as np
 
@@ -232,10 +241,16 @@ def pdlp_box(
 def _pdlp_sparse_impl(c, A, b, lb, ub, n_iter, tol, restart_every, warm):
     """Host-side (numpy) equilibration + COO pattern extraction, then the
     shared PDHG core with segment-sum matvecs. ``A`` must be concrete;
-    ``b``/``c``/``lb``/``ub`` may be traced (they are scaled in-trace)."""
+    ``b``/``c``/``lb``/``ub`` may be traced (they are scaled in-trace).
+
+    Same ``result_type`` dtype promotion as ``_pdlp_box_impl``: under
+    ``sparse="auto"`` the solve's precision must not silently depend on
+    A's density — a float64 problem stays float64 on either path (the
+    host-side pattern precompute is float64 regardless and only cast
+    at the end)."""
     import numpy as np
 
-    dtype = jnp.float32
+    dtype = jnp.result_type(c.dtype, jnp.float32)
     An = np.asarray(A, np.float64)
     m, r = An.shape
     # Ruiz on host, float64 — the SAME _ruiz_scales the dense path runs
@@ -446,13 +461,18 @@ def flux_balance_pdlp(
     objective: jnp.ndarray,
     lb: jnp.ndarray,
     ub: jnp.ndarray,
-    n_iter: int = 16384,
+    n_iter: int = 32768,
     tol: float = 1e-4,
     leak: float = 0.0,
     warm: PDLPWarm | None = None,
     sparse: bool | str = "auto",
 ) -> PDLPResult:
     """FBA via PDLP: ``max objective @ v  s.t. S @ v = 0, lb <= v <= ub``.
+
+    ``n_iter`` matches the ``pdlp_box`` default (32768, above the
+    measured cold-start envelope — see its docstring for why an
+    undersized cap is a sticky warm-start hazard) and the
+    ``fba_metabolism`` process config's ``pdlp_iterations``.
 
     Drop-in analogue of :func:`lens_tpu.ops.linprog.flux_balance` (same
     leak-slack relaxation, same batching contract) built on the

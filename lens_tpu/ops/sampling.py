@@ -1,0 +1,188 @@
+"""Batched hybrid Poisson sampling — the stochastic-expression RNG fast path.
+
+``jax.random.poisson`` is correct but expensive inside the tau-leap hot
+loop: every draw runs Knuth/transformed-rejection loops that each burn
+fresh threefry invocations, measured at ~750 FLOPs per draw on the
+expression step (``bench_mfu.py`` round 5 — the Poisson RNG, not the
+propensity arithmetic, dominated ``GENE_FLOPS``). The TPU Monte-Carlo
+literature keeps the chip fed with batched, counter-based sampling and
+cheap large-mean approximations instead (Ising on TPU clusters, arXiv
+1903.11714); this module is that technique for the expression stack.
+
+The sampler is a **quantile transform**: one uniform per draw, pushed
+through the Poisson inverse CDF,
+
+- **small means** (``lam <= threshold``): exact sequential CDF inversion
+  with a FIXED trip count — ``k = #{i : u > CDF(i)}`` with the pmf
+  recurrence ``p_{i+1} = p_i * lam / (i+1)``. ~4 FLOPs per unrolled term
+  (the trip count is static in ``threshold``), and distributionally
+  EXACT to float32 CDF resolution.
+- **large means** (``lam > threshold``): normal quantile with
+  Cornish–Fisher skewness correction and continuity rounding,
+  ``floor(lam + sqrt(lam) z + (z^2-1)/6 + (z^3-7z)/(36 sqrt(lam)) + 1/2)``
+  with ``z = ndtri(u)``. Approximate by construction: the pmf
+  discrepancy (chi-square divergence per sample) peaks at ~7e-4 right
+  above the default threshold and decays like ~1/lam^2 (calibrated in
+  ``tests/test_sampling.py``, which pins a 2e-3 bound); means/variances
+  match to sampling noise. This sits well below the tau-leap
+  discretization bias the expression processes already accept
+  (``ops.gillespie`` docstring) — shrink ``tau`` before worrying about
+  this term.
+
+Both branches are elementwise and fused under ``jnp.where`` (no
+data-dependent control flow), so the sampler stays jit/vmap/shard_map
+compatible and costs ~200 FLOPs per draw regardless of regime — the
+~3.5x per-draw win ``BENCH_PHASES_CPU_r06.json`` records.
+
+The second half of the win is RNG **batching**: callers that need many
+draws per step (tau-leap windows draw ``[n_substeps, R]`` events) should
+draw ONE fused uniform block with :func:`uniform_block` and feed slices
+to :func:`poisson_from_uniform` — a single threefry batch per expression
+window instead of per-channel per-draw key folding
+(``ops.gillespie.tau_leap_window`` does exactly this).
+
+The ``sampler="exact"`` escape hatch routes to ``jax.random.poisson``
+unchanged — bitwise-identical to the pre-fast-path code, kept for oracle
+tests and resume flows of checkpoints recorded under the exact sampler
+(the two samplers consume the PRNG key differently, so switching mid-run
+changes the trajectory — correctness-neutral, but not bitwise).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: Regime split. Below: exact fixed-trip CDF inversion; above: normal +
+#: Cornish–Fisher quantile. 10 balances the inversion trip count (44
+#: terms) against where the CF approximation is already good (~7e-4 pmf
+#: divergence at the boundary, decaying fast).
+DEFAULT_THRESHOLD = 10.0
+
+#: Hard ceiling on the threshold knob: the inversion branch starts from
+#: ``exp(-lam)``, which UNDERFLOWS float32 near lam ~ 87 — past that the
+#: pmf recurrence is identically zero and every draw returns the trip
+#: count, deterministically (silently wrong, zero variance). 80 keeps
+#: an order-of-magnitude margin above the float32 normal minimum.
+MAX_THRESHOLD = 80.0
+
+SAMPLERS = ("hybrid", "exact")
+
+
+def check_threshold(threshold: float) -> float:
+    """Validate the regime-split knob at trace/config time."""
+    t = float(threshold)
+    if not 0.0 <= t <= MAX_THRESHOLD:
+        raise ValueError(
+            f"sampler threshold must be in [0, {MAX_THRESHOLD}] (float32 "
+            f"exp(-lam) underflows past ~87, making the inversion branch "
+            f"deterministically wrong), got {threshold!r}"
+        )
+    return t
+
+
+def check_sampler(sampler: str) -> str:
+    """Validate a sampler name at trace/config time (not mid-jit)."""
+    if sampler not in SAMPLERS:
+        raise ValueError(
+            f"sampler must be one of {SAMPLERS}, got {sampler!r}"
+        )
+    return sampler
+
+
+def inversion_trip_count(threshold: float) -> int:
+    """Static trip count of the small-mean inversion: covers the
+    Poisson(threshold) tail to ~1e-14 (8.5 sigma + 7), so the fixed
+    loop's truncation is invisible at float32 CDF resolution."""
+    t = max(float(threshold), 0.0)
+    return int(math.ceil(t + 8.5 * math.sqrt(t) + 7.0))
+
+
+def uniform_block(key: Array, shape) -> Array:
+    """One fused threefry batch of uniforms in [0, 1) — THE bulk-RNG
+    block callers slice per substep/channel (one device RNG op per
+    expression window, however many draws it feeds)."""
+    return jax.random.uniform(key, shape, jnp.float32)
+
+
+def poisson_from_uniform(
+    u: Array,
+    lam: Array,
+    threshold: float = DEFAULT_THRESHOLD,
+) -> Array:
+    """Poisson(lam) counts from uniforms by hybrid inverse-CDF transform.
+
+    ``u`` and ``lam`` broadcast elementwise; returns float32 counts (the
+    expression stack keeps molecule counts as exact-integer floats, see
+    ``ops.gillespie``). Monotone in ``u`` (a true quantile transform),
+    so common-random-number comparisons across parameters stay coupled.
+    """
+    threshold = check_threshold(threshold)
+    dtype = jnp.float32
+    lam = jnp.asarray(lam, dtype)
+    u = jnp.asarray(u, dtype)
+
+    # -- small regime: exact sequential inversion, fixed trip count.
+    # min() keeps exp(-lam) from underflowing when the element actually
+    # belongs to the large branch (the where() below discards this lane).
+    small_lam = jnp.minimum(lam, threshold)
+    p = jnp.exp(-small_lam)
+    c = p
+    k = jnp.zeros(jnp.broadcast_shapes(u.shape, lam.shape), dtype)
+    for i in range(1, inversion_trip_count(threshold) + 1):
+        k = k + (u > c).astype(dtype)
+        p = p * (small_lam * (1.0 / i))
+        c = c + p
+
+    # -- large regime: normal + Cornish–Fisher skew term + continuity
+    # rounding. max() keeps sqrt/1/sqrt finite when the element belongs
+    # to the small branch (0 * inf would poison the where()).
+    big_lam = jnp.maximum(lam, threshold)
+    z = jax.scipy.special.ndtri(
+        jnp.clip(u, jnp.finfo(dtype).tiny, 1.0 - jnp.finfo(dtype).epsneg)
+    )
+    s = jnp.sqrt(big_lam)
+    w = (
+        big_lam
+        + s * z
+        + (z * z - 1.0) / 6.0
+        + (z * z * z - 7.0 * z) / (36.0 * s)
+    )
+    big = jnp.maximum(jnp.floor(w + 0.5), 0.0)
+
+    return jnp.where(lam <= threshold, k, big)
+
+
+def poisson_hybrid(
+    key: Array,
+    lam: Array,
+    threshold: float = DEFAULT_THRESHOLD,
+) -> Array:
+    """Hybrid Poisson(lam) draw: ONE uniform batch for the whole ``lam``
+    array (a single threefry invocation), then the quantile transform."""
+    return poisson_from_uniform(
+        uniform_block(key, jnp.shape(lam)), lam, threshold
+    )
+
+
+def sample_poisson(
+    key: Array,
+    lam: Array,
+    sampler: str = "hybrid",
+    threshold: float = DEFAULT_THRESHOLD,
+) -> Array:
+    """Poisson(lam) as float32 counts under the named sampler.
+
+    ``sampler="hybrid"``: :func:`poisson_hybrid` (the fast path).
+    ``sampler="exact"``: ``jax.random.poisson`` verbatim — bitwise
+    identical RNG consumption to the pre-fast-path code, for oracle
+    tests and resuming checkpoints recorded under the exact sampler.
+    """
+    check_sampler(sampler)
+    if sampler == "exact":
+        return jax.random.poisson(key, lam).astype(jnp.float32)
+    return poisson_hybrid(key, lam, threshold)

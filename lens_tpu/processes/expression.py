@@ -17,6 +17,16 @@ real-valued count with ``_updater: nonnegative_accumulate`` and
 Gene regulation uses :mod:`lens_tpu.utils.regulation_logic` rules keyed by
 transcript, evaluated against the merged counts view — the rebuild of the
 reference's boolean regulation parser (``lens/utils/regulation_logic.py``).
+
+**Stochastic option.** Transcription, Translation, and Degradation accept
+``sampler: None | "hybrid" | "exact"``. ``None`` (default) keeps the
+mean-field flux exactly as before. A sampler name turns each step's flux
+into discrete Poisson event counts with that expectation — the
+low-copy-number regime the mean-field form washes out — drawn as ONE
+bulk block per process per step through :mod:`lens_tpu.ops.sampling`
+(``"hybrid"`` = the batched fast path, ``"exact"`` =
+``jax.random.poisson``). The process then declares itself stochastic so
+the engine supplies a per-agent key.
 """
 
 from __future__ import annotations
@@ -25,6 +35,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from lens_tpu.core.process import Process
+from lens_tpu.ops.sampling import check_sampler, sample_poisson
 from lens_tpu.processes import register
 from lens_tpu.utils.rate_laws import first_order, hill_repression
 from lens_tpu.utils.regulation_logic import compile_rule
@@ -39,15 +50,41 @@ def _count_leaf(default=0.0, emit=True):
     }
 
 
+class _MaybeStochastic(Process):
+    """Shared ``sampler`` plumbing: ``None`` = deterministic mean-field;
+    a sampler name flips ``self.stochastic`` (instance attribute shadows
+    the class flag, so the engine starts passing a key) and routes each
+    step's expected fluxes through ONE bulk Poisson draw."""
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        sampler = self.config.get("sampler")
+        if sampler is not None:
+            check_sampler(sampler)
+            self.stochastic = True
+
+    def _eventize(self, names, means, key):
+        """{name: E[events]} -> {name: events}: stacked into one vector,
+        one fused Poisson block, unpacked. Deterministic passthrough
+        when ``sampler`` is None."""
+        sampler = self.config.get("sampler")
+        if sampler is None:
+            return means
+        lam = jnp.stack([jnp.maximum(means[n], 0.0) for n in names])
+        events = sample_poisson(key, lam, sampler=sampler)
+        return {n: events[i] for i, n in enumerate(names)}
+
+
 @register
-class Transcription(Process):
+class Transcription(_MaybeStochastic):
     """Constitutive/regulated mRNA synthesis (counts/s per gene copy).
 
     ``rates``: transcript -> synthesis rate (counts/s).
     ``regulation``: transcript -> boolean rule string over species counts
     (e.g. ``"not repressor"``); when the rule evaluates False the gene is
     off. Smooth repression via ``repressors`` (Hill) is also supported for
-    ODE-friendly dynamics.
+    ODE-friendly dynamics. ``sampler``: see module docstring — discrete
+    Poisson synthesis events instead of the mean-field flux.
     """
 
     name = "transcription"
@@ -56,6 +93,7 @@ class Transcription(Process):
         "rates": {"mrna": 0.1},            # counts/s
         "regulation": {},                   # transcript -> rule string
         "repressors": {},                   # transcript -> (species, K, n)
+        "sampler": None,                    # None | "hybrid" | "exact"
     }
 
     def __init__(self, config=None):
@@ -77,7 +115,7 @@ class Transcription(Process):
             counts.setdefault(species, _count_leaf())
         return {"counts": counts}
 
-    def next_update(self, timestep, states):
+    def next_update(self, timestep, states, key=None):
         counts = states["counts"]
         update = {}
         for t in self.transcripts:
@@ -91,21 +129,24 @@ class Transcription(Process):
                 synthesis = synthesis * hill_repression(
                     counts[species], 1.0, k, n
                 )
-            update[t] = synthesis
-        return {"counts": update}
+            update[t] = jnp.asarray(synthesis, jnp.float32)
+        return {"counts": self._eventize(self.transcripts, update, key)}
 
 
 @register
-class Translation(Process):
+class Translation(_MaybeStochastic):
     """Protein synthesis proportional to transcript counts.
 
     ``pairs``: protein -> (mrna, rate) — each mRNA molecule produces
-    ``rate`` proteins/s.
+    ``rate`` proteins/s. ``sampler``: see module docstring.
     """
 
     name = "translation"
 
-    defaults = {"pairs": {"protein": ("mrna", 0.05)}}
+    defaults = {
+        "pairs": {"protein": ("mrna", 0.05)},
+        "sampler": None,                    # None | "hybrid" | "exact"
+    }
 
     def ports_schema(self):
         counts = {}
@@ -114,35 +155,50 @@ class Translation(Process):
             counts.setdefault(mrna, _count_leaf())
         return {"counts": counts}
 
-    def next_update(self, timestep, states):
+    def next_update(self, timestep, states, key=None):
         counts = states["counts"]
-        return {
-            "counts": {
-                protein: first_order(rate, counts[mrna]) * timestep
-                for protein, (mrna, rate) in self.config["pairs"].items()
-            }
+        proteins = tuple(self.config["pairs"])
+        means = {
+            protein: first_order(rate, counts[mrna]) * timestep
+            for protein, (mrna, rate) in self.config["pairs"].items()
         }
+        return {"counts": self._eventize(proteins, means, key)}
 
 
 @register
-class Degradation(Process):
-    """First-order decay of listed species: dN = -k * N * dt."""
+class Degradation(_MaybeStochastic):
+    """First-order decay of listed species: dN = -k * N * dt.
+
+    ``sampler``: see module docstring — decay becomes discrete Poisson
+    removal events, capped at the pool so a large-dt draw cannot remove
+    molecules that are not there (the nonnegative updater would floor
+    the POOL, but the cap keeps the event count itself honest).
+    """
 
     name = "degradation"
 
-    defaults = {"rates": {"mrna": 0.01, "protein": 0.0005}}  # 1/s
+    defaults = {
+        "rates": {"mrna": 0.01, "protein": 0.0005},  # 1/s
+        "sampler": None,                    # None | "hybrid" | "exact"
+    }
 
     def ports_schema(self):
         return {"counts": {s: _count_leaf() for s in self.config["rates"]}}
 
-    def next_update(self, timestep, states):
+    def next_update(self, timestep, states, key=None):
         counts = states["counts"]
-        return {
-            "counts": {
-                s: -first_order(k, counts[s]) * timestep
-                for s, k in self.config["rates"].items()
-            }
+        species = tuple(self.config["rates"])
+        means = {
+            s: first_order(k, counts[s]) * timestep
+            for s, k in self.config["rates"].items()
         }
+        events = self._eventize(species, means, key)
+        if self.config.get("sampler") is not None:
+            events = {
+                s: jnp.minimum(events[s], jnp.maximum(counts[s], 0.0))
+                for s in species
+            }
+        return {"counts": {s: -events[s] for s in species}}
 
 
 @register

@@ -30,6 +30,7 @@ import numpy as np
 
 from lens_tpu.core.process import Process
 from lens_tpu.ops.gillespie import tau_leap_window
+from lens_tpu.ops.sampling import check_sampler, check_threshold
 from lens_tpu.processes import register
 from lens_tpu.utils.regulation_logic import compile_rule
 
@@ -55,6 +56,12 @@ class GenomeExpression(Process):
         # dicts with keys gene/k_tx/k_tl/d_m/d_p and optional rule.
         "genes": "ecoli_core",
         "substeps": 10,
+        # Poisson event sampler (ops.sampling): "hybrid" = the batched
+        # quantile-transform fast path, one fused [substeps, 4G] uniform
+        # block per agent per step; "exact" = jax.random.poisson,
+        # bitwise-compatible with pre-fast-path checkpoints.
+        "sampler": "hybrid",
+        "sampler_threshold": 10.0,
         "regulation_threshold": 0.05,  # presence threshold for rules
         # Schema default for external species read by rules; shared-path
         # declarations must agree across processes (core.engine), so wire
@@ -64,6 +71,8 @@ class GenomeExpression(Process):
 
     def __init__(self, config=None):
         super().__init__(config)
+        check_sampler(self.config["sampler"])  # typo -> fail at build
+        check_threshold(self.config["sampler_threshold"])
         genes = self.config["genes"]
         if isinstance(genes, str):
             from lens_tpu.data import load_tsv
@@ -163,6 +172,8 @@ class GenomeExpression(Process):
         new = tau_leap_window(
             key, counts, self._stoich, propensities, timestep,
             int(self.config["substeps"]),
+            sampler=self.config["sampler"],
+            threshold=float(self.config["sampler_threshold"]),
         ).reshape(g, 2)
         return {
             "counts": {

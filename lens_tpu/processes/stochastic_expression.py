@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from lens_tpu.core.process import Process
 from lens_tpu.ops.gillespie import tau_leap_window
+from lens_tpu.ops.sampling import check_sampler, check_threshold
 from lens_tpu.processes import register
 
 # stoichiometry [R=4, S=2]; species order: (mRNA, protein)
@@ -55,7 +56,19 @@ class StochasticExpression(Process):
         "d_m": 0.1,    # 1/s mRNA decay
         "d_p": 0.02,   # 1/s protein decay
         "substeps": 10,
+        # Poisson event sampler (ops.sampling): "hybrid" draws one fused
+        # uniform block per window and pushes it through the batched
+        # inverse-CDF fast path; "exact" keeps jax.random.poisson with
+        # per-substep key splits — bitwise-identical to pre-fast-path
+        # checkpoints, the oracle/resume escape hatch.
+        "sampler": "hybrid",
+        "sampler_threshold": 10.0,  # mean-events regime split
     }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        check_sampler(self.config["sampler"])  # typo -> fail at build
+        check_threshold(self.config["sampler_threshold"])
 
     def ports_schema(self):
         c = self.config
@@ -95,6 +108,8 @@ class StochasticExpression(Process):
         new = tau_leap_window(
             key, counts, _STOICH, propensities, timestep,
             int(self.config["substeps"]),
+            sampler=self.config["sampler"],
+            threshold=float(self.config["sampler_threshold"]),
         )
         return {
             "counts": {

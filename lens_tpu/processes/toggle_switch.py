@@ -14,15 +14,42 @@ isolates agent-axis stacking/scaling (SURVEY.md §7 step 4). Fills the
 reference's gene-expression process slot (reconstructed:
 ``lens/processes/`` expression modules, SURVEY.md §2) with TPU-friendly
 pure-jnp kinetics.
+
+``method="tau_leap"`` runs the SAME network stochastically: the four
+ODE fluxes become eight discrete reaction channels (two Hill-gated
+transcriptions, two translations, four decays) tau-leaped through
+``ops.gillespie`` with the hybrid Poisson sampler (``sampler`` knob, see
+``ops.sampling``) — the low-copy-number switch whose spontaneous state
+flips the deterministic form cannot show. Gardner's original analysis
+is bistable-ODE; the stochastic variant is the standard extension.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from lens_tpu.core.process import Process
+from lens_tpu.ops.gillespie import tau_leap_window
 from lens_tpu.ops.integrate import odeint_window
+from lens_tpu.ops.sampling import check_sampler, check_threshold
 from lens_tpu.processes import register
+
+#: tau-leap stoichiometry [8, 4]; species order (m_u, p_u, m_v, p_v)
+_TOGGLE_STOICH = jnp.asarray(
+    np.kron(
+        np.eye(2, dtype=np.float32),          # the U arm, then the V arm
+        np.asarray(
+            [
+                [1.0, 0.0],    # transcription (Hill-gated by the other arm)
+                [0.0, 1.0],    # translation
+                [-1.0, 0.0],   # mRNA decay
+                [0.0, -1.0],   # protein decay
+            ],
+            np.float32,
+        ),
+    )
+)
 
 
 @register
@@ -37,8 +64,20 @@ class ToggleSwitch(Process):
         "k_t": 1.0,       # translation rate 1/s
         "d_p": 0.5,       # protein degradation 1/s
         "substeps": 10,
-        "method": "rk4",
+        "method": "rk4",  # integrate.odeint_window method, or "tau_leap"
+        # Poisson sampler for method="tau_leap" only (ops.sampling)
+        "sampler": "hybrid",
+        "sampler_threshold": 10.0,
     }
+
+    def __init__(self, config=None):
+        super().__init__(config)
+        check_sampler(self.config["sampler"])
+        check_threshold(self.config["sampler_threshold"])
+        if self.config["method"] == "tau_leap":
+            # instance attr shadows the class flag: the engine supplies
+            # a per-agent key only to stochastic processes
+            self.stochastic = True
 
     def ports_schema(self):
         leaf = lambda default: {
@@ -66,13 +105,49 @@ class ToggleSwitch(Process):
             c["k_t"] * m_v - c["d_p"] * p_v,
         )
 
-    def next_update(self, timestep, states):
+    def next_update(self, timestep, states, key=None):
         s = states["internal"]
         y0 = (s["mrna_u"], s["protein_u"], s["mrna_v"], s["protein_v"])
         n = max(int(self.config["substeps"]), 1)
+        names = ("mrna_u", "protein_u", "mrna_v", "protein_v")
+        if self.config["method"] == "tau_leap":
+            c = self.config
+            # The schema defaults are ODE-oriented FRACTIONAL counts
+            # (mrna_u=0.5, ...); discrete kinetics on a fractional pool
+            # leaves a permanent phantom residue (decay caps at
+            # floor(pool), so 0.5 molecules can never decay yet still
+            # contribute propensity). Round at entry: the returned delta
+            # is (new - y0), so the accumulated state lands exactly on
+            # the integral `new` after one step and stays integral.
+            y0r = tuple(jnp.round(y) for y in y0)
+
+            def propensities(x):
+                m_u, p_u, m_v, p_v = x[0], x[1], x[2], x[3]
+                hill = lambda p: c["alpha"] / (
+                    1.0 + (jnp.maximum(p, 0.0) / c["k"]) ** c["n_hill"]
+                )
+                return jnp.stack(
+                    [
+                        hill(p_v), c["k_t"] * m_u,
+                        c["d_m"] * m_u, c["d_p"] * p_u,
+                        hill(p_u), c["k_t"] * m_v,
+                        c["d_m"] * m_v, c["d_p"] * p_v,
+                    ]
+                )
+
+            new = tau_leap_window(
+                key, jnp.stack(y0r), _TOGGLE_STOICH, propensities,
+                timestep, n,
+                sampler=c["sampler"],
+                threshold=float(c["sampler_threshold"]),
+            )
+            return {
+                "internal": {
+                    k: new[i] - y0[i] for i, k in enumerate(names)
+                }
+            }
         y = odeint_window(
             self._rhs, y0, 0.0, jnp.float32(timestep) / n, n,
             method=self.config["method"],
         )
-        names = ("mrna_u", "protein_u", "mrna_v", "protein_v")
         return {"internal": {k: yf - y0_ for k, yf, y0_ in zip(names, y, y0)}}

@@ -13,10 +13,37 @@
 # each file now runs alone — interpreter startup ~15 s/file is the
 # price of determinism here. `python -m pytest tests/ -q` remains the
 # honest single invocation to try first on a healthy box.
+#
+#   ./run_tests.sh            # full suite (~50 min on this box)
+#   ./run_tests.sh --quick    # quick tier (~<10 min): the core-contract
+#                             # files below, still one process per file.
+#                             # The verification loop between edits; the
+#                             # full suite remains the merge gate.
 set -u
 cd "$(dirname "$0")"
+
+# Quick tier: engine/state/process contracts + the numerics the rest of
+# the stack leans on (integration, tau-leap + hybrid sampler, LP ops),
+# chosen for coverage-per-second, not completeness.
+QUICK_FILES="
+tests/test_state.py
+tests/test_engine.py
+tests/test_utils.py
+tests/test_integrate.py
+tests/test_gillespie.py
+tests/test_sampling.py
+tests/test_expression.py
+tests/test_colony.py
+"
+
+files="tests/test_*.py"
+if [ "${1:-}" = "--quick" ]; then
+  shift
+  files=$QUICK_FILES
+fi
+
 rc=0
-for f in tests/test_*.py; do
+for f in $files; do
   python -m pytest "$f" -q "$@"
   rc2=$?
   # exit 5 = "no tests collected" — expected under -k/-m filters when a

@@ -566,6 +566,44 @@ class TestCheckpointResume:
         assert int(state.step) == 20
 
 
+class TestLPSolverSidecar:
+    """Switching lp_solver changes the packed lp_state warm-vector
+    LENGTH, so a solver-switched resume used to die as an opaque shape
+    mismatch deep in restore. The colony_meta.json sidecar now records
+    the solver and resume fails loudly BEFORE restore (ADVICE r5 #3)."""
+
+    def config(self, tmp_path, total_time, solver=None):
+        metab = {"lp_solver": solver} if solver else {}
+        return {
+            "composite": "rfba_lattice",
+            "config": {"capacity": 16, "shape": (8, 8), "metabolism": metab},
+            "n_agents": 4,
+            "total_time": total_time,
+            "checkpoint_dir": str(tmp_path / "ckpt"),
+            "checkpoint_every": 2.0,
+            "emitter": {"type": "null"},
+        }
+
+    def test_sidecar_records_solver_and_mismatch_fails_loudly(
+        self, tmp_path
+    ):
+        import json as json_mod
+
+        with Experiment(self.config(tmp_path, 4.0)) as exp:
+            exp.run()
+        meta = json_mod.load(
+            open(tmp_path / "ckpt" / "colony_meta.json")
+        )
+        assert meta["lp_solvers"] == {"metabolism": "ipm"}
+        with Experiment(self.config(tmp_path, 8.0, solver="pdlp")) as exp:
+            with pytest.raises(ValueError, match="lp_solver mismatch"):
+                exp.resume()
+        # the matching solver still resumes
+        with Experiment(self.config(tmp_path, 6.0)) as exp:
+            state = exp.resume()
+        assert int(state.colony.step) == 6
+
+
 class TestCheckpointer:
     def test_colony_state_roundtrip(self, tmp_path):
         from lens_tpu.checkpoint import Checkpointer
