@@ -9,6 +9,7 @@ jax provides, measuring steady-state throughput after one warm-up window
     python bench_all.py                    # all configs
     python bench_all.py 0 4                # a subset
     python bench_all.py --sampler=exact 4  # pin the Poisson sampler
+    python bench_all.py --coupling=reference 4  # pin the coupling impl
 
 ``--sampler=exact|hybrid`` threads the expression-stack sampler knob
 (ops.sampling) into the composites that carry stochastic expression
@@ -16,6 +17,12 @@ jax provides, measuring steady-state throughput after one warm-up window
 fast path. Default: composite defaults (hybrid since round 6). It also
 reaches config 1's toggle_colony, where it is INERT under the default
 ODE integrator (the toggle reads it only under method="tau_leap").
+
+``--coupling=fused|reference`` threads the agent<->lattice coupling
+implementation (environment.spatial CouplingPlan) into the lattice
+configs (2/2e/3b/3p/3c/4/xf) — the A/B lever for the round-7 fused
+coupling. Default: composite defaults (fused since round 7). Non-lattice
+configs (0/1/3) carry no coupling and ignore it.
 """
 
 from __future__ import annotations
@@ -33,9 +40,18 @@ WINDOW_S = 32.0  # sim-seconds measured per config (dt = 1s)
 #: set by --sampler=...; None = composite defaults
 _SAMPLER: str | None = None
 
+#: set by --coupling=...; None = composite defaults ("fused")
+_COUPLING: str | None = None
 
-def _sampler_cfg() -> dict:
-    return {"sampler": _SAMPLER} if _SAMPLER else {}
+
+def _knob_cfg() -> dict:
+    """Composite-config fragment for every CLI A/B knob (--sampler,
+    --coupling) — spread into each config's composite call so the
+    levers reach every lattice/expression composite uniformly."""
+    cfg = {"sampler": _SAMPLER} if _SAMPLER else {}
+    if _COUPLING:
+        cfg["coupling"] = _COUPLING
+    return cfg
 
 
 def _measure(build_window, n_agents):
@@ -78,7 +94,7 @@ def config_1():
     from lens_tpu.models.composites import toggle_colony
 
     n = 1024
-    colony = Colony(toggle_colony(_sampler_cfg()), capacity=n)
+    colony = Colony(toggle_colony(_knob_cfg()), capacity=n)
 
     def build():
         state = colony.initial_state(n, key=jax.random.PRNGKey(0))
@@ -102,7 +118,7 @@ def config_2():
     from lens_tpu.models.composites import ecoli_lattice
 
     n = 10240
-    spatial, _ = ecoli_lattice({"capacity": n})
+    spatial, _ = ecoli_lattice({"capacity": n, **_knob_cfg()})
 
     def build():
         state = spatial.initial_state(n, jax.random.PRNGKey(0))
@@ -165,7 +181,7 @@ def _rfba_bench(key, n, metabolism, genes, scenario):
             "shape": (64, 64),
             "metabolism": metabolism,
             "expression": {"genes": genes},
-            **_sampler_cfg(),
+            **_knob_cfg(),
         }
     )
 
@@ -239,7 +255,7 @@ def config_4():
         {
             "capacity": {"ecoli": 51200, "scavenger": 51200},
             "shape": (256, 256),
-            **_sampler_cfg(),
+            **_knob_cfg(),
         }
     )
 
@@ -276,6 +292,7 @@ def config_xf():
         {
             "capacity": {"ecoli": n_each, "scavenger": n_each},
             "shape": (64, 64),
+            **_knob_cfg(),
         }
     )
 
@@ -309,7 +326,7 @@ def config_2e():
     from lens_tpu.models.composites import ecoli_lattice
 
     n = 10240
-    spatial, _ = ecoli_lattice({"capacity": n})
+    spatial, _ = ecoli_lattice({"capacity": n, **_knob_cfg()})
 
     def build():
         state = spatial.initial_state(n, jax.random.PRNGKey(0))
@@ -384,11 +401,13 @@ def main() -> None:
     def _key(a: str):
         return int(a) if a.isdigit() else a
 
-    global _SAMPLER
+    global _SAMPLER, _COUPLING
     args = []
     for a in sys.argv[1:]:
         if a.startswith("--sampler="):
             _SAMPLER = a.split("=", 1)[1]
+        elif a.startswith("--coupling="):
+            _COUPLING = a.split("=", 1)[1]
         else:
             args.append(a)
     wanted = [_key(a) for a in args] or list(CONFIGS)
@@ -396,6 +415,7 @@ def main() -> None:
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
         "sampler": _SAMPLER or "default",
+        "coupling": _COUPLING or "default",
         "results": [],
     }
     for k in wanted:

@@ -24,6 +24,18 @@ import jax.numpy as jnp
 from lens_tpu.ops.diffusion import diffuse, stable_substeps
 
 
+def masked_exchange_contrib(
+    exchange: jnp.ndarray, alive: jnp.ndarray, exchange_scale: float
+) -> jnp.ndarray:
+    """The [M, N] exchange payload masked by liveness and scaled to
+    concentration units — the ONE authoritative copy of this expression
+    (same association as the reference path's
+    ``exchange * alive[:, None] * scale``): the unsharded flat apply and
+    both sharded fused blocks all call it, so a future scaling change
+    cannot land in one copy and break the bitwise parity contracts."""
+    return exchange * alive.astype(exchange.dtype)[None, :] * exchange_scale
+
+
 class Lattice:
     """Static configuration + pure field-update functions.
 
@@ -130,6 +142,59 @@ class Lattice:
         i = jnp.clip(ij[:, 0], 0, self.shape[0] - 1)
         j = jnp.clip(ij[:, 1], 0, self.shape[1] - 1)
         return i, j
+
+    @property
+    def n_bins(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    def flat_bin_of(self, locations: jnp.ndarray) -> jnp.ndarray:
+        """Row-major flat bin index [N] (int32) — ``i * W + j`` of
+        :meth:`bin_of`, exactly (integer composition, so the fused
+        coupling path that computes this ONCE per step sees the same
+        bins the reference path derives three times over).
+        """
+        i, j = self.bin_of(locations)
+        return i * self.shape[1] + j
+
+    def occupancy_flat(
+        self, flat: jnp.ndarray, alive: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Live-agent count per flat bin: [H*W] (float32).
+
+        The flat-index counterpart of :meth:`occupancy`, built on the
+        coupling scatter primitive (ops.scatter) so the fused step's
+        occupancy count shares both the precomputed ``flat`` index and
+        the fast scatter path with the exchange application. Bitwise
+        equal to ``occupancy(...).reshape(-1)``.
+        """
+        from lens_tpu.ops.scatter import scatter_add_2d
+
+        base = jnp.zeros((1, self.n_bins), jnp.float32)
+        return scatter_add_2d(
+            base, flat, alive.astype(jnp.float32)[None, :]
+        )[0]
+
+    def apply_exchanges_flat(
+        self,
+        fields_flat: jnp.ndarray,
+        flat: jnp.ndarray,
+        exchange: jnp.ndarray,
+        alive: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """Flat-index counterpart of :meth:`apply_exchanges`.
+
+        fields_flat: [M, H*W]; exchange: [M, N] (channel-major, unlike
+        the reference path's [N, M] — the scatter consumes channel rows
+        directly, so the fused path never materializes the transpose).
+        Returns the updated [M, H*W] (same ``>= 0`` clamp and mask
+        semantics as the reference; bitwise equal to it on CPU).
+        """
+        from lens_tpu.ops.scatter import scatter_add_2d
+
+        contrib = masked_exchange_contrib(
+            exchange, alive, self.exchange_scale
+        )
+        return jnp.maximum(scatter_add_2d(fields_flat, flat, contrib), 0.0)
 
     def occupancy(
         self, locations: jnp.ndarray, alive: jnp.ndarray

@@ -38,10 +38,17 @@ import jax
 import jax.numpy as jnp
 
 from lens_tpu.colony.colony import Colony, ColonyState
-from lens_tpu.core.schedule import scan_schedule
 from lens_tpu.core.topology import Path, normalize_path
 from lens_tpu.environment.lattice import Lattice
-from lens_tpu.environment.spatial import FieldPort, SpatialColony
+from lens_tpu.environment.spatial import (
+    FieldPort,
+    SpatialColony,
+    apply_gather,
+    clip_to_domain,
+    exchange_payload,
+    shared_view,
+    zero_exchanges,
+)
 from lens_tpu.utils.dicts import get_path, set_path
 
 
@@ -72,6 +79,7 @@ class MultiSpeciesColony:
         species: Mapping[str, SpatialColony],
         lattice: Lattice,
         share_bins: bool = True,
+        coupling: str = "fused",
     ):
         if not species:
             raise ValueError("need at least one species")
@@ -89,6 +97,12 @@ class MultiSpeciesColony:
         self.species: Dict[str, SpatialColony] = dict(species)
         self.lattice = lattice
         self.share_bins = bool(share_bins)
+        if coupling not in ("fused", "reference"):
+            raise ValueError(
+                f"coupling must be 'fused' or 'reference', got {coupling!r}"
+            )
+        self.coupling = coupling
+        self._run_cache: Dict = {}
 
     # -- construction --------------------------------------------------------
 
@@ -166,6 +180,90 @@ class MultiSpeciesColony:
                 f"timestep={timestep} != lattice.timestep="
                 f"{self.lattice.timestep}"
             )
+        if self.coupling == "fused":
+            return self._step_fused(ms, timestep)
+        return self._step_reference(ms, timestep)
+
+    def _step_fused(
+        self, ms: MultiSpeciesState, timestep: float
+    ) -> MultiSpeciesState:
+        """One-pass coupling over the species' precomputed CouplingPlans.
+
+        All species' rows concatenate onto one agent axis; the flat bin
+        index of that axis is computed once and shared by the combined
+        (ALL-species) occupancy count, the single ``[M, rows_all]``
+        gather, and the single exchange segment-sum — O(1) lattice ops
+        per step regardless of species count, now with O(1) index
+        derivations too. Numerically identical to
+        :meth:`_step_reference` (bitwise on CPU, tested).
+        """
+        lattice = self.lattice
+        fields = ms.fields
+        rows = self._row_slices(ms)
+        all_locs, all_alive = self._concat_rows(ms)
+        flat = lattice.flat_bin_of(all_locs)  # ONE bin map for the step
+        n_mols = len(lattice.molecules)
+        ff = fields.reshape(n_mols, lattice.n_bins)
+
+        # 1. ONE gather for all species. raw = the bins themselves;
+        # shared divides by the ALL-species occupancy (co-located cells
+        # of every species split the bin's content). Sense-only ports
+        # read raw — the same gather's output before the division.
+        raw = ff[:, flat]  # [M, rows_all]
+        if self.share_bins:
+            occ = lattice.occupancy_flat(flat, all_alive)
+            shared = shared_view(raw, occ, flat, lattice.exchange_scale)
+        else:
+            shared = raw
+        stepped: Dict[str, ColonyState] = {}
+        for name, sp in self.species.items():
+            cs = ms.species[name]
+            stepped[name] = cs._replace(
+                agents=apply_gather(
+                    sp.plan, cs.agents, cs.alive,
+                    raw[:, rows[name]], shared[:, rows[name]],
+                )
+            )
+
+        # 2. biology per species — one vmap per process set (necessarily
+        # per species: each has its own program)
+        for name, sp in self.species.items():
+            stepped[name] = sp.colony.step_biology(stepped[name], timestep)
+
+        # 3. ONE segment-sum of all species' exchanges into the PRE-STEP
+        # bins, one >=0 clamp (channel-major payload assembled per
+        # species from its plan, concatenated along the shared row axis)
+        payloads = []
+        for name, sp in self.species.items():
+            cs = stepped[name]
+            payloads.append(
+                exchange_payload(sp.plan, cs.agents, cs.alive.shape[0])
+            )  # [M, rows]
+            stepped[name] = cs._replace(
+                agents=zero_exchanges(sp.plan, cs.agents)
+            )
+        fields = lattice.apply_exchanges_flat(
+            ff, flat, jnp.concatenate(payloads, axis=1), all_alive
+        ).reshape(fields.shape)
+
+        # 4. division per species, then clip onto the domain
+        for name, sp in self.species.items():
+            cs = sp.colony.step_division(stepped[name])
+            stepped[name] = cs._replace(
+                agents=clip_to_domain(lattice, cs.agents, sp.location_path),
+                step=cs.step + 1,
+            )
+
+        # 5. diffusion, once
+        fields = lattice.step_fields(fields)
+        return MultiSpeciesState(species=stepped, fields=fields)
+
+    def _step_reference(
+        self, ms: MultiSpeciesState, timestep: float
+    ) -> MultiSpeciesState:
+        """The original per-molecule multi-species step (one lattice op
+        per message), kept as the fused path's oracle
+        (``coupling="reference"``)."""
         fields = ms.fields
         rows = self._row_slices(ms)
         all_locs, all_alive = self._concat_rows(ms)
@@ -240,17 +338,12 @@ class MultiSpeciesColony:
         )
 
         # 4. division per species, then clip onto the domain
-        h, w = self.lattice.size
         for name, sp in self.species.items():
             cs = sp.colony.step_division(stepped[name])
-            agents = cs.agents
-            loc = get_path(agents, sp.location_path)
-            loc = jnp.clip(
-                loc, jnp.zeros(2, loc.dtype),
-                jnp.asarray([h, w], loc.dtype) - 1e-3,
-            )
             stepped[name] = cs._replace(
-                agents=set_path(agents, sp.location_path, loc),
+                agents=clip_to_domain(
+                    self.lattice, cs.agents, sp.location_path
+                ),
                 step=cs.step + 1,
             )
 
@@ -274,10 +367,35 @@ class MultiSpeciesColony:
         timestep: float,
         emit_every: int = 1,
     ) -> Tuple[MultiSpeciesState, dict]:
-        return scan_schedule(
-            lambda c: self.step(c, timestep), self.emit_state, ms,
-            total_time, timestep, emit_every,
+        """Scan ``step`` as ONE cached jitted program (same caching and
+        accelerator-side input donation as :meth:`SpatialColony.run`)."""
+        from lens_tpu.environment.spatial import (
+            _cached_run,
+            _colony_trace_key,
+            _lattice_trace_key,
         )
+
+        key = (
+            _lattice_trace_key(self.lattice),
+            tuple(
+                (name, _colony_trace_key(sp.colony))
+                for name, sp in self.species.items()
+            ),
+            self.coupling,
+            self.share_bins,
+            float(total_time),
+            float(timestep),
+            int(emit_every),
+        )
+        return _cached_run(
+            self._run_cache,
+            key,
+            lambda c: self.step(c, timestep),
+            self.emit_state,
+            total_time,
+            timestep,
+            emit_every,
+        )(ms)
 
     def run_timeline(
         self,
@@ -345,7 +463,8 @@ class MultiSpeciesColony:
             new_species[name] = sp.with_colony(grown)
             new_states[name] = cs
         multi = MultiSpeciesColony(
-            new_species, self.lattice, share_bins=self.share_bins
+            new_species, self.lattice, share_bins=self.share_bins,
+            coupling=self.coupling,
         )
         return multi, MultiSpeciesState(
             species=new_states, fields=ms.fields

@@ -3,18 +3,40 @@
 This module is the rebuild of the reference's whole outer/inner exchange
 machinery (SURVEY.md §3.2): where the reference's outer agent broadcasts
 local concentrations over Kafka, waits on a barrier for every inner
-agent's exchange fluxes, then applies them to the lattice, here one pure
-``step`` does, in order:
+agent's exchange fluxes, then applies them to the lattice, here the
+whole exchange window — gather, biology, scatter, division, diffusion —
+compiles into ONE program around a :class:`CouplingPlan` built once at
+construction:
 
-1. **gather**   — each agent's ``external`` port variables are overwritten
-   with its bin's concentrations (ENVIRONMENT_UPDATE as one gather);
-2. **biology**  — one vmapped colony step (all Processes + division);
-3. **scatter**  — each agent's ``exchange`` accumulators are added into
-   its bin and zeroed (CELL_UPDATE as one scatter-add);
-4. **fields**   — diffusion substeps advance the lattice.
+1. **gather**   — the flat bin index is computed exactly once per step
+   and one ``[M, N]`` gather overwrites every agent's ``external`` port
+   variables with its bin's concentrations (ENVIRONMENT_UPDATE as one
+   gather). Consuming ports see the occupancy-SHARED view (the gather
+   divided by the bin's live count); sense-only ports read the RAW bin
+   value straight from the same gather — no second gather is issued,
+   because the raw view is the gather's own output before the division;
+2. **biology**  — one vmapped colony step (all Processes);
+3. **scatter**  — every agent's ``exchange`` accumulators land in its
+   PRE-step bin through one ``[M]``-channel segment-sum over the shared
+   flat index, then are zeroed (CELL_UPDATE as one scatter-add). The
+   occupancy count of phase 1 is the same segment-sum primitive
+   (ops.scatter — native CPU kernel when available); it cannot share
+   the scatter op itself because its OUTPUT feeds the gather that feeds
+   the biology that produces the exchange: occupancy -> gather ->
+   biology -> scatter is the step's load-bearing dependency chain;
+4. **division** — row activation after the accumulators drained;
+5. **fields**   — diffusion substeps advance the lattice.
 
 The barrier is implicit: step 3 happens after step 2 for every agent by
-construction. No broker, no messages, no waiting.
+construction. No broker, no messages, no waiting. ``run`` compiles and
+caches one jitted program per (window, timestep, emit cadence) — and
+donates the input state's buffers on accelerators, where the colony +
+fields pytree dominates HBM.
+
+``coupling="reference"`` keeps the original three-message step (one op
+per message, per-molecule Python loops, ``bin_of`` derived per op) as an
+oracle; the fused path is bitwise-equal to it on CPU (tested) and
+allclose elsewhere.
 """
 
 from __future__ import annotations
@@ -36,6 +58,97 @@ class SpatialState(NamedTuple):
     fields: jax.Array  # [M, H, W]
 
 
+def _lattice_trace_key(lattice: Lattice):
+    """Trace-relevant lattice parameters baked into compiled run
+    programs (tests mutate lattices post-construction — e.g.
+    ``lattice.impl = "adi"`` — so cached programs must be keyed on what
+    their traces closed over; same contract as
+    ``parallel.base.ShardedRunnerBase._lattice_key``)."""
+    return (
+        lattice.impl,
+        lattice.alpha_window.tobytes(),
+        lattice.shape,
+        lattice.exchange_scale,
+    )
+
+
+def _colony_trace_key(colony: Colony):
+    """Trace-relevant COLONY parameters baked into compiled run
+    programs: every process config (tests mutate process configs
+    post-construction too — e.g. ``processes["transport"].config
+    ["vmax"] = 0.0`` — and before round 7 ``run`` re-traced per call,
+    so such mutations silently took effect; the cache must notice
+    them). Configs are small static trees, so fingerprinting per run()
+    call costs microseconds against a window's dispatch."""
+    import numpy as np
+
+    from lens_tpu.utils.dicts import flatten_paths
+
+    parts = [colony.capacity, colony.division_trigger, colony.death_trigger]
+    for pname, proc in colony.compartment.processes.items():
+        # class identity too: swapping a process for a different CLASS
+        # with an identical config dict must also miss the cache
+        parts.append(
+            (pname, type(proc).__module__, type(proc).__qualname__)
+        )
+        for path, leaf in flatten_paths(proc.config):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                leaf = (str(leaf.dtype), leaf.shape,
+                        np.asarray(leaf).tobytes())
+            elif not isinstance(
+                leaf, (str, int, float, bool, bytes, type(None), tuple)
+            ):
+                leaf = repr(leaf)
+            parts.append((pname, path, leaf))
+    return tuple(parts)
+
+
+def _cached_run(
+    cache: Dict, key, step_fn, emit_fn, total_time, timestep, emit_every
+):
+    """Get-or-build the jitted whole-window program for ``run``.
+
+    One compiled program per cache key — a fresh ``lax.scan`` of a fresh
+    lambda per call (the pre-round-7 shape) re-traces every segment of a
+    segmented run. The key's last three elements are the window
+    parameters (several may legitimately coexist — segment + remainder
+    durations); everything before them is the model EPOCH (lattice
+    trace key, colony/process fingerprints, coupling wiring). An epoch
+    change means a post-construction mutation: every cached program
+    baked the stale model, so the cache drops wholesale — which also
+    bounds it, a config sweep mutating one process in place does not
+    accumulate one dead executable per swept value.
+
+    Input-state donation is resolved per call, NOT per cache entry
+    alone: donation only means anything at top level on an accelerator
+    (CPU ignores it loudly; under an outer jit/vmap trace the inner
+    donation is meaningless), so tracer arguments and CPU backends take
+    the non-donating twin of the program.
+    """
+    epoch = key[:-3]
+    if cache.get("_epoch") != epoch:
+        cache.clear()
+        cache["_epoch"] = epoch
+
+    def dispatch(state):
+        donate = jax.default_backend() != "cpu" and not any(
+            isinstance(leaf, jax.core.Tracer)
+            for leaf in jax.tree.leaves(state)
+        )
+        full_key = key + (donate,)
+        fn = cache.get(full_key)
+        if fn is None:
+            fn = cache[full_key] = jax.jit(
+                lambda s: scan_schedule(
+                    step_fn, emit_fn, s, total_time, timestep, emit_every
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn(state)
+
+    return dispatch
+
+
 class FieldPort(NamedTuple):
     """Wiring of one lattice molecule into the agent state tree.
 
@@ -48,11 +161,135 @@ class FieldPort(NamedTuple):
     exchange: Optional[Path]  # agent path accumulating net secretion (or None)
 
 
+class PortSpec(NamedTuple):
+    """One resolved port of a :class:`CouplingPlan`."""
+
+    molecule: str
+    channel: int              # lattice field channel
+    local: Path
+    exchange: Optional[Path]  # None = sense-only (reads the RAW view)
+
+
+class CouplingPlan(NamedTuple):
+    """Static port->field-channel map, precomputed once per composite.
+
+    Everything the per-step coupling needs that does NOT depend on state:
+    which lattice channel each port reads, which agent path each
+    channel's exchange accumulates in, and whether any port needs the
+    raw (sense-only) view or any exchange scatter at all. Building it at
+    construction time is what lets ``step`` run the whole coupling as
+    index ops over ``[M, N]`` blocks with the flat bin index computed
+    exactly once — the reference path instead re-derives ``bin_of`` per
+    lattice op and loops Python-side per molecule per phase.
+    """
+
+    ports: Tuple[PortSpec, ...]
+    #: per lattice channel (len M): the exchange path feeding it, or None
+    exchange_by_channel: Tuple[Optional[Path], ...]
+    any_exchange: bool
+
+
+def build_coupling_plan(
+    lattice: Lattice, field_ports: Mapping[str, FieldPort]
+) -> CouplingPlan:
+    """Resolve validated ``field_ports`` against the lattice's channel
+    order (ports may name any subset of the lattice's molecules)."""
+    ports = tuple(
+        PortSpec(mol, lattice.index(mol), port.local, port.exchange)
+        for mol, port in field_ports.items()
+    )
+    exchange_by_channel: list = [None] * len(lattice.molecules)
+    for spec in ports:
+        if spec.exchange is not None:
+            exchange_by_channel[spec.channel] = spec.exchange
+    return CouplingPlan(
+        ports=ports,
+        exchange_by_channel=tuple(exchange_by_channel),
+        any_exchange=any(p.exchange is not None for p in ports),
+    )
+
+
+# -- the fused step's shared float expressions --------------------------------
+#
+# ONE authoritative copy of every numeric expression the fused coupling
+# uses, called by all four fused step bodies (SpatialColony,
+# MultiSpeciesColony, and their shard_map block programs — which differ
+# only in where the psum sits and how rows split per species). The
+# bitwise fused==reference contract leans on these expressions matching
+# the reference path exactly; keeping them here keeps a future numerics
+# tweak from silently landing in one copy and breaking parity.
+
+
+def shared_view(raw, occ, flat, exchange_scale):
+    """The occupancy-SHARED concentrations: ``raw`` [M, N] divided by
+    each agent's bin occupancy (and the exchange scale) — identical
+    expression to ``Lattice.local_concentrations(share_bins=True)``."""
+    return raw / (jnp.maximum(occ[flat], 1.0)[None, :] * exchange_scale)
+
+
+def apply_gather(plan: CouplingPlan, agents, alive, raw, shared):
+    """Write every port's local variable from the gather ([M, rows]
+    blocks): sense-only ports read ``raw``, consuming ports ``shared``;
+    dead rows keep their previous value (mask hygiene)."""
+    for spec in plan.ports:
+        col = (raw if spec.exchange is None else shared)[spec.channel]
+        prev = get_path(agents, spec.local)
+        agents = set_path(agents, spec.local, jnp.where(alive, col, prev))
+    return agents
+
+
+def exchange_payload(plan: CouplingPlan, agents, n_rows: int):
+    """The [M, rows] channel-major exchange block (zeros for channels
+    without an exchange port) — feeds the scatter directly, so the
+    fused path never materializes the reference's [rows, M] transpose."""
+    return jnp.stack(
+        [
+            get_path(agents, path) if path is not None
+            else jnp.zeros(n_rows)
+            for path in plan.exchange_by_channel
+        ],
+        axis=0,
+    )
+
+
+def zero_exchanges(plan: CouplingPlan, agents):
+    """Drain every exchange accumulator after the scatter banked it."""
+    for spec in plan.ports:
+        if spec.exchange is None:
+            continue
+        agents = set_path(
+            agents,
+            spec.exchange,
+            jnp.zeros_like(get_path(agents, spec.exchange)),
+        )
+    return agents
+
+
+def clip_to_domain(lattice: Lattice, agents, location_path: Path):
+    """Clip every agent's location onto the lattice domain — motility
+    processes need not know the geometry; it lives here, ONCE, for both
+    coupling paths, both colony forms, and their sharded blocks (the
+    1e-3 um inset keeps the floor'd bin index on-lattice)."""
+    loc = get_path(agents, location_path)
+    h, w = lattice.size
+    loc = jnp.clip(
+        loc,
+        jnp.zeros(2, loc.dtype),
+        jnp.asarray([h, w], loc.dtype) - 1e-3,
+    )
+    return set_path(agents, location_path, loc)
+
+
 class SpatialColony:
     """A Colony embedded in a Lattice.
 
     field_ports: molecule name -> FieldPort (or (local, exchange) tuple).
     location_path: agent path of the [2] position leaf (um).
+    coupling: "fused" (default — one-pass gather/scatter over the
+        precomputed :class:`CouplingPlan`) or "reference" (the original
+        per-molecule three-message step, kept as a numerical oracle).
+        The two are bitwise-equal on CPU and allclose in general
+        (tests/test_spatial.py::TestFusedCoupling).
     """
 
     def __init__(
@@ -62,10 +299,16 @@ class SpatialColony:
         field_ports: Mapping[str, FieldPort | Tuple],
         location_path: Path | str = ("boundary", "location"),
         share_bins: bool = True,
+        coupling: str = "fused",
     ):
         self.colony = colony
         self.lattice = lattice
         self.share_bins = bool(share_bins)
+        if coupling not in ("fused", "reference"):
+            raise ValueError(
+                f"coupling must be 'fused' or 'reference', got {coupling!r}"
+            )
+        self.coupling = coupling
         self.location_path = normalize_path(location_path)
         self.field_ports: Dict[str, FieldPort] = {}
         known = colony.compartment.updaters
@@ -83,6 +326,10 @@ class SpatialColony:
                 if path is not None and path not in known:
                     raise ValueError(f"field port path {path} not in schema")
             self.field_ports[mol] = port
+        self.plan = build_coupling_plan(lattice, self.field_ports)
+        #: compiled run programs, keyed per (lattice trace key, window,
+        #: timestep, emit cadence, donate) — see :meth:`run`
+        self._run_cache: Dict = {}
 
     # -- construction --------------------------------------------------------
 
@@ -97,6 +344,7 @@ class SpatialColony:
             self.field_ports,
             location_path=self.location_path,
             share_bins=self.share_bins,
+            coupling=self.coupling,
         )
 
     def expanded(
@@ -156,6 +404,90 @@ class SpatialColony:
                 f"diffusion substeps for its own timestep — construct the "
                 f"Lattice with the timestep you run at"
             )
+        if self.coupling == "fused":
+            return self._step_fused(ss, timestep)
+        return self._step_reference(ss, timestep)
+
+    def _finish_step(
+        self, cs: ColonyState, fields: jax.Array
+    ) -> SpatialState:
+        """Shared tail of both coupling paths: division (row activation)
+        now that accumulators are drained; then clip every agent onto
+        the lattice — motility processes need not know the domain
+        geometry (it lives here, once) — and advance the fields."""
+        cs = self.colony.step_division(cs)
+        cs = cs._replace(
+            agents=clip_to_domain(
+                self.lattice, cs.agents, self.location_path
+            ),
+            step=cs.step + 1,
+        )
+        fields = self.lattice.step_fields(fields)
+        return SpatialState(colony=cs, fields=fields)
+
+    def _step_fused(self, ss: SpatialState, timestep: float) -> SpatialState:
+        """One-pass coupling over the precomputed CouplingPlan.
+
+        The flat bin index is derived once and shared by the occupancy
+        count, the ``[M, N]`` gather, and the exchange segment-sum; the
+        raw (sense-only) view is the gather's own output before the
+        occupancy division, so no second gather exists. Identical
+        numerics to :meth:`_step_reference` op for op (same fold order
+        in the scatters, same division expression in the gather), so the
+        two paths agree bitwise on CPU.
+        """
+        cs, fields = ss
+        lattice, plan = self.lattice, self.plan
+        agents = cs.agents
+        locations = get_path(agents, self.location_path)
+        flat = lattice.flat_bin_of(locations)  # the step's ONE bin map
+        ff = fields.reshape(len(lattice.molecules), lattice.n_bins)
+
+        # 1. gather: raw = the bins themselves; shared = raw / the
+        # bin's live occupancy (consuming ports must split the bin so
+        # collective uptake cannot overdraw it — sense-only ports never
+        # debit it, so they read raw)
+        raw = ff[:, flat]  # [M, N]
+        if self.share_bins:
+            occ = lattice.occupancy_flat(flat, cs.alive)
+            shared = shared_view(raw, occ, flat, lattice.exchange_scale)
+        else:
+            shared = raw
+        cs = cs._replace(
+            agents=apply_gather(plan, agents, cs.alive, raw, shared)
+        )
+
+        # 2. biology — processes only; division is deferred until the
+        # exchange is applied (its dividers zero the accumulators)
+        cs = self.colony.step_biology(cs, timestep)
+
+        # 3. scatter: one [M]-channel segment-sum into the PRE-STEP bins
+        # (motility may have moved agents this step; debiting the new
+        # bin could overdraw it, and the >=0 clamp would create mass)
+        if plan.any_exchange:
+            exchange = exchange_payload(plan, cs.agents, cs.alive.shape[0])
+            fields = lattice.apply_exchanges_flat(
+                ff, flat, exchange, cs.alive
+            ).reshape(fields.shape)
+            cs = cs._replace(agents=zero_exchanges(plan, cs.agents))
+        else:
+            # no exchange ports: the reference path still applies its
+            # all-zero exchange and therefore still CLAMPS — which is a
+            # real invariant for e.g. impl="adi" fields that can
+            # undershoot zero. Keep the clamp so the oracle contract
+            # (and the >=0 fields guarantee) holds for sense-only
+            # wirings too.
+            fields = jnp.maximum(fields, 0.0)
+
+        # 4.-5. division, clip, diffusion (shared tail)
+        return self._finish_step(cs, fields)
+
+    def _step_reference(
+        self, ss: SpatialState, timestep: float
+    ) -> SpatialState:
+        """The original three-message step — one lattice op per message,
+        ``bin_of`` re-derived per op — kept as the fused path's oracle
+        (``coupling="reference"``)."""
         cs, fields = ss
         locations = get_path(cs.agents, self.location_path)
 
@@ -217,26 +549,8 @@ class SpatialColony:
             )
         cs = cs._replace(agents=agents)
 
-        # 4. division (row activation) now that accumulators are drained;
-        # then clip every agent onto the lattice — motility processes need
-        # not know the domain geometry (it lives here, once)
-        cs = self.colony.step_division(cs)
-        agents = cs.agents
-        loc = get_path(agents, self.location_path)
-        h, w = self.lattice.size
-        loc = jnp.clip(
-            loc,
-            jnp.zeros(2, loc.dtype),
-            jnp.asarray([h, w], loc.dtype) - 1e-3,
-        )
-        cs = cs._replace(
-            agents=set_path(agents, self.location_path, loc),
-            step=cs.step + 1,
-        )
-
-        # 5. diffusion
-        fields = self.lattice.step_fields(fields)
-        return SpatialState(colony=cs, fields=fields)
+        # 4.-5. division, clip, diffusion (shared tail)
+        return self._finish_step(cs, fields)
 
     def emit_state(self, ss: SpatialState) -> dict:
         """The emit slice for one state (colony slice + fields)."""
@@ -251,10 +565,35 @@ class SpatialColony:
         timestep: float,
         emit_every: int = 1,
     ) -> Tuple[SpatialState, dict]:
-        return scan_schedule(
-            lambda c: self.step(c, timestep), self.emit_state, ss,
-            total_time, timestep, emit_every,
+        """Scan ``step`` over ``total_time`` as ONE cached jitted program.
+
+        Programs are cached per (lattice trace key, window, timestep,
+        emit cadence), so segmented runs (experiment checkpointing,
+        media timelines) re-dispatch the compiled step chain instead of
+        re-tracing a fresh scan per segment. On accelerators the input
+        state's buffers are donated — the colony + fields pytree
+        dominates device memory, and a window's input is dead the moment
+        its output exists. (Donation is skipped on CPU, inside outer
+        traces, and thus for every vmapped/ensemble use.)
+        """
+        key = (
+            _lattice_trace_key(self.lattice),
+            _colony_trace_key(self.colony),
+            self.coupling,
+            self.share_bins,
+            float(total_time),
+            float(timestep),
+            int(emit_every),
         )
+        return _cached_run(
+            self._run_cache,
+            key,
+            lambda c: self.step(c, timestep),
+            self.emit_state,
+            total_time,
+            timestep,
+            emit_every,
+        )(ss)
 
     def run_timeline(
         self,

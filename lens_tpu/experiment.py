@@ -118,6 +118,17 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # composite config as its top-level "sampler" key; an explicit
     # per-process sampler in "config" still wins.
     "sampler": None,
+    # Agent<->lattice coupling implementation for lattice composites
+    # (environment.spatial CouplingPlan): None defers to the composite
+    # default ("fused", the one-pass gather/scatter over the precomputed
+    # plan); "reference" pins the original per-molecule three-message
+    # step — the numerics oracle the fused path is tested against, and
+    # the A/B lever for BENCH_PHASES coupling records. Bitwise-equal
+    # trajectories on CPU (so no resume sidecar is needed: a checkpoint
+    # written under either knob resumes under either). Threaded into
+    # the composite config as its top-level "coupling" key; an explicit
+    # coupling in "config" wins.
+    "coupling": None,
 }
 
 
@@ -142,10 +153,26 @@ def _jsonable(node):
 #: fresh lambda per call would retrace the reduction every segment).
 _count_free = jax.jit(lambda alive: (~alive).sum())
 
-#: Division backlog (alive rows whose trigger fired but division was
-#: suppressed) + free rows, as replicated scalars — the rebalance gate.
+#: The rebalance gate's two replicated scalars: STARVED backlog and
+#: global free rows. A triggered alive row is only evidence of a
+#: suppressed division if its shard ALSO has zero free rows — division
+#: claims free rows until the pool runs dry, so a shard that suppressed
+#: anything this step ends the step with an empty pool. Counting any
+#: ``alive & trigger`` row instead (the pre-round-7 gate) fires spurious
+#: global re-deals for composites whose trigger variable survives a
+#: successful division (a copy-style divider: both daughters inherit
+#: the set trigger) — ADVICE r5 #4. Rows are block-partitioned
+#: contiguously across the ``n_blocks`` agent shards, so the per-shard
+#: view is a static reshape.
 _backlog_and_free = jax.jit(
-    lambda alive, trig: ((alive & (trig > 0)).sum(), (~alive).sum())
+    lambda alive, trig, n_blocks: (
+        (
+            (alive & (trig > 0)).reshape(n_blocks, -1).sum(axis=-1)
+            * ((~alive).reshape(n_blocks, -1).sum(axis=-1) == 0)
+        ).sum(),
+        (~alive).sum(),
+    ),
+    static_argnums=2,
 )
 
 #: Free rows of the TIGHTEST replicate (alive is [R, rows]) — the
@@ -199,6 +226,13 @@ class Experiment:
             # processes; a sampler already set in "config" wins)
             self.config["config"] = deep_merge(
                 {"sampler": self.config["sampler"]}, self.config["config"]
+            )
+        if self.config["coupling"] is not None:
+            # same threading for the coupling-implementation knob
+            # (lattice composites read it via _coupling_of; others
+            # ignore the key)
+            self.config["config"] = deep_merge(
+                {"coupling": self.config["coupling"]}, self.config["config"]
             )
         built = composite_registry[name](self.config["config"])
         self.spatial: Optional[SpatialColony] = None
@@ -559,10 +593,15 @@ class Experiment:
         """Segment-boundary division-pool rebalance (sharded runner only).
 
         Reads two replicated scalars (multi-host-safe, like
-        ``_maybe_expand``): the division backlog (alive rows whose
-        trigger fired but were suppressed) and the global free-row
-        count. Iff both are nonzero — a shard is starved while capacity
-        exists elsewhere — rows are re-dealt round-robin by alive-rank.
+        ``_maybe_expand``): the STARVED division backlog — triggered
+        alive rows on shards whose free pool is exhausted, the only
+        rows whose division can actually have been suppressed (see
+        ``_backlog_and_free``) — and the global free-row count. Iff both
+        are nonzero — a shard is starved while capacity exists elsewhere
+        — rows are re-dealt round-robin by alive-rank. Triggered rows on
+        shards that still hold free rows do NOT fire the gate: they
+        divide next step locally (and a copy-style divider's surviving
+        trigger would otherwise re-deal globally every segment).
         See ``parallel.mesh.rebalance_colony_rows`` for why this is
         biology-neutral and why it cannot be shard-local.
         """
@@ -578,8 +617,8 @@ class Experiment:
             if trigger_path is None:
                 return cs
             trig = get_path(cs.agents, trigger_path)
-            backlog, free = _backlog_and_free(cs.alive, trig)
-            if int(backlog) == 0 or int(free) == 0:
+            starved, free = _backlog_and_free(cs.alive, trig, n_blocks)
+            if int(starved) == 0 or int(free) == 0:
                 return cs
             return self._rebalance_fn()(cs, n_blocks)
 
@@ -655,7 +694,9 @@ class Experiment:
             )
             new_species[name] = sp.with_colony(grown_colony)
         self.multi = MultiSpeciesColony(
-            new_species, self.multi.lattice, share_bins=self.multi.share_bins
+            new_species, self.multi.lattice,
+            share_bins=self.multi.share_bins,
+            coupling=self.multi.coupling,
         )
         self.runner = ShardedMultiSpeciesColony(self.multi, mesh)
         return state._replace(species=new_states)
@@ -1128,7 +1169,9 @@ class Experiment:
             )
             species[name] = sp.with_colony(grown)
         self.multi = MultiSpeciesColony(
-            species, self.multi.lattice, share_bins=self.multi.share_bins
+            species, self.multi.lattice,
+            share_bins=self.multi.share_bins,
+            coupling=self.multi.coupling,
         )
         if self.runner is not None:
             # the runner closed over the pre-adoption multi; a stale wrap

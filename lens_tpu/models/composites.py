@@ -183,6 +183,13 @@ def _make_lattice(c: Mapping, molecules, diffusion, initial) -> Lattice:
     )
 
 
+def _coupling_of(c: Mapping) -> str:
+    """Composite-level ``coupling`` knob (experiment.py threads its
+    top-level key here, like ``sampler``): "fused" (default) or
+    "reference" — the oracle path for A/B and numerics checks."""
+    return str(c.get("coupling") or "fused")
+
+
 def _spatial_colony(
     compartment: Compartment,
     molecules: list,
@@ -212,6 +219,7 @@ def _spatial_colony(
             for mol in molecules
         },
         location_path=("boundary", "location"),
+        coupling=_coupling_of(c),
     )
     return spatial, compartment
 
@@ -531,6 +539,7 @@ def _field_species(
     lattice: Lattice,
     mols,
     division: bool,
+    coupling: str = "fused",
 ) -> SpatialColony:
     """One species of a multi-species lattice: Colony + SpatialColony
     with the standard boundary port wiring for ``mols`` (shared by
@@ -553,6 +562,7 @@ def _field_species(
             for mol in mols
         },
         location_path=("boundary", "location"),
+        coupling=coupling,
     )
 
 
@@ -721,18 +731,20 @@ def rfba_cross_feeding(
     lattice = _make_lattice(
         c, list(metabolism.external), c["diffusion"], c["initial"]
     )
+    coupling = _coupling_of(c)
     multi = MultiSpeciesColony(
         species={
             "ecoli": _field_species(
                 ecoli, c["capacity"]["ecoli"], lattice,
-                list(metabolism.external), c["division"],
+                list(metabolism.external), c["division"], coupling,
             ),
             "scavenger": _field_species(
                 scavenger, c["capacity"]["scavenger"], lattice, ["ace"],
-                c["division"],
+                c["division"], coupling,
             ),
         },
         lattice=lattice,
+        coupling=coupling,
     )
     return multi, {"ecoli": ecoli, "scavenger": scavenger}
 
@@ -833,18 +845,20 @@ def mixed_species_lattice(
         motility={"boundary": ("boundary",)},
     )
     scavenger = Compartment(processes=scav_procs, topology=scav_topo)
+    coupling = _coupling_of(c)
     multi = MultiSpeciesColony(
         species={
             "ecoli": _field_species(
                 ecoli, c["capacity"]["ecoli"], lattice, ["glucose"],
-                c["division"],
+                c["division"], coupling,
             ),
             "scavenger": _field_species(
                 scavenger, c["capacity"]["scavenger"], lattice, ["acetate"],
-                c["division"],
+                c["division"], coupling,
             ),
         },
         lattice=lattice,
+        coupling=coupling,
     )
     return multi, {"ecoli": ecoli, "scavenger": scavenger}
 

@@ -1,0 +1,66 @@
+// Native CPU segment/scatter-add for the fused agent<->lattice coupling
+// (ops.scatter.scatter_add_2d).
+//
+// XLA's CPU scatter lowers to a generic serial update loop measured at
+// ~35-45 ns per update on this class of host — at config-2 scale
+// (10k agents x 2 scatters x every step) that loop IS the coupling
+// phase (BENCH_PHASES_CPU_r07.json "reference" rows). This kernel is
+// the same left-fold in the same row order (bitwise-identical results,
+// asserted in tests/test_spatial.py), minus the generic-scatter
+// machinery: ~1-2 ns per update.
+//
+// Contract (enforced by the ffi binding + the Python dispatcher):
+//   base [C, B] f32, idx [N] s32, upd [C, N] f32 -> out [C, B] f32
+//   out = base; for c: for n: out[c, idx[n]] += upd[c, n]
+// Out-of-range indices are dropped (XLA scatter's OOB semantics; the
+// callers clip anyway). base is input-output aliased, so the copy below
+// only runs when XLA actually materialized a distinct output buffer.
+
+#include <cstdint>
+#include <cstring>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error ScatterAddImpl(ffi::Buffer<ffi::F32> base,
+                                 ffi::Buffer<ffi::S32> idx,
+                                 ffi::Buffer<ffi::F32> upd,
+                                 ffi::ResultBuffer<ffi::F32> out) {
+  auto base_dims = base.dimensions();
+  auto upd_dims = upd.dimensions();
+  if (base_dims.size() != 2 || upd_dims.size() != 2 ||
+      idx.dimensions().size() != 1) {
+    return ffi::Error::InvalidArgument(
+        "scatter_add expects base [C, B], idx [N], upd [C, N]");
+  }
+  const size_t channels = base_dims[0];
+  const size_t bins = base_dims[1];
+  const size_t n = idx.dimensions()[0];
+  if (upd_dims[0] != channels || upd_dims[1] != n) {
+    return ffi::Error::InvalidArgument(
+        "upd shape does not match (base channels, idx length)");
+  }
+  float* o = out->typed_data();
+  const float* b = base.typed_data();
+  if (o != b) std::memcpy(o, b, channels * bins * sizeof(float));
+  const int32_t* ix = idx.typed_data();
+  const float* u = upd.typed_data();
+  for (size_t c = 0; c < channels; ++c) {
+    float* oc = o + c * bins;
+    const float* uc = u + c * n;
+    for (size_t i = 0; i < n; ++i) {
+      const int32_t k = ix[i];
+      if (k >= 0 && static_cast<size_t>(k) < bins) oc[k] += uc[i];
+    }
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LensCouplingScatterAdd, ScatterAddImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()   // base [C, B]
+        .Arg<ffi::Buffer<ffi::S32>>()   // idx [N]
+        .Arg<ffi::Buffer<ffi::F32>>()   // upd [C, N]
+        .Ret<ffi::Buffer<ffi::F32>>()); // out [C, B]

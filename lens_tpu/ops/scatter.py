@@ -1,0 +1,155 @@
+"""Multi-channel scatter-add — the coupling layer's one hot primitive.
+
+Both halves of the agent<->lattice coupling reduce to the same op
+(environment.spatial: occupancy counting and exchange application are
+the two segment-sums of one step):
+
+    scatter_add_2d(base [C, B], idx [N], upd [C, N]) -> [C, B]
+    out = base;  out[c, idx[n]] += upd[c, n]   (OOB indices dropped)
+
+Two implementations, bitwise-identical by construction (both left-fold
+the updates in row order; asserted in tests/test_spatial.py):
+
+- **XLA** ``base.at[:, idx].add(upd)`` — the portable baseline, and the
+  only path on accelerators (TPU scatters are handled by the backend).
+- **Native CPU kernel** (``native/coupling_scatter.cpp`` via XLA FFI) —
+  XLA's CPU scatter lowers to a generic serial update loop measured at
+  ~35-45 ns/update, which at colony scale IS the coupling phase
+  (BENCH_PHASES_CPU_r07.json); the native loop is the same fold at
+  ~1-2 ns/update. Built on first use with the repo Makefile (g++ is part
+  of the baked toolchain); any build/load failure falls back to the XLA
+  path — functionality never blocks on the native path, mirroring
+  ``lens_tpu.native``'s emit-writer contract.
+
+The native path is used only when every operand matches the kernel
+contract (CPU backend, f32 data, i32 indices); everything else takes the
+XLA path. The dispatch happens at trace time, so a jitted program bakes
+in whichever path its backend gets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libcoupling_scatter.so")
+_FFI_TARGET = "lens_coupling_scatter_add_f32"
+
+_lock = threading.Lock()
+_ready: bool | None = None  # None = not yet attempted
+
+
+def _ffi_module():
+    """jax's FFI surface across versions: ``jax.ffi`` (jax >= 0.5/0.6,
+    where ``jax.extend.ffi`` was deprecated and then removed) with the
+    ``jax.extend.ffi`` original as fallback — same API subset used here
+    (include_dir, register_ffi_target, pycapsule, ffi_call). Returns
+    None when neither exists."""
+    try:
+        import jax.ffi as ffi
+
+        return ffi
+    except ImportError:
+        pass
+    try:
+        import jax.extend.ffi as ffi
+
+        return ffi
+    except ImportError:
+        return None
+
+
+def _build_and_register() -> bool:
+    """Build (if needed), load, and FFI-register the kernel. False on any
+    failure — callers fall back to XLA."""
+    ffi = _ffi_module()
+    if ffi is None:
+        return False
+    if not os.path.exists(_SO_PATH):
+        try:
+            subprocess.run(
+                [
+                    "make", "-C", _NATIVE_DIR, "scatter",
+                    f"JAXLIB_INCLUDE={ffi.include_dir()}",
+                ],
+                check=True,
+                capture_output=True,
+                timeout=180,
+            )
+        except (subprocess.SubprocessError, OSError, AttributeError):
+            # AttributeError: an ffi surface without include_dir —
+            # same verdict as a failed build, fall back to XLA
+            return False
+        if not os.path.exists(_SO_PATH):
+            return False
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        ffi.register_ffi_target(
+            _FFI_TARGET,
+            ffi.pycapsule(lib.LensCouplingScatterAdd),
+            platform="cpu",
+        )
+    except (OSError, AttributeError):
+        return False
+    return True
+
+
+def native_scatter_ready() -> bool:
+    """True iff the native kernel is built, loaded, and registered
+    (attempted at most once per process)."""
+    global _ready
+    if _ready is None:
+        with _lock:
+            if _ready is None:
+                _ready = _build_and_register()
+    return _ready
+
+
+def _native_eligible(base, idx, upd) -> bool:
+    return (
+        jax.default_backend() == "cpu"
+        and base.dtype == jnp.float32
+        and upd.dtype == jnp.float32
+        and idx.dtype == jnp.int32
+        and base.ndim == 2
+        and idx.ndim == 1
+        and upd.ndim == 2
+        and native_scatter_ready()
+    )
+
+
+def scatter_add_2d(base, idx, upd):
+    """``base[c, idx[n]] += upd[c, n]`` for all (c, n); returns the new
+    [C, B] array. Out-of-bounds indices are dropped (XLA scatter
+    semantics — callers clip anyway). Duplicate indices accumulate in
+    row order on CPU, so the two implementations agree bitwise.
+
+    ``base`` is input-output aliased on the native path: when XLA can
+    prove the operand dead it updates in place (the common case — a
+    fresh zeros canvas or a donated fields buffer), otherwise it
+    inserts the copy itself.
+    """
+    if _native_eligible(base, idx, upd):
+        try:
+            return _ffi_module().ffi_call(
+                _FFI_TARGET,
+                jax.ShapeDtypeStruct(base.shape, base.dtype),
+                vmap_method="sequential",
+                input_output_aliases={0: 0},
+            )(base, idx, upd)
+        except (TypeError, AttributeError):
+            # an ffi_call surface without the callable-returning
+            # signature / vmap_method kwarg (older jax.extend.ffi):
+            # honor the never-block contract — disable the native path
+            # for the process and take the XLA scatter
+            global _ready
+            _ready = False
+    return base.at[:, idx].add(upd)

@@ -86,6 +86,58 @@ class ShardedRunnerBase:
             lattice.exchange_scale,
         )
 
+    def _assemble_fields(self, strip, s_idx):
+        """Full [M, H, W] fields from this device's strip: place it in a
+        zero canvas and psum over the space axis (an all-gather in psum
+        clothing; psum lets the VMA checker prove the result is
+        space-invariant). Runs inside shard_map; both colony runners'
+        block programs start with it."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from lens_tpu.parallel.mesh import SPACE_AXIS
+
+        m, h_local, w = strip.shape
+        h_full = h_local * self.n_space
+        return lax.psum(
+            lax.dynamic_update_slice_in_dim(
+                jnp.zeros((m, h_full, w), strip.dtype), strip,
+                s_idx * h_local, axis=1,
+            ),
+            SPACE_AXIS,
+        )
+
+    def _apply_exchange_strip(self, strip, ff, flat, contrib, s_idx):
+        """Apply a block's masked, scaled exchange payload to this
+        device's field strip: one plan-driven segment-sum into a full
+        zero canvas, psum over the agent axis, slice this strip's rows,
+        ONE >=0 clamp. The fused coupling's scatter half on a mesh —
+        shared by both colony runners so the contrib/clamp numerics
+        (which the bitwise fused==reference tests pin) have one
+        authoritative copy. Runs inside shard_map.
+
+        ff: the psum-assembled full fields as [M, H*W]; contrib:
+        [M, rows] already alive-masked and exchange-scaled.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from lens_tpu.ops.scatter import scatter_add_2d
+        from lens_tpu.parallel.mesh import AGENTS_AXIS
+
+        m, h_local, w = strip.shape
+        delta = scatter_add_2d(jnp.zeros_like(ff), flat, contrib).reshape(
+            m, h_local * self.n_space, w
+        )
+        delta = lax.psum(delta, AGENTS_AXIS)
+        return jnp.maximum(
+            strip
+            + lax.dynamic_slice_in_dim(
+                delta, s_idx * h_local, h_local, axis=1
+            ),
+            0.0,
+        )
+
     def _diffuse_strip(self, strip, axis_name: str, n_shards: int):
         """Diffuse a sharded field strip per the lattice's ``impl``:
         ppermute-halo FTCS by default, SPIKE distributed tridiagonal ADI
@@ -132,8 +184,10 @@ class ShardedRunnerBase:
                 f"{lattice.timestep}: the lattice precomputes its "
                 f"diffusion substeps — construct it with the run timestep"
             )
+        from lens_tpu.utils.platform import shard_map_fn
+
         specs = self._pspecs(example)
-        body = jax.shard_map(
+        body = shard_map_fn()(
             partial(self._block_step, timestep=timestep),
             mesh=self.mesh,
             in_specs=(specs,),

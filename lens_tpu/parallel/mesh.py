@@ -274,8 +274,10 @@ def expand_colony_rows_on_mesh(colony_state, grown_colony, old_cap: int,
         )
         return cs_blk._replace(agents=agents, alive=alive)
 
+    from lens_tpu.utils.platform import shard_map_fn
+
     grow = jax.jit(
-        jax.shard_map(
+        shard_map_fn()(
             pad_block, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs
         )
     )

@@ -44,6 +44,12 @@ from lens_tpu.environment.multispecies import (
     MultiSpeciesColony,
     MultiSpeciesState,
 )
+from lens_tpu.environment.spatial import (
+    apply_gather,
+    exchange_payload,
+    shared_view,
+    zero_exchanges,
+)
 from lens_tpu.parallel.base import ShardedRunnerBase
 from lens_tpu.parallel.mesh import (
     AGENTS_AXIS,
@@ -107,24 +113,120 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
     ) -> MultiSpeciesState:
         """Per-device block program (runs inside shard_map). Mirrors
         ``MultiSpeciesColony.step`` stage for stage; every cross-device
-        movement is an explicit collective."""
+        movement is an explicit collective. Honors the wrapped multi's
+        ``coupling`` knob (fused CouplingPlan one-pass vs the original
+        per-molecule reference oracle)."""
+        if self.multi.coupling == "fused":
+            return self._block_step_fused(ms, timestep)
+        return self._block_step_reference(ms, timestep)
+
+    def _block_lifecycle(self, stepped, a_idx):
+        """Per-shard lifecycle per species (death, then division), then
+        clip onto the domain — shared by both coupling paths."""
+        from lens_tpu.environment.spatial import clip_to_domain
+
+        multi, lattice = self.multi, self.multi.lattice
+        for name, sp in multi.species.items():
+            cs = sp.colony.step_death(stepped[name])
+            if sp.colony.division_trigger is not None:
+                key, sub = jax.random.split(cs.key)
+                sub = jax.random.fold_in(sub, a_idx)
+                d_agents, d_alive = sp.colony._divide(
+                    cs.agents, cs.alive, sub, cs.step
+                )
+                cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
+            stepped[name] = cs._replace(
+                agents=clip_to_domain(lattice, cs.agents, sp.location_path),
+                step=cs.step + 1,
+            )
+        return stepped
+
+    def _block_step_fused(
+        self, ms: MultiSpeciesState, timestep: float
+    ) -> MultiSpeciesState:
+        """The fused multi-species coupling on a device mesh: ONE flat
+        bin map over this block's concatenated all-species rows, the
+        combined occupancy and exchange delta as plan-driven
+        segment-sums psum'd over the agent axis — so shared-bin mass
+        conservation spans species AND shards, at one index derivation
+        per step."""
         multi, lattice = self.multi, self.multi.lattice
         strip = ms.fields
         a_idx = lax.axis_index(AGENTS_AXIS)
         s_idx = lax.axis_index(SPACE_AXIS)
-        m, h_local, w = strip.shape
-        h_full = h_local * self.n_space
+        full_fields = self._assemble_fields(strip, s_idx)  # [M, H, W]
+        n_mols = len(lattice.molecules)
+        ff = full_fields.reshape(n_mols, lattice.n_bins)
 
-        # Assemble the full field: strip -> zero canvas -> psum over the
-        # space axis (an all-gather in psum clothing; psum lets the VMA
-        # checker prove the result is space-invariant).
-        full_fields = lax.psum(
-            lax.dynamic_update_slice_in_dim(
-                jnp.zeros((m, h_full, w), strip.dtype),
-                strip, s_idx * h_local, axis=1,
-            ),
-            SPACE_AXIS,
-        )  # [M, H, W]
+        row_slices = multi._row_slices(ms)
+        all_locs, all_alive = multi._concat_rows(ms)
+        flat = lattice.flat_bin_of(all_locs)  # the block's ONE bin map
+
+        # 1. ONE gather for all species; combined GLOBAL occupancy
+        # (per-block segment-sum over every species' rows, psum over
+        # agent shards). Sense-only ports read the raw gather output.
+        raw = ff[:, flat]  # [M, rows_all]
+        if multi.share_bins:
+            occ = lax.psum(
+                lattice.occupancy_flat(flat, all_alive), AGENTS_AXIS
+            )
+            shared = shared_view(raw, occ, flat, lattice.exchange_scale)
+        else:
+            shared = raw
+        stepped: Dict[str, ColonyState] = {}
+        for name, sp in multi.species.items():
+            cs = ms.species[name]
+            stepped[name] = cs._replace(
+                agents=apply_gather(
+                    sp.plan, cs.agents, cs.alive,
+                    raw[:, row_slices[name]], shared[:, row_slices[name]],
+                )
+            )
+
+        # 2. biology per species; stochastic draws fold in the shard id
+        for name, sp in multi.species.items():
+            cs = stepped[name]
+            shard_key = jax.random.fold_in(cs.key, a_idx)
+            cs = sp.colony.step_biology(
+                cs._replace(key=shard_key), timestep
+            )
+            stepped[name] = cs._replace(key=stepped[name].key)
+
+        # 3. ONE segment-sum of all species' exchanges into the PRE-STEP
+        # bins, psum over agent shards, ONE clamp
+        payloads = []
+        for name, sp in multi.species.items():
+            cs = stepped[name]
+            payloads.append(
+                exchange_payload(sp.plan, cs.agents, cs.alive.shape[0])
+            )  # [M, rows]
+            stepped[name] = cs._replace(
+                agents=zero_exchanges(sp.plan, cs.agents)
+            )
+        from lens_tpu.environment.lattice import masked_exchange_contrib
+
+        contrib = masked_exchange_contrib(
+            jnp.concatenate(payloads, axis=1), all_alive,
+            lattice.exchange_scale,
+        )
+        strip = self._apply_exchange_strip(strip, ff, flat, contrib, s_idx)
+
+        # 4. per-shard lifecycle per species + clip, 5. diffusion
+        stepped = self._block_lifecycle(stepped, a_idx)
+        strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
+        return MultiSpeciesState(species=stepped, fields=strip)
+
+    def _block_step_reference(
+        self, ms: MultiSpeciesState, timestep: float
+    ) -> MultiSpeciesState:
+        """The original per-molecule block program (the oracle under
+        shard_map, ``coupling="reference"``)."""
+        multi, lattice = self.multi, self.multi.lattice
+        strip = ms.fields
+        a_idx = lax.axis_index(AGENTS_AXIS)
+        s_idx = lax.axis_index(SPACE_AXIS)
+        h_local = strip.shape[1]
+        full_fields = self._assemble_fields(strip, s_idx)  # [M, H, W]
 
         # This block's rows of EVERY species, concatenated — the SAME
         # row-slice/concat methods the unsharded step uses (shape-
@@ -221,31 +323,10 @@ class ShardedMultiSpeciesColony(ShardedRunnerBase):
             0.0,
         )
 
-        # 4. per-shard lifecycle per species (death, then division), then
-        # clip onto the domain
-        h, w_um = lattice.size
-        for name, sp in multi.species.items():
-            cs = sp.colony.step_death(stepped[name])
-            if sp.colony.division_trigger is not None:
-                key, sub = jax.random.split(cs.key)
-                sub = jax.random.fold_in(sub, a_idx)
-                d_agents, d_alive = sp.colony._divide(
-                    cs.agents, cs.alive, sub, cs.step
-                )
-                cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
-            agents = cs.agents
-            loc = get_path(agents, sp.location_path)
-            loc = jnp.clip(
-                loc, jnp.zeros(2, loc.dtype),
-                jnp.asarray([h, w_um], loc.dtype) - 1e-3,
-            )
-            stepped[name] = cs._replace(
-                agents=set_path(agents, sp.location_path, loc),
-                step=cs.step + 1,
-            )
-
-        # 5. diffusion on the strip, once (halo FTCS, or SPIKE ADI when
-        # the lattice opted in — see ShardedRunnerBase._diffuse_strip)
+        # 4. per-shard lifecycle per species + clip, 5. diffusion on the
+        # strip, once (halo FTCS, or SPIKE ADI when the lattice opted in
+        # — see ShardedRunnerBase._diffuse_strip)
+        stepped = self._block_lifecycle(stepped, a_idx)
         strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
         return MultiSpeciesState(species=stepped, fields=strip)
 

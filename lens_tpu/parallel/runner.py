@@ -27,7 +27,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from lens_tpu.environment.spatial import SpatialColony, SpatialState
+from lens_tpu.environment.spatial import (
+    SpatialColony,
+    SpatialState,
+    apply_gather,
+    exchange_payload,
+    shared_view,
+    zero_exchanges,
+)
 from lens_tpu.parallel.base import ShardedRunnerBase
 from lens_tpu.parallel.mesh import (
     AGENTS_AXIS,
@@ -88,24 +95,118 @@ class ShardedSpatialColony(ShardedRunnerBase):
     # -- the SPMD step -------------------------------------------------------
 
     def _block_step(self, ss: SpatialState, timestep: float) -> SpatialState:
-        """Per-device block program. Runs inside shard_map."""
+        """Per-device block program. Runs inside shard_map. Honors the
+        wrapped spatial's ``coupling`` knob: the fused path mirrors
+        ``SpatialColony._step_fused`` block for block (flat bin index
+        derived once, occupancy + exchange as plan-driven segment-sums,
+        raw view read off the single gather), the reference path keeps
+        the original per-molecule program as the oracle."""
+        if self.spatial.coupling == "fused":
+            return self._block_step_fused(ss, timestep)
+        return self._block_step_reference(ss, timestep)
+
+    def _block_step_fused(
+        self, ss: SpatialState, timestep: float
+    ) -> SpatialState:
+        """The fused coupling on a device mesh: the same CouplingPlan
+        one-pass step as unsharded, with the two cross-shard reductions
+        the coupling fundamentally needs — GLOBAL occupancy (psum of the
+        per-block segment-sum over the agent axis, so shared-bin mass
+        conservation spans shards) and the combined exchange delta (psum
+        of per-block segment-sums, one clamp)."""
+        spatial, lattice, colony = (
+            self.spatial, self.spatial.lattice, self.spatial.colony
+        )
+        plan = spatial.plan
+        cs, strip = ss.colony, ss.fields
+        a_idx = lax.axis_index(AGENTS_AXIS)
+        s_idx = lax.axis_index(SPACE_AXIS)
+        full_fields = self._assemble_fields(strip, s_idx)  # [M, H, W]
+        n_mols = len(lattice.molecules)
+        ff = full_fields.reshape(n_mols, lattice.n_bins)
+        locations = get_path(cs.agents, spatial.location_path)
+        flat = lattice.flat_bin_of(locations)  # this block's ONE bin map
+
+        # 1. gather with GLOBAL occupancy (per-block segment-sum, psum
+        # over the agent axis). Same raw-vs-shared split as the
+        # unsharded fused step: consuming ports see the bin-SHARED view,
+        # sense-only ports read the raw gather output.
+        raw = ff[:, flat]  # [M, rows]
+        if spatial.share_bins:
+            occ = lax.psum(
+                lattice.occupancy_flat(flat, cs.alive), AGENTS_AXIS
+            )
+            shared = shared_view(raw, occ, flat, lattice.exchange_scale)
+        else:
+            shared = raw
+        cs = cs._replace(
+            agents=apply_gather(plan, cs.agents, cs.alive, raw, shared)
+        )
+
+        # 2. biology on this block; stochastic draws fold in the shard id
+        shard_key = jax.random.fold_in(cs.key, a_idx)
+        cs = colony.step_biology(cs._replace(key=shard_key), timestep)
+        cs = cs._replace(key=ss.colony.key)
+
+        # 3. one segment-sum of this block's exchanges into PRE-STEP
+        # bins; reduce over agent shards; apply to the strip, one clamp
+        if plan.any_exchange:
+            from lens_tpu.environment.lattice import masked_exchange_contrib
+
+            payload = exchange_payload(plan, cs.agents, cs.alive.shape[0])
+            contrib = masked_exchange_contrib(
+                payload, cs.alive, lattice.exchange_scale
+            )
+            strip = self._apply_exchange_strip(
+                strip, ff, flat, contrib, s_idx
+            )
+            cs = cs._replace(agents=zero_exchanges(plan, cs.agents))
+        else:
+            # no exchange ports: match the reference block (and the
+            # unsharded fused step), which clamps unconditionally
+            strip = jnp.maximum(strip, 0.0)
+
+        # 4. per-shard lifecycle + clip, 5. diffusion (shared tail)
+        cs = self._block_lifecycle(cs, a_idx)
+        strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
+        return SpatialState(colony=cs, fields=strip)
+
+    def _block_lifecycle(self, cs, a_idx):
+        """Per-shard lifecycle (death, then division), then clip
+        locations onto the domain. Death is elementwise — shard-safe
+        with no collectives; freed rows rejoin THIS shard's pool."""
+        spatial, lattice, colony = (
+            self.spatial, self.spatial.lattice, self.spatial.colony
+        )
+        cs = colony.step_death(cs)
+        if colony.division_trigger is not None:
+            key, sub = jax.random.split(cs.key)
+            sub = jax.random.fold_in(sub, a_idx)
+            d_agents, d_alive = colony._divide(
+                cs.agents, cs.alive, sub, cs.step
+            )
+            cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
+        from lens_tpu.environment.spatial import clip_to_domain
+
+        return cs._replace(
+            agents=clip_to_domain(
+                lattice, cs.agents, spatial.location_path
+            ),
+            step=cs.step + 1,
+        )
+
+    def _block_step_reference(
+        self, ss: SpatialState, timestep: float
+    ) -> SpatialState:
+        """The original per-molecule block program (the oracle under
+        shard_map, ``coupling="reference"``)."""
         spatial, lattice, colony = self.spatial, self.spatial.lattice, self.spatial.colony
         cs, strip = ss.colony, ss.fields
         a_idx = lax.axis_index(AGENTS_AXIS)
         s_idx = lax.axis_index(SPACE_AXIS)
         h_local = strip.shape[1]
 
-        # Assemble the full field: place the strip in a zero canvas and
-        # psum over the space axis (an all-gather in psum clothing; psum
-        # lets the VMA checker prove the result is space-invariant).
-        m, _, w = strip.shape
-        h_full = h_local * self.n_space
-        full_fields = lax.psum(
-            lax.dynamic_update_slice_in_dim(
-                jnp.zeros((m, h_full, w), strip.dtype), strip, s_idx * h_local, axis=1
-            ),
-            SPACE_AXIS,
-        )  # [M, H, W]
+        full_fields = self._assemble_fields(strip, s_idx)  # [M, H, W]
         locations = get_path(cs.agents, spatial.location_path)
         i, j = lattice.bin_of(locations)
 
@@ -167,30 +268,10 @@ class ShardedSpatialColony(ShardedRunnerBase):
             )
         cs = cs._replace(agents=agents)
 
-        # 4. per-shard lifecycle (death, then division), then clip
-        # locations onto the domain. Death is elementwise — shard-safe
-        # with no collectives; freed rows rejoin THIS shard's pool.
-        cs = colony.step_death(cs)
-        if colony.division_trigger is not None:
-            key, sub = jax.random.split(cs.key)
-            sub = jax.random.fold_in(sub, a_idx)
-            d_agents, d_alive = colony._divide(
-                cs.agents, cs.alive, sub, cs.step
-            )
-            cs = cs._replace(agents=d_agents, alive=d_alive, key=key)
-        agents = cs.agents
-        loc = get_path(agents, spatial.location_path)
-        h, w = lattice.size
-        loc = jnp.clip(
-            loc, jnp.zeros(2, loc.dtype), jnp.asarray([h, w], loc.dtype) - 1e-3
-        )
-        cs = cs._replace(
-            agents=set_path(agents, spatial.location_path, loc),
-            step=cs.step + 1,
-        )
-
-        # 5. diffusion on the strip (halo FTCS, or SPIKE ADI when the
-        # lattice opted in — see ShardedRunnerBase._diffuse_strip)
+        # 4. per-shard lifecycle + clip, 5. diffusion on the strip (halo
+        # FTCS, or SPIKE ADI when the lattice opted in — see
+        # ShardedRunnerBase._diffuse_strip)
+        cs = self._block_lifecycle(cs, a_idx)
         strip = self._diffuse_strip(strip, SPACE_AXIS, self.n_space)
         return SpatialState(colony=cs, fields=strip)
 

@@ -85,3 +85,37 @@ def force_cpu_platform(n_devices: int = 1) -> bool:
         return True
     except RuntimeError:
         return False
+
+
+def shard_map_fn():
+    """``shard_map`` across jax versions: the stable ``jax.shard_map``
+    (jax >= 0.6) when present, else the ``jax.experimental`` original
+    (same call signature for the mesh/in_specs/out_specs form every
+    caller here uses). The sharded runners went dead-on-arrival on a
+     0.4.x jaxlib without this — every ``jax.shard_map`` call raised
+    AttributeError before any collective ran."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    version = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    # The attribute alone is not proof of the stable API: the test
+    # conftest back-patches ``jax.shard_map`` for old jaxlibs, and that
+    # patched-in experimental function still defaults check_rep=True.
+    if fn is not None and version >= (0, 6):
+        return fn
+    import functools
+
+    from jax.experimental.shard_map import shard_map
+
+    # check_rep=False: the experimental checker has no replication rule
+    # for ``while`` (the LP solvers scan one), and the runners' programs
+    # are replication-correct by construction (psum-assembled fields);
+    # the stable jax.shard_map drops the knob entirely.
+    @functools.wraps(shard_map)
+    def compat(f, *, mesh, in_specs, out_specs):
+        return shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    return compat

@@ -39,3 +39,13 @@ os.environ.pop("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", None)
 import jax  # noqa: E402  (import after env setup is the whole point)
 
 jax.config.update("jax_platforms", "cpu")
+
+# jax < 0.6 ships shard_map under jax.experimental only (and has no
+# jax.P alias); the suite (and the sharded runners, via
+# utils.platform.shard_map_fn) must run on both.
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    jax.shard_map = _shard_map
+if not hasattr(jax, "P"):
+    jax.P = jax.sharding.PartitionSpec

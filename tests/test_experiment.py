@@ -1419,3 +1419,132 @@ class TestMultiSpeciesExperiment:
         with pytest.raises(ValueError, match="per-species dict"):
             with Experiment(self.config(n_agents=4)) as exp:
                 exp.initial_state()
+
+
+class TestRebalanceGateCopyDivider:
+    """ADVICE r5 #4: the segment-boundary rebalance must gate on
+    SUPPRESSED divisions (a triggered shard with an exhausted pool), not
+    on any alive row with trigger > 0 — a copy-style divider leaves the
+    trigger set on BOTH daughters after a successful division, and the
+    old gate then re-dealt the whole colony at every boundary for as
+    long as any free row existed anywhere."""
+
+    @staticmethod
+    def _register():
+        from lens_tpu.colony import Colony
+        from lens_tpu.core.engine import Compartment
+        from lens_tpu.core.process import Deriver
+        from lens_tpu.environment import Lattice, SpatialColony
+        from lens_tpu.models.composites import (
+            composite_registry,
+            register_composite,
+        )
+        from lens_tpu.processes.mm_transport import (
+            BrownianMotility,
+            MichaelisMentenTransport,
+        )
+
+        if "copy_trigger_lattice" in composite_registry:
+            return
+
+        class StickyDivideFlag(Deriver):
+            """Declares a division flag with the COPY divider and never
+            rewrites it: once set (initial-state override), a lineage
+            divides every step and both daughters stay triggered."""
+
+            name = "sticky_divide_flag"
+
+            def ports_schema(self):
+                return {
+                    "global": {
+                        "divide": {
+                            "_default": 0.0,
+                            "_divider": "copy",
+                            "_emit": False,
+                        }
+                    }
+                }
+
+            def next_update(self, timestep, states):
+                return {}
+
+        @register_composite
+        def copy_trigger_lattice(config=None):
+            comp = Compartment(
+                processes={
+                    "transport": MichaelisMentenTransport({}),
+                    "motility": BrownianMotility({"sigma": 0.0}),
+                    "sticky": StickyDivideFlag(),
+                },
+                topology={
+                    "transport": {
+                        "external": ("boundary", "external"),
+                        "internal": ("cell",),
+                        "exchange": ("boundary", "exchange"),
+                    },
+                    "motility": {"boundary": ("boundary",)},
+                    "sticky": {"global": ("global",)},
+                },
+            )
+            colony = Colony(
+                comp, capacity=64, division_trigger=("global", "divide")
+            )
+            lattice = Lattice(
+                molecules=["glucose"], shape=(8, 8), size=(8.0, 8.0),
+                diffusion=1.0, initial=10.0, timestep=1.0,
+            )
+            spatial = SpatialColony(
+                colony, lattice,
+                field_ports={
+                    "glucose": (
+                        ("boundary", "external", "glucose"),
+                        ("boundary", "exchange", "glucose_exchange"),
+                    )
+                },
+            )
+            return spatial, comp
+
+    def _run(self, monkeypatch, n_agents, stripe):
+        self._register()
+        import lens_tpu.parallel.mesh as mesh_mod
+
+        calls = []
+        real = mesh_mod.rebalance_colony_rows
+
+        def spy(cs, n_blocks):
+            calls.append(n_blocks)
+            return real(cs, n_blocks)
+
+        monkeypatch.setattr(mesh_mod, "rebalance_colony_rows", spy)
+        cfg = {
+            "composite": "copy_trigger_lattice",
+            "n_agents": n_agents,
+            "overrides": {
+                "global": {"divide": np.ones(64, np.float32)}
+            },
+            "total_time": 6.0,
+            "checkpoint_every": 2.0,
+            "mesh": {"agents": 4, "space": 1, "stripe": stripe},
+            "seed": 1,
+        }
+        with Experiment(cfg) as exp:
+            state = exp.run()
+        return calls, int(np.asarray(state.colony.alive).sum())
+
+    def test_surviving_trigger_with_local_free_rows_does_not_redeal(
+        self, monkeypatch
+    ):
+        # striped founders: every shard divides into its OWN free rows,
+        # so triggers survive each division (copy divider) but nothing
+        # is ever suppressed -> the gate must stay silent
+        calls, alive = self._run(monkeypatch, n_agents=4, stripe=True)
+        assert calls == [], "spurious re-deal on a copy-style divider"
+        assert alive == 64  # population actually multiplied to capacity
+
+    def test_genuinely_starved_shard_still_fires(self, monkeypatch):
+        # contiguous founders fill shard 0's whole block: its divisions
+        # are ALL suppressed while other shards sit empty -> the gate
+        # must fire at the first boundary
+        calls, alive = self._run(monkeypatch, n_agents=16, stripe=False)
+        assert len(calls) >= 1, "starved shard did not trigger a re-deal"
+        assert alive == 64
