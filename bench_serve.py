@@ -1,0 +1,263 @@
+"""Serving benchmark: req/s + latency vs the bare Ensemble.run ceiling.
+
+Measures what the serve layer costs over the raw device program it
+wraps. Two kinds of record, written to ``BENCH_SERVE_CPU_r08.json``
+(or ``--out``):
+
+1. **Saturation** (per lane count L): the bare ceiling — an
+   ``Ensemble(sim, L).run`` of the same composite for the same steps,
+   in row-steps/s — against the served throughput with every lane
+   occupied for the whole measurement (N = fill_rounds * L
+   equal-horizon requests, so lanes retire and refill in lockstep and
+   occupancy stays 1.0 until the drain tail). ``served_over_ceiling``
+   is the acceptance ratio: everything the scheduler adds (admission
+   scatters, per-window host transfer + slicing, Python bookkeeping)
+   shows up as the gap to 1.0.
+2. **Offered-load sweep** (per L): requests arriving at a paced rate
+   (0.5x / 0.9x / 1.5x the measured saturated req/s), p50/p95/p99
+   request latency + queue wait per load, plus reject counts at the
+   bounded queue — the latency-under-load curve a capacity planner
+   reads.
+
+Composite: ``toggle_colony`` (config-1 cell; deterministic, light
+biology) — the point is to measure the SERVING machinery, not the
+biology, so the cheapest real composite gives the most sensitive
+ratio. Window/capacity are CLI-tunable for heavier sweeps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import numpy as np
+
+from lens_tpu.colony.ensemble import Ensemble
+from lens_tpu.experiment import build_model
+from lens_tpu.serve import QueueFull, ScenarioRequest, SimServer
+from lens_tpu.serve.metrics import percentiles
+
+
+def saturation_point(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, fill_rounds: int,
+    reps: int = 3,
+):
+    """The per-lane-count saturation record: ceiling vs served,
+    INTERLEAVED min-of-reps (this host's wall clock wanders ±20% —
+    same protocol as bench_phases).
+
+    Ceiling: ``Ensemble.run`` at the serve bucket's exact shapes (same
+    emit cadence, plus a ``device_get`` of the trajectory, so the
+    device->host transfer the server also pays is inside the ceiling,
+    not counted against serving). Served: N = fill_rounds*L
+    equal-horizon requests, every lane occupied for the whole phase.
+    Both warmed before any timing; warmup samples dropped.
+    """
+    sim = build_model(composite, {}, capacity=capacity).sim
+    ens = Ensemble(sim, lanes)
+    states = ens.initial_state(1, key=jax.random.PRNGKey(0))
+    run = jax.jit(
+        lambda s: ens.run(
+            s, float(horizon_steps), 1.0, emit_every=emit_every
+        )
+    )
+    jax.block_until_ready(run(states)[0])  # compile + warm
+
+    srv = SimServer.single_bucket(
+        composite,
+        capacity=capacity,
+        lanes=lanes,
+        window=window,
+        emit_every=emit_every,
+        queue_depth=max(2 * lanes * fill_rounds, 16),
+    )
+    _warm(srv, composite, lanes, window)
+
+    n = fill_rounds * lanes
+    ceiling_wall = served_wall = float("inf")
+    busy0 = srv.metrics.counters["lane_windows_busy"]
+    total0 = srv.metrics.counters["lane_windows_total"]
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        final, traj = run(states)
+        jax.device_get(traj)
+        jax.block_until_ready(final)
+        ceiling_wall = min(ceiling_wall, time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        ids = [
+            srv.submit(ScenarioRequest(
+                composite=composite, seed=100 + rep * n + i,
+                horizon=float(horizon_steps),
+            ))
+            for i in range(n)
+        ]
+        srv.run_until_idle(max_ticks=100_000)
+        served_wall = min(served_wall, time.perf_counter() - t0)
+        assert all(
+            srv.status(r)["status"] == "done" for r in ids
+        )
+    snap = srv.metrics.snapshot()
+    # occupancy of the measured phases only (warmup windows excluded)
+    snap["occupancy"] = (
+        snap["counters"]["lane_windows_busy"] - busy0
+    ) / max(snap["counters"]["lane_windows_total"] - total0, 1)
+    srv.close()
+    ceiling = lanes * capacity * horizon_steps / ceiling_wall
+    served = n * horizon_steps * capacity / served_wall
+    return ceiling, served, n / served_wall, snap
+
+
+def _warm(srv, composite, lanes, window) -> None:
+    """Compile the admit + window programs with a throwaway round, then
+    drop its samples so the measured phase's latency percentiles and
+    occupancy are not diluted by short warmup requests."""
+    for s in range(lanes):
+        srv.submit(ScenarioRequest(
+            composite=composite, seed=s, horizon=float(window)
+        ))
+    srv.run_until_idle(max_ticks=100)
+    srv.metrics.latency_seconds.clear()
+    srv.metrics.wait_seconds.clear()
+    srv.metrics.window_seconds.clear()
+
+
+def offered_load(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, rate_req_s: float, n: int,
+):
+    """Pace ``n`` arrivals at ``rate_req_s``; tick between arrivals.
+    Returns latency/wait percentiles + reject count. Rejected requests
+    are retried until admitted (the client-backoff model), so every
+    request's latency includes its backpressure delay."""
+    srv = SimServer.single_bucket(
+        composite,
+        capacity=capacity,
+        lanes=lanes,
+        window=window,
+        emit_every=emit_every,
+        queue_depth=2 * lanes,
+    )
+    _warm(srv, composite, lanes, window)
+    busy0 = srv.metrics.counters["lane_windows_busy"]
+    total0 = srv.metrics.counters["lane_windows_total"]
+
+    interval = 1.0 / rate_req_s
+    pending = [
+        ScenarioRequest(
+            composite=composite, seed=1000 + i,
+            horizon=float(horizon_steps),
+        )
+        for i in range(n)
+    ]
+    rejects = 0
+    t0 = time.perf_counter()
+    next_arrival = t0
+    i = 0
+    while i < n:
+        now = time.perf_counter()
+        if now >= next_arrival:
+            try:
+                srv.submit(pending[i])
+                i += 1
+                next_arrival += interval
+            except QueueFull:
+                rejects += 1  # client retries at the next tick boundary
+        srv.tick()
+    srv.run_until_idle(max_ticks=100_000)
+    wall = time.perf_counter() - t0
+    lat = list(srv.metrics.latency_seconds)
+    wait = list(srv.metrics.wait_seconds)
+    snap = srv.metrics.snapshot()
+    srv.close()
+    return {
+        "offered_req_s": rate_req_s,
+        "achieved_req_s": n / wall,
+        "latency_s": percentiles(lat),
+        "queue_wait_s": percentiles(wait),
+        "rejects": rejects,
+        "occupancy": (
+            snap["counters"]["lane_windows_busy"] - busy0
+        ) / max(snap["counters"]["lane_windows_total"] - total0, 1),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--composite", default="toggle_colony")
+    # 256-row buckets: small enough to serve interactively, big enough
+    # that the window's device work is representative (a 32-row bucket
+    # measures Python dispatch, not serving — see the README of
+    # BENCH_SERVE record for the overhead-dominated small-bucket point)
+    p.add_argument("--capacity", type=int, default=256)
+    p.add_argument("--window", type=int, default=64)
+    p.add_argument("--emit-every", type=int, default=8)
+    p.add_argument(
+        "--lanes", type=int, nargs="+", default=[2, 4, 8]
+    )
+    p.add_argument(
+        "--horizon-windows", type=int, default=6,
+        help="request horizon in windows",
+    )
+    p.add_argument("--fill-rounds", type=int, default=4)
+    p.add_argument("--sweep-n", type=int, default=48)
+    p.add_argument("--out", default="BENCH_SERVE_CPU_r08.json")
+    args = p.parse_args()
+
+    horizon_steps = args.horizon_windows * args.window
+    record = {
+        "bench": "serve",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "saturation": [],
+        "offered_load": [],
+    }
+
+    for lanes in args.lanes:
+        ceiling, served, req_s, snap = saturation_point(
+            args.composite, args.capacity, lanes, args.window,
+            args.emit_every, horizon_steps, args.fill_rounds,
+        )
+        entry = {
+            "lanes": lanes,
+            "ceiling_row_steps_s": round(ceiling),
+            "served_row_steps_s": round(served),
+            "served_over_ceiling": round(served / ceiling, 4),
+            "saturated_req_s": round(req_s, 2),
+            "occupancy": snap["occupancy"],
+            "retraces": snap["retraces"],
+            "latency_s": snap["latency_seconds"],
+        }
+        record["saturation"].append(entry)
+        print(json.dumps(entry), flush=True)
+
+        for frac in (0.5, 0.9, 1.5):
+            sweep = offered_load(
+                args.composite, args.capacity, lanes, args.window,
+                args.emit_every, horizon_steps,
+                rate_req_s=max(frac * req_s, 0.5), n=args.sweep_n,
+            )
+            sweep["lanes"] = lanes
+            sweep["load_fraction"] = frac
+            record["offered_load"].append(sweep)
+            print(json.dumps(sweep), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    worst = min(
+        e["served_over_ceiling"] for e in record["saturation"]
+    )
+    print(f"worst served/ceiling ratio: {worst:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,11 +2,14 @@
 
 Replaces the reference's control/boot command surface
 (``python -m lens.actor.control experiment --number N ...``, boot scripts;
-reconstructed SURVEY.md §1 L5, §3.1) with three commands against the
+reconstructed SURVEY.md §1 L5, §3.1) with six commands against the
 experiment layer:
 
 - ``run``     start an experiment from a composite name + JSON config
 - ``resume``  continue the latest checkpoint of an experiment
+- ``serve``   continuous-batching scenario server: many small requests
+  multiplexed onto one resident jitted multi-lane program
+  (lens_tpu.serve; see docs/serving.md)
 - ``list``    show registered composites, processes, emitters
 - ``demo``    step ONE process standalone and plot it (the reference's
   per-process ``__main__`` dev harness)
@@ -22,6 +25,8 @@ Examples::
         --config '{"capacity": 1024, "shape": [64, 64]}'
     python -m lens_tpu resume --composite toggle_colony --time 400 \\
         --out-dir out/exp1
+    python -m lens_tpu serve --composite toggle_colony --lanes 8 \\
+        --requests requests.json --out-dir out/served
     python -m lens_tpu analyze out/exp1 --animate
 """
 
@@ -150,6 +155,46 @@ def _build_parser() -> argparse.ArgumentParser:
             "(view with TensorBoard's profile plugin or perfetto)",
         )
 
+    serve = sub.add_parser(
+        "serve",
+        help="serve many scenario requests through one resident "
+        "continuous-batching multi-lane program (docs/serving.md)",
+    )
+    serve.add_argument(
+        "--composite", default="toggle_colony",
+        help="the bucket's composite (one bucket per serve invocation; "
+        "the in-process SimServer API takes arbitrary bucket maps)",
+    )
+    serve.add_argument(
+        "--config", default="{}", help="composite config as JSON"
+    )
+    serve.add_argument("--capacity", type=int, default=None)
+    serve.add_argument(
+        "--lanes", type=int, default=4, help="resident lane count L"
+    )
+    serve.add_argument(
+        "--window", type=int, default=32,
+        help="steps per scheduler tick (amortizes dispatch; coarsens "
+        "admission granularity)",
+    )
+    serve.add_argument("--timestep", type=float, default=1.0)
+    serve.add_argument("--emit-every", type=int, default=1)
+    serve.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="bounded admission queue; a full queue rejects with a "
+        "retry-after hint",
+    )
+    serve.add_argument(
+        "--requests", required=True,
+        help="JSON file of request objects (or '-' for stdin): "
+        '[{"seed": 1, "horizon": 50.0, "overrides": {...}, '
+        '"deadline": 30.0, "emit": {"paths": ["alive"]}}, ...]',
+    )
+    serve.add_argument(
+        "--out-dir", default="out/serve",
+        help="per-request .lens result logs + server_meta.json land here",
+    )
+
     sub.add_parser("list", help="list composites, processes, emitters")
 
     ana = sub.add_parser(
@@ -253,6 +298,79 @@ def _experiment_config(args: argparse.Namespace) -> dict:
     }
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Drive a SimServer over a JSON request list: submit (respecting
+    backpressure by retrying after the hinted delay), run to idle,
+    report. Results stream to per-request ``.lens`` logs while the
+    scheduler is still running — tail them with
+    ``lens_tpu.emit.log.tail_records``."""
+    import time
+
+    from lens_tpu.serve import QueueFull, ScenarioRequest, SimServer
+
+    if args.requests == "-":
+        raw = json.load(sys.stdin)
+    else:
+        with open(args.requests) as f:
+            raw = json.load(f)
+    if not isinstance(raw, list):
+        raise SystemExit(
+            f"--requests must be a JSON list of request objects, got "
+            f"{type(raw).__name__}"
+        )
+
+    server = SimServer.single_bucket(
+        args.composite,
+        config=json.loads(args.config),
+        capacity=args.capacity,
+        lanes=args.lanes,
+        window=args.window,
+        timestep=args.timestep,
+        emit_every=args.emit_every,
+        queue_depth=args.queue_depth,
+        out_dir=args.out_dir,
+        sink="log",
+    )
+    with server:
+        ids = []
+        for req in raw:
+            req = dict(req)
+            req.setdefault("composite", args.composite)
+            while True:
+                try:
+                    ids.append(server.submit(ScenarioRequest(**req)))
+                    break
+                except QueueFull as e:
+                    # the CLI is its own client: drain by ticking (a
+                    # remote client would sleep e.retry_after instead)
+                    server.tick()
+                    time.sleep(min(e.retry_after, 0.05))
+        server.run_until_idle()
+        snap = server.metrics.snapshot()
+        by_status: dict = {}
+        for rid in ids:
+            st = server.status(rid)["status"]
+            by_status[st] = by_status.get(st, 0) + 1
+        occ = snap["occupancy"]  # None when no window ever ran
+        print(
+            f"served {len(ids)} requests "
+            f"({', '.join(f'{k}={v}' for k, v in sorted(by_status.items()))}) "
+            f"in {snap['counters']['ticks']} ticks / "
+            f"{snap['counters']['windows']} windows; "
+            f"occupancy={'n/a' if occ is None else f'{occ:.2f}'} "
+            f"retraces={snap['retraces']}"
+        )
+        lat = snap["latency_seconds"]
+        if lat["p50"] is not None:
+            print(
+                f"latency p50={lat['p50']:.3f}s p95={lat['p95']:.3f}s "
+                f"p99={lat['p99']:.3f}s"
+            )
+        print(f"results: {args.out_dir}/<request-id>.lens")
+        print(f"meta:    {args.out_dir}/server_meta.json")
+    return 0
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
 
@@ -306,6 +424,9 @@ def main(argv=None) -> int:
         )
         print(f"plot: {out['plot']}")
         return 0
+
+    if args.command == "serve":
+        return _cmd_serve(args)
 
     _validate_run_args(args)
 

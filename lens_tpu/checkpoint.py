@@ -12,12 +12,20 @@ are part of the state), which the tests pin.
 Layout: ``<dir>/step_<n>/`` orbax PyTree checkpoints; ``latest_step()``
 scans the directory. NamedTuple states are saved as plain nested
 containers and rebuilt by the typed ``restore_*`` helpers.
+
+Crash safety: ``save`` writes into ``step_<n>.tmp-save`` and
+``os.rename``\\ s it into place once orbax has fully committed the tree.
+``steps()`` matches only final ``step_<n>`` names, so a run killed
+mid-save can never leave a half-written directory that ``restore()``
+then picks as latest — the worst case is a stale ``.tmp-save`` dir,
+which the next save of that step silently overwrites.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import shutil
 from typing import Any, List, Optional
 
 import jax
@@ -98,8 +106,39 @@ class Checkpointer:
         return os.path.join(self.directory, f"step_{step}")
 
     def save(self, state: Any, step: int, force: bool = True) -> str:
+        """Write ``step_<n>`` atomically: orbax-save into a ``.tmp-save``
+        sibling, then rename into place. A kill at ANY point leaves
+        either the old final dir (or nothing) or the complete new one —
+        never a torn ``step_<n>/`` that ``restore()`` would pick as
+        latest. The tmp name is deterministic (not randomized) so every
+        process of a multi-host save addresses the same directory, and a
+        stale tmp from a previous kill is simply overwritten."""
         path = self._path(step)
-        self._ckpt.save(path, _to_plain(state), force=force)
+        if os.path.exists(path) and not force:
+            raise FileExistsError(
+                f"checkpoint {path} already exists (force=False)"
+            )
+        tmp = f"{path}.tmp-save"
+        self._ckpt.save(tmp, _to_plain(state), force=True)
+        # Only the coordinator promotes (multi-host orbax saves share one
+        # filesystem path; a per-process rename would race). On one host
+        # this is always true.
+        from lens_tpu.parallel.distributed import is_coordinator
+
+        if is_coordinator():
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            os.rename(tmp, path)
+        if jax.process_count() > 1:
+            # every host must observe the promotion before its save()
+            # returns — without the barrier a non-coordinator could
+            # read steps()/restore() ahead of the coordinator's rename
+            # and miss the step it just saved
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"checkpoint_promote_{step}"
+            )
         return path
 
     def steps(self) -> List[int]:

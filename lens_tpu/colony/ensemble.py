@@ -125,6 +125,31 @@ class Ensemble:
     def step(self, states, timestep: float):
         return jax.vmap(lambda s: self.sim.step(s, timestep))(states)
 
+    def step_where(self, states, active: jax.Array, timestep: float):
+        """Step only the replicates where ``active`` is True; the rest
+        keep their state BITWISE (every leaf, including the PRNG key and
+        step counter, is the old value — the replicate-axis analogue of
+        the colony's frozen dead rows).
+
+        This is what lets heterogeneous lifetimes share one resident
+        program (lens_tpu.serve packs requests with different horizons
+        into fixed lanes): the step is computed for every lane — masking
+        trades wasted FLOPs on idle lanes for a single compiled shape —
+        and a per-leaf ``where`` selects old state for inactive lanes.
+        Because the select is elementwise along the replicate axis, an
+        active lane's result is independent of what the OTHER lanes hold
+        (garbage, frozen remnants of a finished run, anything) — the
+        property the serve layer's co-batching determinism contract
+        rests on.
+        """
+        stepped = self.step(states, timestep)
+
+        def sel(new, old):
+            mask = active.reshape(active.shape + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        return jax.tree.map(sel, stepped, states)
+
     def emit_state(self, states) -> dict:
         return jax.vmap(self.sim.emit_state)(states)
 

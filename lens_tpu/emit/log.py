@@ -97,6 +97,49 @@ def read_records(path: str) -> Iterator[Dict[str, Any]]:
             yield decode_record(payload)
 
 
+def tail_records(
+    path: str, offset: int = 0
+) -> Tuple[List[Dict[str, Any]], int]:
+    """Incremental read: complete records past ``offset``, plus the new
+    offset to resume from.
+
+    The streaming counterpart of :func:`read_records`, safe against a
+    CONCURRENTLY-APPENDING writer (the serve result streamer tails a
+    log the scheduler is still writing): a frame whose header or payload
+    has not fully landed yet is left alone — the returned ``new_offset``
+    stops at the last byte of the last COMPLETE record, so the next call
+    resumes exactly there and re-reads the (by then complete) frame.
+    Returns ``([], offset)`` when nothing new is readable.
+
+    A complete frame with a bad magic or CRC is real corruption, not a
+    race with the writer (records are appended front-to-back, so bytes
+    before a complete frame's end are final) — raises ``ValueError``,
+    same as :func:`read_records`.
+    """
+    if offset < 0:
+        raise ValueError(f"offset must be >= 0, got {offset}")
+    records: List[Dict[str, Any]] = []
+    with open(path, "rb") as f:
+        f.seek(offset)
+        good = offset
+        while True:
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                return records, good  # header not fully written yet
+            magic, crc, length = _FRAME.unpack(head)
+            if magic != MAGIC:
+                raise ValueError(
+                    f"{path}: bad record magic {magic:#x} at offset {good}"
+                )
+            payload = f.read(length)
+            if len(payload) < length:
+                return records, good  # payload still being appended
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise ValueError(f"{path}: CRC mismatch at offset {good}")
+            records.append(decode_record(payload))
+            good += _FRAME.size + length
+
+
 def make_header(experiment_id: str, config: Mapping | None = None) -> Dict:
     """The experiment-header record (first record of every log)."""
     return {

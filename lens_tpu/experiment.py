@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, Mapping, Optional, Tuple
+from typing import Any, Dict, Mapping, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -132,6 +132,74 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 }
 
 
+class BuiltModel(NamedTuple):
+    """A composite factory's output, normalized to one shape.
+
+    Exactly one of ``multi`` / ``spatial`` is set for lattice
+    composites; ``colony`` is set for every single-species form (for a
+    spatial composite it is the wrapped colony). ``sim`` is the
+    steppable to hand to runners — the object exposing the colony-form
+    protocol (``initial_state`` / ``step`` / ``emit_state``).
+    """
+
+    compartment: Any
+    colony: Optional[Colony]
+    spatial: Optional[SpatialColony]
+    multi: Optional[MultiSpeciesColony]
+
+    @property
+    def sim(self):
+        return self.multi or self.spatial or self.colony
+
+
+def build_model(
+    name: str,
+    config: Mapping[str, Any] | None = None,
+    *,
+    capacity: int | None = None,
+    n_agents: Any = 1,
+    division: bool = True,
+) -> BuiltModel:
+    """Registry name + composite config -> a steppable sim.
+
+    The one place composite-factory outputs (bare ``Compartment``,
+    ``(SpatialColony, Compartment)``, ``(MultiSpeciesColony, {...})``)
+    are normalized and wrapped in a ``Colony``; both ``Experiment`` and
+    the serve layer (lens_tpu.serve) build through it so model
+    construction cannot drift between the one-shot and serving paths.
+    ``capacity``/``n_agents``/``division`` only matter for bare
+    compartments (lattice composites size their own colonies).
+    """
+    if name not in composite_registry:
+        raise ValueError(
+            f"unknown composite {name!r}; known: {sorted(composite_registry)}"
+        )
+    built = composite_registry[name](config or {})
+    if isinstance(built, tuple) and isinstance(built[0], MultiSpeciesColony):
+        multi, compartments = built
+        return BuiltModel(compartments, None, None, multi)
+    if isinstance(built, tuple):  # (SpatialColony, Compartment)
+        spatial, compartment = built
+        return BuiltModel(compartment, spatial.colony, spatial, None)
+    if isinstance(built, Compartment):
+        cap = capacity or max(int(n_agents) * 64, 64)
+        trigger = (
+            ("global", "divide")
+            if division and ("global", "divide") in built.updaters
+            else None
+        )
+        from lens_tpu.models.composites import _death_trigger_of
+
+        colony = Colony(
+            built,
+            capacity=cap,
+            division_trigger=trigger,
+            death_trigger=_death_trigger_of(built),
+        )
+        return BuiltModel(built, colony, None, None)
+    raise TypeError(f"composite factory {name!r} returned {type(built)!r}")
+
+
 def _jsonable(node):
     """Config tree -> plain JSON-serializable types (tuples -> lists,
     arrays -> lists, anything else -> str) for the log header's
@@ -234,41 +302,17 @@ class Experiment:
             self.config["config"] = deep_merge(
                 {"coupling": self.config["coupling"]}, self.config["config"]
             )
-        built = composite_registry[name](self.config["config"])
-        self.spatial: Optional[SpatialColony] = None
-        self.multi = None  # MultiSpeciesColony composites (config 4)
-        if isinstance(built, tuple) and isinstance(
-            built[0], MultiSpeciesColony
-        ):
-            # (MultiSpeciesColony, {name: Compartment})
-            self.multi, self.compartment = built
-            self.colony = None
-        elif isinstance(built, tuple):  # (SpatialColony, Compartment)
-            self.spatial, self.compartment = built
-            self.colony = self.spatial.colony
-        elif isinstance(built, Compartment):
-            self.compartment = built
-            capacity = self.config["capacity"] or max(
-                int(self.config["n_agents"]) * 64, 64
-            )
-            trigger = (
-                ("global", "divide")
-                if self.config["division"]
-                and ("global", "divide") in built.updaters
-                else None
-            )
-            from lens_tpu.models.composites import _death_trigger_of
-
-            self.colony = Colony(
-                built,
-                capacity=capacity,
-                division_trigger=trigger,
-                death_trigger=_death_trigger_of(built),
-            )
-        else:
-            raise TypeError(
-                f"composite factory {name!r} returned {type(built)!r}"
-            )
+        built = build_model(
+            name,
+            self.config["config"],
+            capacity=self.config["capacity"],
+            n_agents=self.config["n_agents"],
+            division=self.config["division"],
+        )
+        self.compartment = built.compartment
+        self.spatial: Optional[SpatialColony] = built.spatial
+        self.multi = built.multi  # MultiSpeciesColony composites (config 4)
+        self.colony = built.colony
         if self.config["timeline"] is not None and self.spatial is None \
                 and self.multi is None:
             # without this the run loop would fall through to the plain

@@ -1,0 +1,38 @@
+"""lens_tpu.serve: continuous-batching scenario serving.
+
+One resident jitted multi-lane window program per (composite, shape)
+bucket; a host scheduler packs many small user scenarios — each with its
+own seed, parameter overrides, horizon, and emit spec — into fixed
+vmapped lanes, with bounded-queue backpressure, deadlines, cancellation,
+and counters. See docs/serving.md for the architecture and the
+determinism contract.
+"""
+
+from lens_tpu.serve.batcher import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    QUEUED,
+    QueueFull,
+    RUNNING,
+    TIMEOUT,
+    ScenarioRequest,
+)
+from lens_tpu.serve.lanes import LanePool
+from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
+from lens_tpu.serve.server import SimServer
+
+__all__ = [
+    "CANCELLED",
+    "DONE",
+    "FAILED",
+    "QUEUED",
+    "QueueFull",
+    "RUNNING",
+    "TIMEOUT",
+    "LanePool",
+    "ScenarioRequest",
+    "ServerMetrics",
+    "SimServer",
+    "write_server_meta",
+]

@@ -1,0 +1,174 @@
+"""Request admission: bounded queue, buckets, deadlines, backpressure.
+
+Host-side scheduling policy, kept apart from the device mechanics
+(lanes.py) on purpose: everything in this module is plain Python over
+plain data, so the queueing behavior is unit-testable without ever
+compiling a program.
+
+Shape discipline is the organizing idea, borrowed from inference-stack
+continuous batching: a resident program serves exactly one (composite,
+config, capacity, lane-count, window) BUCKET, requests are routed to
+their bucket by composite name, and anything per-request must be DATA
+(seed, initial-state overrides, horizon, emit spec) — never shape. A
+request that would need a different shape belongs in a different bucket.
+
+Backpressure is reject-with-retry-after, not unbounded buffering: the
+queue is bounded, a full queue refuses the submit, and the hint quotes
+how long the present backlog would take to drain at the measured window
+rate — the client's cue to back off (the serving analogue of HTTP 429 +
+Retry-After).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+#: Request lifecycle states. Terminal: DONE, TIMEOUT, CANCELLED, FAILED.
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+TIMEOUT = "timeout"
+CANCELLED = "cancelled"
+FAILED = "failed"
+
+
+class QueueFull(Exception):
+    """Bounded-queue backpressure: resubmit after ``retry_after`` seconds.
+
+    Deliberately an exception, not a None return: a dropped request must
+    be impossible to ignore silently at the call site.
+    """
+
+    def __init__(self, retry_after: float, depth: int):
+        self.retry_after = float(retry_after)
+        self.depth = int(depth)
+        super().__init__(
+            f"request queue full ({depth} deep); retry in "
+            f"~{self.retry_after:.2f}s"
+        )
+
+
+@dataclass
+class ScenarioRequest:
+    """One serving request: WHICH resident program (composite -> bucket)
+    plus the per-request data that rides the lane.
+
+    horizon:
+        Sim seconds to run (must be a positive multiple of the bucket's
+        timestep, and its step count a multiple of the bucket's
+        emit_every — same divisibility contract as ``scan_schedule``).
+    overrides:
+        Initial-state overrides (schema-variable paths -> values), the
+        same surface as a one-shot run's ``overrides`` config. Data
+        only — shapes are the bucket's.
+    n_agents:
+        Initially-alive rows (int, or per-species mapping for
+        multi-species buckets); None -> the bucket default.
+    emit:
+        Optional host-side emit spec: ``{"paths": [...]}`` keeps only
+        leaves whose joined path starts with one of the prefixes;
+        ``{"every": k}`` keeps every k-th emitted record (relative to
+        the request's own start). Both filter AFTER the device emits at
+        the bucket cadence, so they never change compiled shapes (or
+        the bits of what is kept).
+    deadline:
+        Wall-clock seconds from submit; expired requests (queued OR
+        mid-run) retire as TIMEOUT at the next tick, keeping whatever
+        records they already streamed.
+    """
+
+    composite: str
+    seed: int = 0
+    horizon: float = 10.0
+    overrides: Mapping[str, Any] = field(default_factory=dict)
+    n_agents: Any = None
+    emit: Optional[Mapping[str, Any]] = None
+    deadline: Optional[float] = None
+
+
+@dataclass
+class Ticket:
+    """Scheduler-side bookkeeping for one submitted request."""
+
+    request_id: str
+    request: ScenarioRequest
+    status: str = QUEUED
+    error: Optional[str] = None
+    horizon_steps: int = 0
+    steps_done: int = 0
+    lane: Optional[int] = None
+    submitted_at: float = field(default_factory=time.perf_counter)
+    admitted_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    cancel_requested: bool = False
+    emit_count: int = 0  # emitted records streamed so far (pre-filter)
+    result_path: Optional[str] = None
+
+    def expired(self, now: float) -> bool:
+        return (
+            self.request.deadline is not None
+            and now - self.submitted_at > self.request.deadline
+        )
+
+
+class RequestQueue:
+    """Bounded FIFO of tickets awaiting a lane.
+
+    ``take(bucket_of, free_lanes)`` pops admissible tickets in FIFO
+    order, skipping (not blocking on) tickets whose bucket has no free
+    lane — one saturated bucket must not head-of-line-block the others.
+    """
+
+    def __init__(self, max_depth: int = 64):
+        if max_depth < 1:
+            raise ValueError(f"max_depth={max_depth} must be >= 1")
+        self.max_depth = int(max_depth)
+        self._queue: List[Ticket] = []
+        self._ids = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def push(self, ticket: Ticket, retry_after: float) -> None:
+        if len(self._queue) >= self.max_depth:
+            raise QueueFull(retry_after, len(self._queue))
+        self._queue.append(ticket)
+
+    def next_id(self) -> str:
+        return f"req-{next(self._ids):06d}"
+
+    def drop(self, ticket: Ticket) -> bool:
+        """Remove a specific queued ticket (cancel/expiry)."""
+        try:
+            self._queue.remove(ticket)
+            return True
+        except ValueError:
+            return False
+
+    def expire(self, now: float) -> List[Ticket]:
+        """Pop every queued ticket whose deadline has passed."""
+        expired = [t for t in self._queue if t.expired(now)]
+        for t in expired:
+            self._queue.remove(t)
+        return expired
+
+    def take(
+        self, bucket_of, free_lanes: Dict[str, int]
+    ) -> List[Ticket]:
+        """FIFO admission pass: tickets whose bucket still has a free
+        lane, decrementing ``free_lanes`` as it goes. ``bucket_of`` maps
+        a ticket to its bucket name."""
+        taken: List[Ticket] = []
+        rest: List[Ticket] = []
+        for t in self._queue:
+            b = bucket_of(t)
+            if free_lanes.get(b, 0) > 0:
+                free_lanes[b] -= 1
+                taken.append(t)
+            else:
+                rest.append(t)
+        self._queue = rest
+        return taken

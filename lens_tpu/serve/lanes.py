@@ -1,0 +1,287 @@
+"""Fixed lane pools: L logical scenarios resident in ONE jitted program.
+
+The serving primitive. A ``LanePool`` wraps any colony-form sim
+(:class:`~lens_tpu.colony.colony.Colony`, ``SpatialColony``,
+``MultiSpeciesColony``) in an :class:`~lens_tpu.colony.ensemble.Ensemble`
+of ``n_lanes`` replicates and keeps exactly TWO device programs hot for
+the server's whole lifetime:
+
+- ``_admit``: scatter one freshly-built solo state into lane ``i`` and
+  arm its remaining-steps counter (``i`` and the counter are traced
+  scalars, so every admission reuses one compile);
+- ``_window``: advance every lane by ``window_steps`` steps, freezing
+  lanes whose per-lane ``remaining`` counter hits zero mid-window
+  (``Ensemble.step_where`` — the replicate-axis version of the colony's
+  dead-row alive mask), collecting the emit slice every ``emit_every``
+  steps. One trace at construction shapes; retraces are a bug the
+  metrics surface.
+
+Heterogeneous horizons ride the ``remaining`` vector: a request needing
+37 more steps and one needing 4,000 share the same window dispatch, and
+a finished lane costs (masked) FLOPs but never a recompile. Determinism
+contract: a lane's trajectory depends only on its own admitted state —
+``step_where``'s select is elementwise along the lane axis and the serve
+path contains no cross-lane reduction — so a request's bits are
+identical served solo or co-batched (pinned in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lens_tpu.colony.colony import Colony
+from lens_tpu.colony.ensemble import Ensemble
+
+
+def _solo_initial_state(
+    sim: Any,
+    n_agents: Any,
+    key: jax.Array,
+    overrides: Mapping | None = None,
+):
+    """``initial_state`` across the three colony forms' signatures.
+
+    The solo construction is the determinism anchor: the state scattered
+    into a lane is built exactly as a one-shot run would build it (same
+    seed -> same bits), so "served" vs "ran alone" can only differ if
+    the window program itself coupled lanes.
+    """
+    if isinstance(sim, Colony):
+        return sim.initial_state(
+            int(n_agents), overrides=overrides or None, key=key
+        )
+    # SpatialColony and MultiSpeciesColony share (n, key, overrides=...);
+    # the multi form takes a per-species count mapping.
+    if isinstance(n_agents, Mapping):
+        n_agents = {k: int(v) for k, v in n_agents.items()}
+    else:
+        n_agents = int(n_agents)
+    return sim.initial_state(n_agents, key, overrides=overrides or None)
+
+
+class LanePool:
+    """``n_lanes`` independent scenario slots over one resident program.
+
+    Parameters
+    ----------
+    sim:
+        The bucket's steppable (one per composite/shape bucket — every
+        request served by this pool shares the compiled shapes).
+    n_lanes:
+        Lane count L. Throughput scales with occupied lanes; idle lanes
+        cost masked compute, so L is a capacity/latency knob, not free.
+    window_steps:
+        Steps per scheduler tick. Larger windows amortize dispatch and
+        host round-trips (better throughput ceiling) but coarsen the
+        admission/retire granularity (worse queueing latency).
+    timestep:
+        Sim seconds per step (must match the sim's own dt constraints,
+        e.g. a lattice's diffusion dt).
+    emit_every:
+        Steps between emitted slices inside the window;
+        ``window_steps`` must be a positive multiple.
+    """
+
+    def __init__(
+        self,
+        sim: Any,
+        n_lanes: int,
+        window_steps: int = 32,
+        timestep: float = 1.0,
+        emit_every: int = 1,
+    ):
+        if n_lanes < 1:
+            raise ValueError(f"n_lanes={n_lanes} must be >= 1")
+        if window_steps < 1 or emit_every < 1 \
+                or window_steps % emit_every != 0:
+            raise ValueError(
+                f"window_steps ({window_steps}) must be a positive "
+                f"multiple of emit_every ({emit_every})"
+            )
+        self.sim = sim
+        self.ensemble = Ensemble(sim, n_lanes)
+        self.n_lanes = int(n_lanes)
+        self.window_steps = int(window_steps)
+        self.timestep = float(timestep)
+        self.emit_every = int(emit_every)
+        self.emits_per_window = self.window_steps // self.emit_every
+
+        # Idle-lane filler: an empty (0 alive) solo state broadcast to
+        # every lane. Its contents are never observed — admission
+        # overwrites the whole lane, step_where freezes it — it only
+        # pins shapes/dtypes for the resident program.
+        template = _solo_initial_state(
+            sim, self._zero_agents(), jax.random.PRNGKey(0)
+        )
+        self.states = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x, (self.n_lanes,) + jnp.shape(x)
+            ).copy(),
+            template,
+        )
+        self.remaining = jnp.zeros(self.n_lanes, jnp.int32)
+        # Host mirror of ``remaining``: admission/retire arithmetic is
+        # fully host-predictable (arm H, subtract min(window, left) per
+        # window), so the scheduler never reads the device counter —
+        # reading it would force a device sync per window, which
+        # measurably caps served/ceiling throughput (bench_serve.py).
+        # The device array stays authoritative for the in-window mask.
+        self.remaining_host = np.zeros(self.n_lanes, np.int64)
+
+        ens, dt = self.ensemble, self.timestep
+        emit_every, n_emits = self.emit_every, self.emits_per_window
+
+        def window(states, remaining):
+            def emit_block(carry, _):
+                st, rem = carry
+
+                def one_step(c, _):
+                    st2, rem2 = c
+                    active = rem2 > 0
+                    st2 = ens.step_where(st2, active, dt)
+                    return (st2, rem2 - active.astype(rem2.dtype)), None
+
+                (st, rem), _ = jax.lax.scan(
+                    one_step, (st, rem), None, length=emit_every
+                )
+                return (st, rem), ens.emit_state(st)
+
+            (states, remaining), traj = jax.lax.scan(
+                emit_block, (states, remaining), None, length=n_emits
+            )
+            return states, remaining, traj
+
+        # Donate the lane states on accelerators: the old buffer is dead
+        # after the window returns, and the pool is the largest resident
+        # allocation. CPU skips donation (XLA:CPU ignores it and warns —
+        # same policy as SpatialColony's cached window program).
+        donate = jax.default_backend() != "cpu"
+        self._window = jax.jit(
+            window, donate_argnums=(0,) if donate else ()
+        )
+
+        def admit(states, remaining, lane, solo, steps):
+            states = jax.tree.map(
+                lambda pool, s: pool.at[lane].set(s), states, solo
+            )
+            return states, remaining.at[lane].set(steps)
+
+        # lane/steps are traced scalars: one compile serves every
+        # admission into every lane
+        self._admit = jax.jit(
+            admit, donate_argnums=(0, 1) if donate else ()
+        )
+        self._release = jax.jit(
+            lambda remaining, lane: remaining.at[lane].set(0),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    def _zero_agents(self):
+        """The 'no live rows' n_agents for this sim form."""
+        from lens_tpu.environment.multispecies import MultiSpeciesColony
+
+        if isinstance(self.sim, MultiSpeciesColony):
+            return {name: 0 for name in self.sim.species}
+        return 0
+
+    def default_agents(self, n: Any = None):
+        """Normalize an n_agents default to this sim form: ints fan out
+        to every species of a multi-species sim (a bare int would crash
+        its per-species ``initial_state``); ``None`` means one agent
+        (per species)."""
+        zero = self._zero_agents()
+        if n is None:
+            n = 1
+        if isinstance(zero, dict) and not isinstance(n, Mapping):
+            return {name: int(n) for name in zero}
+        return n
+
+    # -- admission -----------------------------------------------------------
+
+    def admit(
+        self,
+        lane: int,
+        seed: int,
+        horizon_steps: int,
+        n_agents: Any = None,
+        overrides: Mapping | None = None,
+    ) -> None:
+        """Build a solo initial state (request seed, request overrides)
+        and scatter it into ``lane``, arming ``horizon_steps``.
+
+        Raises whatever the sim's own override/count validation raises —
+        the scheduler maps that to a FAILED request instead of letting
+        one bad request poison the pool.
+        """
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        if horizon_steps < 1:
+            raise ValueError(
+                f"horizon_steps={horizon_steps} must be >= 1"
+            )
+        n_agents = self.default_agents(n_agents)
+        solo = _solo_initial_state(
+            self.sim,
+            n_agents,
+            jax.random.PRNGKey(int(seed)),
+            overrides=overrides,
+        )
+        self.states, self.remaining = self._admit(
+            self.states,
+            self.remaining,
+            jnp.int32(lane),
+            solo,
+            jnp.int32(horizon_steps),
+        )
+        self.remaining_host[lane] = int(horizon_steps)
+
+    def release(self, lane: int) -> None:
+        """Free a lane before its horizon elapsed (cancel/deadline): zero
+        the remaining counter so the next window freezes it. The stale
+        state stays in place — frozen, unobserved, overwritten by the
+        next admission."""
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        self.remaining = self._release(self.remaining, jnp.int32(lane))
+        self.remaining_host[lane] = 0
+
+    # -- stepping ------------------------------------------------------------
+
+    def run_window(self) -> Tuple[np.ndarray, Any]:
+        """One resident-program dispatch: every lane advances up to
+        ``window_steps`` of ITS OWN remaining steps.
+
+        Returns ``(remaining_before, trajectory)`` where
+        ``remaining_before`` is the host MIRROR of the pre-window
+        counters (what the scheduler needs to slice each lane's VALID
+        emit rows — no device read: the mirror is exact by arithmetic)
+        and ``trajectory`` is the device emit stack, leaves
+        ``[emits_per_window, n_lanes, ...]``.
+        """
+        remaining_before = self.remaining_host.copy()
+        self.states, self.remaining, traj = self._window(
+            self.states, self.remaining
+        )
+        self.remaining_host = np.maximum(
+            remaining_before - self.window_steps, 0
+        )
+        return remaining_before, traj
+
+    def retraces(self) -> int:
+        """Compiles of the window program beyond the expected one — the
+        serving-layer regression the metrics export watches."""
+        size = getattr(self._window, "_cache_size", None)
+        if size is None:
+            return 0
+        return max(int(size()) - 1, 0)
+
+    def valid_emits(self, remaining_before: int) -> int:
+        """How many of this window's emit rows a lane with
+        ``remaining_before`` steps left actually produced (rows past its
+        horizon are frozen state — dropped host-side)."""
+        steps_run = min(int(remaining_before), self.window_steps)
+        return steps_run // self.emit_every

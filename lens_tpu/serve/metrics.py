@@ -1,0 +1,146 @@
+"""Serving counters: the numbers that tell you whether the server is keeping up.
+
+The reference lineage has no serving tier to observe; the inference
+stacks this subsystem borrows its shape from (continuous-batching LLM
+servers, Podracer actor pools) live and die by a small set of gauges —
+queue depth, lane occupancy, admit/retire/timeout rates, retraces — so
+the serve layer carries the same set from day one. Everything here is
+host-side Python (incremented by the scheduler loop between device
+dispatches); nothing touches the jitted window program.
+
+``ServerMetrics.snapshot()`` is the one read surface: the CLI summary,
+the ``server_meta.json`` sidecar, tests, and ``bench_serve.py`` all
+consume it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+
+def percentiles(samples: List[float], points=(50.0, 95.0, 99.0)) -> Dict[str, Optional[float]]:
+    """{"p50": ..., "p95": ..., "p99": ...} by linear interpolation —
+    tiny and dependency-free so metrics never import numpy for three
+    numbers. Empty input yields ``None`` entries (a server that served
+    nothing has no latency, not a zero latency)."""
+    out: Dict[str, Optional[float]] = {}
+    ordered = sorted(samples)
+    for p in points:
+        key = f"p{p:g}"
+        if not ordered:
+            out[key] = None
+            continue
+        rank = (len(ordered) - 1) * (p / 100.0)
+        lo = int(rank)
+        hi = min(lo + 1, len(ordered) - 1)
+        out[key] = ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
+    return out
+
+
+class ServerMetrics:
+    """Counters + gauges + latency samples for one ``SimServer``.
+
+    Counter semantics (all monotonic over the server's lifetime):
+
+    - ``submitted``/``rejected``: every ``submit`` call lands in exactly
+      one of these (rejected = bounded-queue backpressure).
+    - ``admitted``: requests scattered into a lane.
+    - ``retired``: horizons that ran to completion.
+    - ``timeouts``: deadline expiries (queued or mid-run).
+    - ``cancelled``: explicit cancels (queued or mid-run).
+    - ``failed``: admission-time construction errors (bad overrides).
+    - ``ticks``: scheduler iterations; ``windows``: device window
+      programs actually dispatched (a tick with no occupied lanes runs
+      no window).
+    - ``lane_windows_busy`` / ``lane_windows_total``: per-window lane
+      occupancy accumulators — their ratio is the mean occupancy, the
+      serving analogue of duty cycle.
+    - ``retraces``: compiled-program count of the window executable
+      beyond the expected single trace; anything nonzero means a shape
+      leaked into the hot loop.
+    """
+
+    _COUNTERS = (
+        "submitted",
+        "rejected",
+        "admitted",
+        "retired",
+        "timeouts",
+        "cancelled",
+        "failed",
+        "ticks",
+        "windows",
+        "lane_windows_busy",
+        "lane_windows_total",
+    )
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = {k: 0 for k in self._COUNTERS}
+        self.queue_depth = 0
+        self.lanes_busy = 0
+        self.lanes_total = 0
+        self.retraces = 0
+        self._t0 = time.perf_counter()
+        # per finished request: wall seconds submit->admit and submit->done
+        self.wait_seconds: List[float] = []
+        self.latency_seconds: List[float] = []
+        self.window_seconds: List[float] = []
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] += by
+
+    def observe_request(self, wait_s: float, total_s: float) -> None:
+        self.wait_seconds.append(float(wait_s))
+        self.latency_seconds.append(float(total_s))
+
+    def observe_window(self, wall_s: float) -> None:
+        self.window_seconds.append(float(wall_s))
+
+    def avg_window_seconds(self, default: float = 0.1) -> float:
+        """Recent mean window wall time — the unit the backpressure
+        retry-after hint is quoted in. Falls back to ``default`` before
+        the first window has run (cold server, nothing measured)."""
+        recent = self.window_seconds[-32:]
+        return sum(recent) / len(recent) if recent else default
+
+    def occupancy(self) -> Optional[float]:
+        total = self.counters["lane_windows_total"]
+        if total == 0:
+            return None
+        return self.counters["lane_windows_busy"] / total
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "counters": dict(self.counters),
+            "queue_depth": self.queue_depth,
+            "lanes_busy": self.lanes_busy,
+            "lanes_total": self.lanes_total,
+            "occupancy": self.occupancy(),
+            "retraces": self.retraces,
+            "uptime_seconds": time.perf_counter() - self._t0,
+            "avg_window_seconds": (
+                self.avg_window_seconds() if self.window_seconds else None
+            ),
+            "latency_seconds": percentiles(self.latency_seconds),
+            "wait_seconds": percentiles(self.wait_seconds),
+        }
+
+
+def write_server_meta(
+    out_dir: str, config: Mapping[str, Any], metrics: ServerMetrics
+) -> str:
+    """The ``server_meta.json`` sidecar: serving config + final counter
+    snapshot, beside the per-request result logs — the serve analogue of
+    the run path's ``colony_meta.json`` (provenance that is not
+    recoverable from the data files themselves)."""
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "server_meta.json")
+    payload = {"config": dict(config), **metrics.snapshot()}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,577 @@
+"""SimServer: a resident, continuously-batched scenario server.
+
+The ROADMAP north star is "serves heavy traffic" — but every
+``python -m lens_tpu run`` pays interpreter boot + trace + compile per
+invocation, which caps the request rate at compiles per second, not
+agent-steps per second. The fix is the inference-stack shape (one
+resident program, many logical sequences packed into fixed slots,
+host scheduler feeding it — Podracer's Sebulba, TF-Agents' batched
+environments, LLM continuous batching):
+
+- each configured BUCKET compiles one multi-lane window program at
+  startup (lanes.LanePool over the existing Ensemble machinery) and
+  keeps it hot for the server's lifetime;
+- a host scheduler loop (``tick``) admits queued requests into free
+  lanes, dispatches one window, streams each lane's freshly-produced
+  records out through the framed emit-log format, and retires lanes
+  whose horizon elapsed — requests with wildly different horizons
+  share every dispatch;
+- a bounded queue rejects with a retry-after hint when full
+  (batcher.QueueFull), per-request wall-clock deadlines expire queued
+  AND running work, and counters (metrics.ServerMetrics) plus a
+  ``server_meta.json`` sidecar make the whole thing observable.
+
+Determinism contract (pinned in tests/test_serve.py): a request's
+emitted trajectory is BITWISE identical served solo or co-batched with
+arbitrary other requests, across admission orders — per-request PRNG
+keys, elementwise lane masking, and no cross-lane reduction anywhere in
+the serve path.
+
+Use in-process (tests, bench_serve.py)::
+
+    server = SimServer.single_bucket("toggle_colony", lanes=8)
+    rid = server.submit(ScenarioRequest(composite="toggle_colony",
+                                        seed=7, horizon=50.0))
+    server.run_until_idle()
+    ts = server.result(rid)          # {"__times__": [T], leaves [T, ...]}
+
+or from the CLI: ``python -m lens_tpu serve --requests reqs.json``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+import jax
+import numpy as np
+
+from lens_tpu.emit import LogEmitter
+from lens_tpu.emit.log import SEP
+from lens_tpu.serve.batcher import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    QUEUED,
+    QueueFull,
+    RUNNING,
+    TIMEOUT,
+    RequestQueue,
+    ScenarioRequest,
+    Ticket,
+)
+from lens_tpu.serve.lanes import LanePool
+from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
+from lens_tpu.utils.dicts import flatten_paths, get_path, set_path
+
+#: Per-bucket knobs and their defaults; see ``SimServer`` docstring.
+BUCKET_DEFAULTS: Dict[str, Any] = {
+    "composite": None,      # registry name (None: the bucket's own key)
+    "config": {},           # composite factory config (shared per bucket)
+    "capacity": None,       # colony rows (bare compartments; None: default)
+    "n_agents": 1,          # default initially-alive rows per request
+    "division": True,       # watch ('global','divide') for bare compartments
+    "lanes": 4,             # resident lane count L
+    "window": 32,           # steps per scheduler tick
+    "timestep": 1.0,        # sim seconds per step
+    "emit_every": 1,        # device emit cadence within the window
+}
+
+
+def _filter_paths(tree: Mapping, prefixes: List[str]) -> Dict:
+    """Keep leaves whose ``/``-joined path starts with any prefix
+    (component-aligned: prefix ``cell`` matches ``cell/volume``, not
+    ``cells``). Host-side, post-device — a pure projection of the
+    emitted bits, so it can never perturb them."""
+    out: Dict = {}
+    for path, value in flatten_paths(tree):
+        joined = SEP.join(str(p) for p in path)
+        if any(
+            joined == p or joined.startswith(p + SEP) for p in prefixes
+        ):
+            out = set_path(out, path, value)
+    return out
+
+
+class _RamResult:
+    """In-process result sink: per-window segments, stacked on read."""
+
+    def __init__(self) -> None:
+        self._times: List[np.ndarray] = []
+        self._segments: List[Dict] = []
+
+    def append(self, tree: Mapping, times: np.ndarray) -> None:
+        self._segments.append(dict(tree))
+        self._times.append(np.asarray(times))
+
+    def close(self) -> None:
+        pass
+
+    def timeseries(self) -> Dict[str, Any]:
+        if not self._segments:
+            return {"__times__": np.zeros(0)}
+        out: Dict[str, Any] = {}
+        for path, _ in flatten_paths(self._segments[0]):
+            leaves = [
+                np.asarray(get_path(seg, path)) for seg in self._segments
+            ]
+            out = set_path(out, path, np.concatenate(leaves))
+        out["__times__"] = np.concatenate(self._times)
+        return out
+
+
+class _LogResult:
+    """Disk result sink: one framed ``.lens`` log per request (header +
+    one SEGMENT record per window), flushed after every append so a
+    concurrent reader can stream it with ``emit.log.tail_records``."""
+
+    def __init__(self, path: str, request_id: str, config: Mapping,
+                 stream_flush: bool = True):
+        self.path = path
+        self._stream_flush = stream_flush
+        # A request wholly owns its log. LogEmitter APPENDS (the run
+        # path's resume semantics) — but serve request ids restart at
+        # req-000000 per server, so a reused out_dir would silently
+        # interleave a stale run's records into this request's stream
+        # (and poison tailing readers). Truncate instead.
+        if os.path.exists(path):
+            os.remove(path)
+        self._emitter = LogEmitter(
+            experiment_id=request_id, config=config, path=path
+        )
+
+    def append(self, tree: Mapping, times: np.ndarray) -> None:
+        self._emitter.emit_trajectory(tree, times=times)
+        if self._stream_flush:
+            self._emitter.flush()
+
+    def close(self) -> None:
+        self._emitter.close()
+
+    def timeseries(self) -> str:
+        return self.path
+
+
+class _Bucket:
+    """One resident program + its lane assignments."""
+
+    def __init__(self, name: str, cfg: Dict[str, Any]):
+        from lens_tpu.experiment import build_model
+        from lens_tpu.utils.dicts import deep_merge
+
+        self.name = name
+        self.cfg = cfg = deep_merge(BUCKET_DEFAULTS, cfg or {})
+        composite = cfg["composite"] or name
+        built = build_model(
+            composite,
+            cfg["config"],
+            capacity=cfg["capacity"],
+            n_agents=cfg["n_agents"],
+            division=cfg["division"],
+        )
+        self.pool = LanePool(
+            built.sim,
+            n_lanes=int(cfg["lanes"]),
+            window_steps=int(cfg["window"]),
+            timestep=float(cfg["timestep"]),
+            emit_every=int(cfg["emit_every"]),
+        )
+        # normalize the bucket's n_agents default to the sim form once
+        # (an int fans out per species on multi-species buckets)
+        cfg["n_agents"] = self.pool.default_agents(cfg["n_agents"])
+        self.assignments: Dict[int, Ticket] = {}
+
+    def free_lanes(self) -> int:
+        return self.pool.n_lanes - len(self.assignments)
+
+    def next_free_lane(self) -> int:
+        return next(
+            i for i in range(self.pool.n_lanes)
+            if i not in self.assignments
+        )
+
+
+class SimServer:
+    """Continuous-batching scenario server over vmapped simulation lanes.
+
+    Parameters
+    ----------
+    buckets:
+        ``{bucket_name: bucket_config}`` — each entry compiles one
+        resident multi-lane program (knobs: ``BUCKET_DEFAULTS``).
+        Requests route to the bucket whose name matches their
+        ``composite`` field.
+    queue_depth:
+        Bound on requests waiting for a lane, across all buckets. A
+        full queue rejects (``QueueFull`` with a retry-after hint).
+    out_dir / sink:
+        ``sink="ram"`` keeps results in process (tests, bench);
+        ``sink="log"`` streams each request to
+        ``<out_dir>/<request_id>.lens`` — readable while still being
+        written via :func:`lens_tpu.emit.log.tail_records`.
+    stream_flush:
+        With the log sink, flush after every window so concurrent
+        readers see records promptly (off = fewer fsync-ish stalls,
+        records visible only at close).
+    """
+
+    def __init__(
+        self,
+        buckets: Mapping[str, Mapping[str, Any]],
+        queue_depth: int = 64,
+        out_dir: Optional[str] = None,
+        sink: str = "ram",
+        stream_flush: bool = True,
+    ):
+        if not buckets:
+            raise ValueError("SimServer needs at least one bucket")
+        if sink not in ("ram", "log"):
+            raise ValueError(f"unknown sink {sink!r}; known: ram, log")
+        if sink == "log" and not out_dir:
+            raise ValueError("sink='log' needs out_dir")
+        self.buckets = {
+            name: _Bucket(name, dict(cfg or {}))
+            for name, cfg in buckets.items()
+        }
+        self.queue = RequestQueue(queue_depth)
+        self.metrics = ServerMetrics()
+        self.metrics.lanes_total = sum(
+            b.pool.n_lanes for b in self.buckets.values()
+        )
+        self.out_dir = out_dir
+        self.sink = sink
+        self.stream_flush = stream_flush
+        self.tickets: Dict[str, Ticket] = {}
+        self._results: Dict[str, Any] = {}
+        self._closed = False
+
+    @classmethod
+    def single_bucket(cls, composite: str, **kwargs) -> "SimServer":
+        """Convenience: one bucket named after its composite. Bucket
+        knobs (lanes, window, ...) ride ``kwargs``; server knobs
+        (queue_depth, out_dir, sink, stream_flush) are split off."""
+        server_keys = ("queue_depth", "out_dir", "sink", "stream_flush")
+        server_kwargs = {
+            k: kwargs.pop(k) for k in server_keys if k in kwargs
+        }
+        return cls({composite: kwargs}, **server_kwargs)
+
+    # -- client surface ------------------------------------------------------
+
+    def submit(self, request: ScenarioRequest | Mapping[str, Any]) -> str:
+        """Queue a request; returns its request id.
+
+        Raises ``ValueError`` for malformed requests (unknown bucket,
+        horizon not on the bucket's step/emit grid — caller bugs) and
+        ``QueueFull`` for backpressure (a healthy client retries after
+        ``.retry_after`` seconds).
+        """
+        if isinstance(request, Mapping):
+            request = ScenarioRequest(**request)
+        bucket = self.buckets.get(request.composite)
+        if bucket is None:
+            raise ValueError(
+                f"no bucket serves composite {request.composite!r}; "
+                f"configured: {sorted(self.buckets)}"
+            )
+        pool = bucket.pool
+        steps = int(round(float(request.horizon) / pool.timestep))
+        if steps < 1 or abs(
+            steps * pool.timestep - float(request.horizon)
+        ) > 1e-6 * max(abs(float(request.horizon)), 1.0):
+            raise ValueError(
+                f"horizon={request.horizon} is not a positive multiple "
+                f"of the bucket timestep {pool.timestep}"
+            )
+        if steps % pool.emit_every != 0:
+            raise ValueError(
+                f"horizon steps ({steps}) must be a multiple of the "
+                f"bucket emit_every ({pool.emit_every})"
+            )
+        every = int((request.emit or {}).get("every", 1))
+        if every < 1:
+            raise ValueError(f"emit every={every} must be >= 1")
+        ticket = Ticket(
+            request_id=self.queue.next_id(),
+            request=request,
+            horizon_steps=steps,
+        )
+        try:
+            self.queue.push(ticket, retry_after=self._retry_after())
+        except QueueFull:
+            self.metrics.inc("rejected")
+            self.metrics.queue_depth = len(self.queue)
+            raise
+        self.metrics.inc("submitted")
+        self.metrics.queue_depth = len(self.queue)
+        self.tickets[ticket.request_id] = ticket
+        return ticket.request_id
+
+    def status(self, request_id: str) -> Dict[str, Any]:
+        t = self._ticket(request_id)
+        return {
+            "request_id": t.request_id,
+            "status": t.status,
+            "steps_done": t.steps_done,
+            "horizon_steps": t.horizon_steps,
+            "error": t.error,
+            "result_path": t.result_path,
+        }
+
+    def result(self, request_id: str):
+        """The request's streamed trajectory: a stacked timeseries tree
+        (ram sink) or the path of its ``.lens`` log (log sink). Partial
+        for TIMEOUT/CANCELLED requests — whatever was streamed before
+        retirement."""
+        t = self._ticket(request_id)
+        sink = self._results.get(request_id)
+        if sink is None:
+            raise ValueError(
+                f"request {request_id} ({t.status}) has no result — it "
+                f"was never admitted to a lane"
+            )
+        return sink.timeseries()
+
+    def cancel(self, request_id: str) -> str:
+        """Cancel a request: queued -> dropped now; running -> its lane
+        is reclaimed at the next tick (already-streamed records are
+        kept). Returns the resulting status."""
+        t = self._ticket(request_id)
+        if t.status == QUEUED and self.queue.drop(t):
+            self._finish(t, CANCELLED)
+            self.metrics.inc("cancelled")
+            self.metrics.queue_depth = len(self.queue)
+        elif t.status == RUNNING:
+            t.cancel_requested = True
+        return t.status
+
+    def _ticket(self, request_id: str) -> Ticket:
+        t = self.tickets.get(request_id)
+        if t is None:
+            raise KeyError(f"unknown request id {request_id!r}")
+        return t
+
+    # -- scheduling ----------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One scheduler iteration: expire/cancel, admit, run one window
+        per occupied bucket, stream, retire. Returns False when the
+        server is fully idle (nothing queued, no lane busy)."""
+        now = time.perf_counter()
+        self.metrics.inc("ticks")
+        did_work = False
+
+        # 1. queued-side expiry (cancel of queued tickets is immediate
+        #    in cancel(); only deadlines need the sweep)
+        for t in self.queue.expire(now):
+            self._finish(t, TIMEOUT)
+            self.metrics.inc("timeouts")
+
+        # 2. running-side cancel/expiry: reclaim lanes BEFORE admission
+        #    so freed lanes are reusable this very tick
+        for bucket in self.buckets.values():
+            for lane, t in list(bucket.assignments.items()):
+                if t.cancel_requested or t.expired(now):
+                    bucket.pool.release(lane)
+                    del bucket.assignments[lane]
+                    if t.cancel_requested:
+                        self._finish(t, CANCELLED)
+                        self.metrics.inc("cancelled")
+                    else:
+                        self._finish(t, TIMEOUT)
+                        self.metrics.inc("timeouts")
+                    did_work = True
+
+        # 3. admission: FIFO over the queue, per-bucket free lanes
+        free = {
+            name: b.free_lanes() for name, b in self.buckets.items()
+        }
+        for t in self.queue.take(
+            lambda t: t.request.composite, free
+        ):
+            did_work = True
+            self._admit(t, now)
+        self.metrics.queue_depth = len(self.queue)
+
+        # 4. one window per bucket with any occupied lane
+        for bucket in self.buckets.values():
+            if not bucket.assignments:
+                continue
+            did_work = True
+            self._run_bucket_window(bucket)
+
+        self.metrics.lanes_busy = sum(
+            len(b.assignments) for b in self.buckets.values()
+        )
+        self.metrics.retraces = sum(
+            b.pool.retraces() for b in self.buckets.values()
+        )
+        return did_work
+
+    def run_until_idle(self, max_ticks: Optional[int] = None) -> int:
+        """Drive ``tick`` until nothing is queued or running (the
+        in-process serving loop for tests/bench/CLI). Returns ticks
+        run. ``max_ticks`` guards against a scheduling bug looping
+        forever — exceeding it raises."""
+        ticks = 0
+        while True:
+            busy = self.tick()
+            ticks += 1
+            if not busy and not len(self.queue):
+                return ticks
+            if max_ticks is not None and ticks >= max_ticks:
+                raise RuntimeError(
+                    f"server not idle after {ticks} ticks "
+                    f"(queue={len(self.queue)}, "
+                    f"busy={self.metrics.lanes_busy})"
+                )
+
+    # -- internals -----------------------------------------------------------
+
+    def _retry_after(self) -> float:
+        """Backpressure hint: how long the current backlog should take
+        to drain at the measured window rate. Deliberately rough — a
+        pacing signal, not a promise."""
+        total_lanes = sum(
+            b.pool.n_lanes for b in self.buckets.values()
+        )
+        backlog_windows = len(self.queue) / max(total_lanes, 1) + 1.0
+        return backlog_windows * self.metrics.avg_window_seconds()
+
+    def _admit(self, t: Ticket, now: float) -> None:
+        bucket = self.buckets[t.request.composite]
+        lane = bucket.next_free_lane()
+        try:
+            bucket.pool.admit(
+                lane,
+                seed=int(t.request.seed),
+                horizon_steps=t.horizon_steps,
+                n_agents=bucket.pool.default_agents(
+                    t.request.n_agents
+                    if t.request.n_agents is not None
+                    else bucket.cfg["n_agents"]
+                ),
+                overrides=t.request.overrides or None,
+            )
+        except Exception as e:  # bad overrides/counts: fail the REQUEST
+            t.error = f"{type(e).__name__}: {e}"
+            self._finish(t, FAILED)
+            self.metrics.inc("failed")
+            return
+        t.status = RUNNING
+        t.lane = lane
+        t.admitted_at = now
+        bucket.assignments[lane] = t
+        self._results[t.request_id] = self._make_sink(t)
+        self.metrics.inc("admitted")
+
+    def _make_sink(self, t: Ticket):
+        if self.sink == "ram":
+            return _RamResult()
+        path = os.path.join(self.out_dir, f"{t.request_id}.lens")
+        t.result_path = path
+        req = t.request
+        return _LogResult(
+            path,
+            t.request_id,
+            config={
+                "composite": req.composite,
+                "seed": int(req.seed),
+                "horizon": float(req.horizon),
+                "n_agents": req.n_agents,
+                "overrides": {
+                    SEP.join(map(str, p)): np.asarray(v).tolist()
+                    for p, v in flatten_paths(req.overrides or {})
+                },
+                "emit": dict(req.emit or {}),
+            },
+            stream_flush=self.stream_flush,
+        )
+
+    def _run_bucket_window(self, bucket: _Bucket) -> None:
+        pool = bucket.pool
+        t0 = time.perf_counter()
+        remaining_before, traj = pool.run_window()
+        # ONE device->host transfer for the whole window, shared by
+        # every lane's slicing below (same policy as the run path's
+        # per-segment transfer).
+        host = jax.device_get(traj)
+        wall = time.perf_counter() - t0
+        self.metrics.inc("windows")
+        self.metrics.inc("lane_windows_busy", len(bucket.assignments))
+        self.metrics.inc("lane_windows_total", pool.n_lanes)
+        self.metrics.observe_window(wall)
+
+        for lane, t in list(bucket.assignments.items()):
+            before = int(remaining_before[lane])
+            self._stream_lane(pool, t, lane, before, host)
+            ran = min(before, pool.window_steps)
+            t.steps_done += ran
+            if before <= pool.window_steps:  # horizon elapsed: retire
+                del bucket.assignments[lane]
+                self._finish(t, DONE)
+                self.metrics.inc("retired")
+
+    def _stream_lane(
+        self, pool: LanePool, t: Ticket, lane: int, before: int, host
+    ) -> None:
+        """Slice lane ``lane``'s VALID rows out of the window trajectory
+        and append them to the request's sink. All host-side numpy — the
+        bits are exactly what the device emitted for that lane."""
+        n_valid = pool.valid_emits(before)
+        if n_valid == 0:
+            return
+        every = int((t.request.emit or {}).get("every", 1))
+        # global (request-local) emit indices of this window's rows
+        first = t.emit_count  # 0-based count of rows emitted so far
+        rows = [
+            r for r in range(n_valid) if (first + r + 1) % every == 0
+        ]
+        t.emit_count += n_valid
+        if not rows:
+            return
+        idx = np.asarray(rows)
+        tree = jax.tree.map(lambda leaf: np.asarray(leaf)[idx, lane], host)
+        paths = (t.request.emit or {}).get("paths")
+        if paths:
+            tree = _filter_paths(tree, [str(p) for p in paths])
+            if not tree:
+                return
+        times = (
+            t.steps_done + (idx + 1) * pool.emit_every
+        ) * pool.timestep
+        self._results[t.request_id].append(tree, times)
+
+    def _finish(self, t: Ticket, status: str) -> None:
+        t.status = status
+        t.finished_at = time.perf_counter()
+        sink = self._results.get(t.request_id)
+        if sink is not None:
+            sink.close()
+        if t.admitted_at is not None:
+            self.metrics.observe_request(
+                t.admitted_at - t.submitted_at,
+                t.finished_at - t.submitted_at,
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for sink in self._results.values():
+            sink.close()
+        if self.out_dir:
+            write_server_meta(
+                self.out_dir,
+                {name: b.cfg for name, b in self.buckets.items()},
+                self.metrics,
+            )
+
+    def __enter__(self) -> "SimServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -37,6 +37,7 @@ tests/test_gillespie.py
 tests/test_sampling.py
 tests/test_expression.py
 tests/test_colony.py
+tests/test_serve.py
 "
 
 # Full-suite batches. Grouping rationale: each line stays well under
@@ -55,6 +56,7 @@ BATCHES=(
   "tests/test_adi.py"
   "tests/test_parallel.py tests/test_distributed.py"
   "tests/test_multispecies.py tests/test_ensemble.py"
+  "tests/test_serve.py"
   "tests/test_experiment.py"
   "tests/test_bridge.py"
 )
@@ -76,7 +78,9 @@ run_per_file() {
 mode=batched
 if [ "${1:-}" = "--quick" ]; then
   shift
-  run_per_file "$QUICK_FILES" "$@"
+  # the quick tier is the fast signal: slow-marked soaks stay out of it
+  # (a caller's own -m overrides, since pytest takes the last -m given)
+  run_per_file "$QUICK_FILES" -m "not slow" "$@"
   exit $rc
 elif [ "${1:-}" = "--per-file" ]; then
   shift
