@@ -37,7 +37,6 @@ import numpy as np
 from lens_tpu.colony.ensemble import Ensemble
 from lens_tpu.experiment import build_model
 from lens_tpu.serve import QueueFull, ScenarioRequest, SimServer
-from lens_tpu.serve.metrics import percentiles
 
 
 def saturation_point(
@@ -78,8 +77,9 @@ def saturation_point(
 
     n = fill_rounds * lanes
     ceiling_wall = served_wall = float("inf")
-    busy0 = srv.metrics.counters["lane_windows_busy"]
-    total0 = srv.metrics.counters["lane_windows_total"]
+    counters0 = srv.metrics()["counters"]
+    busy0 = counters0["lane_windows_busy"]
+    total0 = counters0["lane_windows_total"]
     for rep in range(reps):
         t0 = time.perf_counter()
         final, traj = run(states)
@@ -100,7 +100,7 @@ def saturation_point(
         assert all(
             srv.status(r)["status"] == "done" for r in ids
         )
-    snap = srv.metrics.snapshot()
+    snap = srv.metrics()
     # occupancy of the measured phases only (warmup windows excluded)
     snap["occupancy"] = (
         snap["counters"]["lane_windows_busy"] - busy0
@@ -120,9 +120,7 @@ def _warm(srv, composite, lanes, window) -> None:
             composite=composite, seed=s, horizon=float(window)
         ))
     srv.run_until_idle(max_ticks=100)
-    srv.metrics.latency_seconds.clear()
-    srv.metrics.wait_seconds.clear()
-    srv.metrics.window_seconds.clear()
+    srv.reset_samples()
 
 
 def offered_load(
@@ -142,8 +140,9 @@ def offered_load(
         queue_depth=2 * lanes,
     )
     _warm(srv, composite, lanes, window)
-    busy0 = srv.metrics.counters["lane_windows_busy"]
-    total0 = srv.metrics.counters["lane_windows_total"]
+    counters0 = srv.metrics()["counters"]
+    busy0 = counters0["lane_windows_busy"]
+    total0 = counters0["lane_windows_total"]
 
     interval = 1.0 / rate_req_s
     pending = [
@@ -169,15 +168,13 @@ def offered_load(
         srv.tick()
     srv.run_until_idle(max_ticks=100_000)
     wall = time.perf_counter() - t0
-    lat = list(srv.metrics.latency_seconds)
-    wait = list(srv.metrics.wait_seconds)
-    snap = srv.metrics.snapshot()
+    snap = srv.metrics()
     srv.close()
     return {
         "offered_req_s": rate_req_s,
         "achieved_req_s": n / wall,
-        "latency_s": percentiles(lat),
-        "queue_wait_s": percentiles(wait),
+        "latency_s": snap["latency_seconds"],
+        "queue_wait_s": snap["wait_seconds"],
         "rejects": rejects,
         "occupancy": (
             snap["counters"]["lane_windows_busy"] - busy0

@@ -1,0 +1,213 @@
+"""Sweep benchmark: trials/s + lane occupancy vs one-at-a-time serving.
+
+What the sweep layer buys over the pre-sweep workflow (run one
+scenario, wait, run the next): both sides do IDENTICAL simulation work
+— the same deterministic trial list, same composite, same horizon —
+but the baseline drives a 1-lane server one request at a time
+(submit, drain, submit), while the sweep drives an L-lane server
+through ``lens_tpu.sweep.run_sweep`` with bounded in-flight
+concurrency, so trials co-batch onto the resident vmapped window
+program. The ratio is the sweep subsystem's throughput claim; lane
+occupancy says how much of it the scheduler actually kept busy.
+
+Protocol (same conventions as bench_serve.py): INTERLEAVED min-of-reps
+— baseline and sweep alternate within each rep so this host's ±20%
+wall-clock wander hits both alike, min taken across reps; servers are
+built and warmed ONCE per configuration with warmup samples dropped,
+so compiles never land in a timed phase. Three sweep sizes by default;
+occupancy is computed from counter deltas over the measured phase only.
+
+Writes ``BENCH_SWEEP_CPU_r09.json`` (or ``--out``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+from lens_tpu.serve import ScenarioRequest, SimServer
+from lens_tpu.sweep import run_sweep, space_from_spec
+
+
+def _sweep_spec(composite, capacity, n_trials, horizon, emit_every):
+    """One deterministic spec per size: a random volume space (content
+    is irrelevant to timing; random keeps the per-trial override
+    distinct, like a real search)."""
+    return {
+        "composite": composite,
+        "space": {
+            "kind": "random",
+            "n_trials": n_trials,
+            "params": {
+                "global/volume": {"low": 0.8, "high": 1.3},
+            },
+        },
+        "seed": 0,
+        "horizon": float(horizon),
+        "emit_every": emit_every,
+        "capacity": capacity,
+        "objective": {
+            "path": "global/volume",
+            "reduction": "final_live_sum",
+            "mode": "max",
+        },
+        "backend": {"kind": "server"},
+    }
+
+
+def _occupancy_delta(before, after):
+    busy = (
+        after["counters"]["lane_windows_busy"]
+        - before["counters"]["lane_windows_busy"]
+    )
+    total = (
+        after["counters"]["lane_windows_total"]
+        - before["counters"]["lane_windows_total"]
+    )
+    return busy / max(total, 1)
+
+
+def run_baseline(server, spec, trials) -> float:
+    """One-at-a-time: each trial fully drains before the next submits —
+    the pre-sweep workflow, on the same serving machinery so scheduler
+    overhead cancels out of the comparison."""
+    t0 = time.perf_counter()
+    for t in trials:
+        rid = server.submit(ScenarioRequest(
+            composite=spec["composite"],
+            seed=t.seed,
+            horizon=spec["horizon"],
+            overrides=t.overrides(),
+            emit={"paths": ["global/volume", "alive"]},
+        ))
+        server.run_until_idle(max_ticks=100_000)
+        assert server.status(rid)["status"] == "done"
+    return time.perf_counter() - t0
+
+
+def run_swept(server, spec) -> float:
+    t0 = time.perf_counter()
+    result = run_sweep(spec, server=server)
+    assert all(r["status"] == "done" for r in result.table)
+    return time.perf_counter() - t0
+
+
+def bench_size(
+    base_server, sweep_server, spec, n_trials, reps
+) -> dict:
+    trials = space_from_spec(spec["space"]).trials(spec["seed"])
+    base_wall = sweep_wall = float("inf")
+    occ0 = sweep_server.metrics()
+    for _ in range(reps):
+        base_wall = min(
+            base_wall, run_baseline(base_server, spec, trials)
+        )
+        sweep_wall = min(sweep_wall, run_swept(sweep_server, spec))
+    occ = _occupancy_delta(occ0, sweep_server.metrics())
+    return {
+        "n_trials": n_trials,
+        "baseline_wall_s": round(base_wall, 4),
+        "sweep_wall_s": round(sweep_wall, 4),
+        "baseline_trials_per_s": round(n_trials / base_wall, 3),
+        "sweep_trials_per_s": round(n_trials / sweep_wall, 3),
+        "speedup": round(base_wall / sweep_wall, 3),
+        "sweep_occupancy": round(occ, 4),
+        "retraces": sweep_server.metrics()["retraces"],
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--composite", default="toggle_colony")
+    # Defaults target the sweep's home regime on this 1-core CPU box:
+    # many SMALL scenarios (an 8-row bucket ~ a single-cell trial),
+    # sparse emission (the objective reads the final frame), horizons
+    # long enough to amortize per-trial admission. Bigger buckets are
+    # compute-bound on one core, where vmapped lanes cannot add FLOPs
+    # — the speedup there comes back on accelerators, where idle lane
+    # compute is genuinely parallel (see docs/sweeps.md).
+    p.add_argument("--capacity", type=int, default=8)
+    p.add_argument("--lanes", type=int, default=8)
+    p.add_argument("--window", type=int, default=32)
+    p.add_argument("--emit-every", type=int, default=32)
+    p.add_argument(
+        "--horizon-windows", type=int, default=12,
+        help="trial horizon in windows",
+    )
+    p.add_argument(
+        "--sizes", type=int, nargs="+", default=[16, 32, 64],
+        help="sweep sizes (trials) to measure",
+    )
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--out", default="BENCH_SWEEP_CPU_r09.json")
+    args = p.parse_args()
+
+    horizon = args.horizon_windows * args.window
+    record = {
+        "bench": "sweep",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "lanes": args.lanes,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon,
+        "reps": args.reps,
+        "protocol": "interleaved min-of-reps; shared warmed servers; "
+        "baseline = same trials one-at-a-time on 1 lane",
+        "sizes": [],
+    }
+
+    def make_server(lanes):
+        srv = SimServer.single_bucket(
+            args.composite,
+            capacity=args.capacity,
+            lanes=lanes,
+            window=args.window,
+            emit_every=args.emit_every,
+            queue_depth=max(4 * args.lanes, 2 * max(args.sizes)),
+        )
+        # compile builder + admit + window once, outside every timed
+        # phase (overrides match the sweep's structure so the jitted
+        # solo builder is warm too)
+        for s in range(lanes):
+            srv.submit(ScenarioRequest(
+                composite=args.composite, seed=s,
+                horizon=float(args.window),
+                overrides={"global": {"volume": 1.0}},
+            ))
+        srv.run_until_idle(max_ticks=1000)
+        srv.reset_samples()
+        return srv
+
+    base_server = make_server(1)
+    sweep_server = make_server(args.lanes)
+
+    for n in args.sizes:
+        spec = _sweep_spec(
+            args.composite, args.capacity, n, horizon, args.emit_every
+        )
+        entry = bench_size(
+            base_server, sweep_server, spec, n, args.reps
+        )
+        record["sizes"].append(entry)
+        print(json.dumps(entry), flush=True)
+
+    base_server.close()
+    sweep_server.close()
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    worst = min(e["speedup"] for e in record["sizes"])
+    print(
+        f"worst sweep speedup over one-at-a-time at {args.lanes} "
+        f"lanes: {worst:.2f}x"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

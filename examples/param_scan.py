@@ -1,15 +1,19 @@
-"""Parameter scan as one compiled program: a batch-culture yield curve.
+"""Parameter scan via lens_tpu.sweep: a batch-culture yield curve.
 
-Scans the initial glucose concentration across the replicate axis of a
-``colony.Ensemble`` wrapping the wcEcoli-minimal cell (config 3's
-metabolism + expression + division composite): replicate r starts every
-cell at dose[r] mM glucose, and ONE jitted scan computes the whole
-dose-response. Each replicate is a batch culture — cells burn their
-finite substrate and growth stops — so final live biomass tracks the
-dose (the classic substrate-limited yield curve) while the population
-count responds only once a dose buys a full volume doubling. The
-reference would submit one experiment cluster per dose (SURVEY.md
-§3.3); here the scan axis is an ``in_axes`` entry.
+Scans initial glucose with a declarative GRID sweep over the
+wcEcoli-minimal cell (config 3's metabolism + expression + division
+composite) on the sweep subsystem's direct-ensemble backend: the whole
+dose grid packs onto the replicate axis of one compiled
+``colony.Ensemble`` program, each trial keyed by its own
+``(sweep_seed, trial_index)``-derived PRNG seed. Each replicate is a
+batch culture — cells burn their finite substrate and growth stops —
+so the objective (final live biomass, ``final_live_sum`` over
+``global/mass``) tracks the dose: the classic substrate-limited yield
+curve, with population counts responding only once a dose buys a full
+volume doubling. The reference would submit one experiment cluster per
+dose (SURVEY.md §3.3); here it is ~15 lines of spec, and the same spec
+fed to ``python -m lens_tpu sweep`` runs it from the CLI with ledger
+resume (docs/sweeps.md).
 
     python examples/param_scan.py            # chip-sized (16 doses x 1k cells)
     python examples/param_scan.py --small    # CPU-sized check (6 doses x 32)
@@ -42,11 +46,9 @@ def main() -> None:
         force_cpu_platform(1)
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from lens_tpu.colony import Colony, Ensemble
-    from lens_tpu.models.composites import minimal_wcecoli
+    from lens_tpu.sweep import run_sweep
 
     if args.small:
         doses_n, n, total, emit_every = 6, 32, 450.0, 10
@@ -55,34 +57,49 @@ def main() -> None:
 
     # log-spaced doses spanning sub-Km starvation to saturation
     # (network Km for glucose is 0.5 mM — processes/metabolism.py)
-    doses = jnp.logspace(-1.5, 1.0, doses_n)
+    doses = np.logspace(-1.5, 1.0, doses_n)
 
-    colony = Colony(
-        minimal_wcecoli({}), capacity=n, division_trigger=("global", "divide")
-    )
-    ens = Ensemble(colony, doses_n)
-    states = ens.initial_state(
-        n // 4,
-        key=jax.random.PRNGKey(0),
-        replicate_overrides={"metabolites": {"glc": doses}},
-    )
+    spec = {
+        "composite": "minimal_wcecoli",
+        "space": {
+            "kind": "grid",
+            "params": {"metabolites/glc": {"grid": [float(d) for d in doses]}},
+        },
+        "seed": 0,
+        "horizon": total,
+        "emit_every": emit_every,
+        "n_agents": n // 4,
+        "capacity": n,
+        "objective": {
+            "path": "global/mass",
+            "reduction": "final_live_sum",
+            "mode": "max",
+        },
+        # dense finite grid -> the one-compile vmapped-Ensemble backend
+        "backend": {"kind": "ensemble", "batch": doses_n},
+    }
 
-    run = jax.jit(lambda s: ens.run(s, total, 1.0, emit_every=emit_every))
     t0 = time.perf_counter()
-    final, traj = jax.block_until_ready(run(states))
+    result = run_sweep(spec)
     wall = time.perf_counter() - t0
 
-    pops = np.asarray(final.alive).sum(axis=1)  # [R] final populations
-    alive_mask = np.asarray(final.alive)
-    mass = np.asarray(final.agents["global"]["mass"])
-    total_mass = (mass * alive_mask).sum(axis=1)  # [R] final live biomass
-    live_counts = np.asarray(traj["alive"]).sum(axis=(1, 2))
+    # per-dose curves off the per-trial emitted trajectories
+    # (trial order == grid order == dose order)
+    ts = [result.timeseries[i] for i in range(doses_n)]
+    pops = np.asarray(
+        [t["alive"][-1].sum() for t in ts]
+    )  # [R] final populations
+    total_mass = np.asarray(
+        [row["objective"] for row in result.table]
+    )  # [R] final live biomass (the sweep objective)
+    live_counts = np.asarray([t["alive"].sum() for t in ts])
     agent_steps = float(live_counts.sum()) * emit_every
 
     d = np.asarray(doses)
     summary = {
         "scenario": "glucose dose-response scan, wcEcoli-minimal colony "
-        "(one compiled program, scan on the replicate axis)",
+        "(lens_tpu.sweep grid space, direct-ensemble backend: one "
+        "compiled program, trials on the replicate axis)",
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
         "doses_mM": [round(float(x), 4) for x in d],
@@ -97,6 +114,9 @@ def main() -> None:
             and total_mass[-1] > total_mass[0]
         ),
         "agent_steps_per_sec": round(agent_steps / wall, 1),
+        "best_dose_mM": round(
+            float(result.best["params"]["metabolites/glc"]), 4
+        ),
     }
     record = "PARAM_SCAN_SMALL.json" if args.small else "PARAM_SCAN.json"
     with open(record, "w") as f:

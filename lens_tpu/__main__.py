@@ -2,7 +2,7 @@
 
 Replaces the reference's control/boot command surface
 (``python -m lens.actor.control experiment --number N ...``, boot scripts;
-reconstructed SURVEY.md §1 L5, §3.1) with six commands against the
+reconstructed SURVEY.md §1 L5, §3.1) with seven commands against the
 experiment layer:
 
 - ``run``     start an experiment from a composite name + JSON config
@@ -10,6 +10,10 @@ experiment layer:
 - ``serve``   continuous-batching scenario server: many small requests
   multiplexed onto one resident jitted multi-lane program
   (lens_tpu.serve; see docs/serving.md)
+- ``sweep``   resumable parameter sweep / adaptive search from a JSON
+  spec: grid/random/LHS spaces, scalar objectives, successive-halving
+  early stopping, crash-safe ledger resume (lens_tpu.sweep; see
+  docs/sweeps.md)
 - ``list``    show registered composites, processes, emitters
 - ``demo``    step ONE process standalone and plot it (the reference's
   per-process ``__main__`` dev harness)
@@ -27,6 +31,9 @@ Examples::
         --out-dir out/exp1
     python -m lens_tpu serve --composite toggle_colony --lanes 8 \\
         --requests requests.json --out-dir out/served
+    python -m lens_tpu sweep --spec sweep.json --out-dir out/sweep1
+    python -m lens_tpu sweep --spec sweep.json --out-dir out/sweep1 \\
+        --resume   # continue a killed sweep from its ledger
     python -m lens_tpu analyze out/exp1 --animate
 """
 
@@ -195,6 +202,35 @@ def _build_parser() -> argparse.ArgumentParser:
         help="per-request .lens result logs + server_meta.json land here",
     )
 
+    sweep = sub.add_parser(
+        "sweep",
+        help="parameter sweep / adaptive search from a declarative JSON "
+        "spec, with crash-safe ledger resume (docs/sweeps.md)",
+    )
+    sweep.add_argument(
+        "--spec", required=True,
+        help="sweep spec JSON file (or '-' for stdin): composite, "
+        "space, horizon, objective, backend, optional asha — see "
+        "docs/sweeps.md",
+    )
+    sweep.add_argument(
+        "--out-dir", default=None,
+        help="ledger + sweep_result.json (+ trials/ with "
+        "--save-trajectories) land here; omit for an in-memory run",
+    )
+    sweep.add_argument(
+        "--resume", action="store_true",
+        help="continue a killed sweep from its ledger (re-runs only "
+        "unfinished trials; refuses a changed spec)",
+    )
+    sweep.add_argument(
+        "--save-trajectories", action="store_true",
+        help="also write each trial's emitted trajectory as "
+        "<out-dir>/trials/trial_<i>.lens (analysis.load_many reads "
+        "them back)",
+    )
+    sweep.add_argument("--quiet", action="store_true")
+
     sub.add_parser("list", help="list composites, processes, emitters")
 
     ana = sub.add_parser(
@@ -346,7 +382,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     server.tick()
                     time.sleep(min(e.retry_after, 0.05))
         server.run_until_idle()
-        snap = server.metrics.snapshot()
+        snap = server.metrics()
         by_status: dict = {}
         for rid in ids:
             st = server.status(rid)["status"]
@@ -368,6 +404,72 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             )
         print(f"results: {args.out_dir}/<request-id>.lens")
         print(f"meta:    {args.out_dir}/server_meta.json")
+    return 0
+
+
+def _cmd_sweep(args: argparse.Namespace) -> int:
+    """Run (or resume) a sweep spec and print its trial table."""
+    from lens_tpu.sweep import run_sweep
+
+    if args.spec == "-":
+        spec = json.load(sys.stdin)
+    else:
+        with open(args.spec) as f:
+            spec = json.load(f)
+    if not isinstance(spec, dict):
+        raise SystemExit(
+            f"--spec must be a JSON object, got {type(spec).__name__}"
+        )
+    if args.resume and not args.out_dir:
+        # without the ledger directory there is nothing to resume FROM;
+        # silently re-running everything in memory is the opposite of
+        # what the flag promises
+        raise SystemExit(
+            "--resume needs --out-dir (the sweep.ledger it resumes "
+            "from lives there)"
+        )
+    if args.save_trajectories:
+        if not args.out_dir:
+            raise SystemExit("--save-trajectories needs --out-dir")
+        spec["save_trajectories"] = True
+
+    progress = None
+    if not args.quiet:
+        def progress(index, event):
+            obj = event.get("objective")
+            obj = "-" if obj is None else f"{obj:.6g}"
+            print(
+                f"trial {index:>4} {event.get('status', '?'):>7} "
+                f"objective={obj}",
+                flush=True,
+            )
+
+    result = run_sweep(
+        spec,
+        out_dir=args.out_dir,
+        resume=args.resume,
+        on_trial=progress,
+    )
+    by_status: dict = {}
+    for row in result.table:
+        by_status[row["status"]] = by_status.get(row["status"], 0) + 1
+    counts = ", ".join(
+        f"{k}={v}" for k, v in sorted(by_status.items())
+    )
+    print(
+        f"sweep: {len(result.table)} trials ({counts}) in "
+        f"{result.metrics['wall_seconds']:.1f}s "
+        f"[{result.metrics['backend']} backend]"
+    )
+    if result.best is not None:
+        print(
+            f"best: trial {result.best['trial']} "
+            f"objective={result.best['objective']:.6g} "
+            f"params={json.dumps(result.best['params'])}"
+        )
+    if result.path:
+        print(f"table:  {result.path}")
+        print(f"ledger: {args.out_dir}/sweep.ledger")
     return 0
 
 
@@ -427,6 +529,9 @@ def main(argv=None) -> int:
 
     if args.command == "serve":
         return _cmd_serve(args)
+
+    if args.command == "sweep":
+        return _cmd_sweep(args)
 
     _validate_run_args(args)
 

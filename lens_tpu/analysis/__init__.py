@@ -30,6 +30,70 @@ def load(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     return header, stack_records(records)
 
 
+def load_many(
+    directory: str, pattern: str = "*.lens"
+) -> Dict[str, Dict[str, Any]]:
+    """Load a directory of per-trial emit logs into one trial-indexed
+    timeseries tree: ``{log stem: timeseries}``, stems sorted — the
+    layout a sweep's ``save_trajectories`` writes
+    (``trials/trial_00042.lens``) and a serve out_dir's per-request
+    logs share.
+
+    A fleet directory is allowed to be ragged: a killed sweep leaves
+    missing trials, a killed writer leaves a truncated or torn tail.
+    Cleanly-truncated logs load their complete records (the framing's
+    at-most-one-lost-record contract); logs that are corrupt beyond
+    truncation, or hold no complete data records, are SKIPPED with a
+    ``UserWarning`` naming the file — one bad trial must not take down
+    the analysis of the other thousand.
+    """
+    import fnmatch
+    import warnings
+
+    from lens_tpu.emit.log import is_header, is_segment, expand_segment
+
+    if not os.path.isdir(directory):
+        raise NotADirectoryError(f"{directory!r} is not a directory")
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(os.listdir(directory)):
+        if not fnmatch.fnmatch(name, pattern):
+            continue
+        path = os.path.join(directory, name)
+        records: List[Dict[str, Any]] = []
+        try:
+            # incremental consumption: a mid-log ValueError (corruption
+            # past truncation) still keeps every record before it
+            from lens_tpu.emit.log import read_records
+
+            for record in read_records(path):
+                if is_header(record):
+                    continue
+                if is_segment(record):
+                    records.extend(expand_segment(record))
+                else:
+                    records.append(record)
+        except (ValueError, OSError) as e:
+            if not records:
+                warnings.warn(
+                    f"load_many: skipping unreadable log {path}: {e}"
+                )
+                continue
+            warnings.warn(
+                f"load_many: {path} is corrupt after "
+                f"{len(records)} records ({e}); keeping the readable "
+                f"prefix"
+            )
+        if not records:
+            warnings.warn(
+                f"load_many: skipping {path}: no complete data records "
+                f"(trial still being written, or killed before its "
+                f"first emit?)"
+            )
+            continue
+        out[os.path.splitext(name)[0]] = stack_records(records)
+    return out
+
+
 def get_path(tree: Mapping, path: Sequence[str]) -> np.ndarray:
     node: Any = tree
     for key in path:
@@ -962,6 +1026,7 @@ def report(
 
 __all__ = [
     "load",
+    "load_many",
     "report",
     "ensemble_series",
     "plot_ensemble_fan",

@@ -68,13 +68,21 @@ class Ensemble:
     def initial_state(
         self,
         *args,
-        key: jax.Array,
+        key: jax.Array | None = None,
+        keys: jax.Array | None = None,
         replicate_overrides: Mapping | None = None,
         **kwargs,
     ):
         """Stacked initial states: ``sim.initial_state`` vmapped over
         ``n_replicates`` keys split from ``key`` (all other arguments are
         shared and static across replicates).
+
+        ``keys`` replaces the split with EXPLICIT per-replicate PRNG keys
+        (shape ``[n_replicates, 2]``) — the hook the sweep subsystem's
+        dense-grid backend uses so every trial's key is derived from
+        ``(sweep_seed, trial_index)`` independently of which batch the
+        trial lands in (``jax.random.split`` would entangle a trial's
+        stream with the batch size). Exactly one of ``key``/``keys``.
 
         ``replicate_overrides`` turns the ensemble into a parameter scan:
         a nested mapping of schema-variable paths to arrays with a leading
@@ -85,7 +93,20 @@ class Ensemble:
         agent), a ``[R, capacity, ...]`` leaf sets per-agent values per
         replicate.
         """
-        keys = jax.random.split(key, self.n_replicates)
+        if (key is None) == (keys is None):
+            raise ValueError(
+                "pass exactly one of key= (split into n_replicates "
+                "streams) or keys= (explicit [n_replicates, 2] keys)"
+            )
+        if keys is None:
+            keys = jax.random.split(key, self.n_replicates)
+        else:
+            keys = jnp.asarray(keys)
+            if keys.ndim != 2 or keys.shape[0] != self.n_replicates:
+                raise ValueError(
+                    f"keys must be [n_replicates={self.n_replicates}, 2] "
+                    f"PRNG keys, got shape {keys.shape}"
+                )
         if not replicate_overrides:
             return jax.vmap(
                 lambda k: self.sim.initial_state(*args, key=k, **kwargs)

@@ -72,13 +72,30 @@ def frame(payload: bytes) -> bytes:
     return _FRAME.pack(MAGIC, zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
 
 
-def read_records(path: str) -> Iterator[Dict[str, Any]]:
-    """Yield decoded records; stop cleanly at EOF or a truncated tail.
+def iter_frames(
+    path: str, with_offsets: bool = False
+) -> Iterator[bytes] | Iterator[Tuple[bytes, int]]:
+    """Yield each complete frame's raw payload bytes; stop cleanly at EOF
+    or a truncated tail frame.
+
+    The payload-agnostic layer of the log format: ``read_records``
+    decodes npz payloads on top of it, and the sweep trial ledger
+    (``lens_tpu.sweep.ledger``) rides the same framing with JSON
+    payloads — one framing/CRC/truncation discipline for every
+    append-only file in the repo.
+
+    ``with_offsets=True`` yields ``(payload, end_offset)`` pairs, where
+    ``end_offset`` is the file offset just past the frame — what a
+    writer REOPENING the file for append must truncate to, so a torn
+    tail frame (kill mid-append) can never end up with later appends
+    landing after it (which would turn a cleanly-lost tail into
+    corruption on the next read).
 
     Raises ``ValueError`` on corruption that is NOT simple truncation
     (bad magic or CRC mismatch with a complete frame).
     """
     with open(path, "rb") as f:
+        offset = 0
         while True:
             head = f.read(_FRAME.size)
             if len(head) < _FRAME.size:
@@ -94,7 +111,18 @@ def read_records(path: str) -> Iterator[Dict[str, Any]]:
                 return  # truncated tail record
             if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                 raise ValueError(f"{path}: CRC mismatch at offset {f.tell()}")
-            yield decode_record(payload)
+            offset += _FRAME.size + length
+            yield (payload, offset) if with_offsets else payload
+
+
+def read_records(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield decoded records; stop cleanly at EOF or a truncated tail.
+
+    Raises ``ValueError`` on corruption that is NOT simple truncation
+    (bad magic or CRC mismatch with a complete frame).
+    """
+    for payload in iter_frames(path):
+        yield decode_record(payload)
 
 
 def tail_records(

@@ -78,6 +78,16 @@ class ScenarioRequest:
         Wall-clock seconds from submit; expired requests (queued OR
         mid-run) retire as TIMEOUT at the next tick, keeping whatever
         records they already streamed.
+    hold_state:
+        Retain the lane's final simulation state (host-side) when the
+        request retires DONE, so ``SimServer.resubmit`` can EXTEND the
+        scenario past its horizon later — the continuation is admitted
+        from the held state and is bitwise what a longer original
+        horizon would have produced. Costs one lane-slice device->host
+        transfer at retirement plus host RAM until the state is
+        consumed by ``resubmit`` or dropped by ``release_state``. The
+        sweep driver's successive-halving rungs are the intended
+        client (survivors extend, losers never rerun).
     """
 
     composite: str
@@ -87,6 +97,7 @@ class ScenarioRequest:
     n_agents: Any = None
     emit: Optional[Mapping[str, Any]] = None
     deadline: Optional[float] = None
+    hold_state: bool = False
 
 
 @dataclass
@@ -106,6 +117,16 @@ class Ticket:
     cancel_requested: bool = False
     emit_count: int = 0  # emitted records streamed so far (pre-filter)
     result_path: Optional[str] = None
+    # -- continuation plumbing (hold_state / resubmit) --
+    # carry_state: a host state pytree to scatter at admission instead of
+    # building one from seed+overrides (set on continuation tickets;
+    # cleared once scattered). final_state: the lane's state captured at
+    # DONE retirement when the request asked hold_state (consumed by
+    # resubmit, dropped by release_state). parent: the request id this
+    # ticket continues, for provenance.
+    carry_state: Any = None
+    final_state: Any = None
+    parent: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return (

@@ -3,9 +3,13 @@
 The serving primitive. A ``LanePool`` wraps any colony-form sim
 (:class:`~lens_tpu.colony.colony.Colony`, ``SpatialColony``,
 ``MultiSpeciesColony``) in an :class:`~lens_tpu.colony.ensemble.Ensemble`
-of ``n_lanes`` replicates and keeps exactly TWO device programs hot for
-the server's whole lifetime:
+of ``n_lanes`` replicates and keeps a small fixed set of device
+programs hot for the server's whole lifetime:
 
+- ``_build_solo``: jitted solo-state construction, one compile per
+  (n_agents, override structure) — seed and override values are traced
+  data, so every sweep trial / plain request reuses one program
+  (eager per-admission builds were the admission bottleneck);
 - ``_admit``: scatter one freshly-built solo state into lane ``i`` and
   arm its remaining-steps counter (``i`` and the counter are traced
   scalars, so every admission reuses one compile);
@@ -27,7 +31,7 @@ identical served solo or co-batched (pinned in tests/test_serve.py).
 
 from __future__ import annotations
 
-from typing import Any, Mapping, Tuple
+from typing import Any, Dict, Mapping, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +39,7 @@ import numpy as np
 
 from lens_tpu.colony.colony import Colony
 from lens_tpu.colony.ensemble import Ensemble
+from lens_tpu.utils.dicts import flatten_paths, set_path
 
 
 def _solo_initial_state(
@@ -179,6 +184,49 @@ class LanePool:
             lambda remaining, lane: remaining.at[lane].set(0),
             donate_argnums=(0,) if donate else (),
         )
+        # Jitted solo-state builders, one per (n_agents, override
+        # STRUCTURE) — admission's third resident program. The eager
+        # op-by-op build cost ~0.8 ms per admission on this box's CPU
+        # (dozens of tiny dispatches), which capped sweep throughput:
+        # it exceeded the whole 1-lane window wall. Requests sharing an
+        # override structure — every trial of a sweep, every plain
+        # request — reuse ONE compile; seed and override VALUES ride as
+        # traced data, so the built bits are the eager build's bits.
+        self._solo_builders: Dict[Any, Any] = {}
+
+    def _build_solo(self, n_agents, seed: int, overrides: Mapping | None):
+        leaves = sorted(
+            (path, jnp.asarray(value))
+            for path, value in flatten_paths(overrides or {})
+        )
+        na_key = (
+            tuple(sorted(n_agents.items()))
+            if isinstance(n_agents, Mapping)
+            else int(n_agents)
+        )
+        key = (
+            na_key,
+            tuple(
+                (path, v.shape, str(v.dtype)) for path, v in leaves
+            ),
+        )
+        builder = self._solo_builders.get(key)
+        if builder is None:
+            paths = [path for path, _ in leaves]
+
+            def build(prng, values):
+                tree: Dict = {}
+                for path, value in zip(paths, values):
+                    tree = set_path(tree, path, value)
+                return _solo_initial_state(
+                    self.sim, n_agents, prng, overrides=tree or None
+                )
+
+            builder = jax.jit(build)
+            self._solo_builders[key] = builder
+        return builder(
+            jax.random.PRNGKey(int(seed)), [v for _, v in leaves]
+        )
 
     def _zero_agents(self):
         """The 'no live rows' n_agents for this sim form."""
@@ -224,12 +272,7 @@ class LanePool:
                 f"horizon_steps={horizon_steps} must be >= 1"
             )
         n_agents = self.default_agents(n_agents)
-        solo = _solo_initial_state(
-            self.sim,
-            n_agents,
-            jax.random.PRNGKey(int(seed)),
-            overrides=overrides,
-        )
+        solo = self._build_solo(n_agents, seed, overrides)
         self.states, self.remaining = self._admit(
             self.states,
             self.remaining,
@@ -238,6 +281,43 @@ class LanePool:
             jnp.int32(horizon_steps),
         )
         self.remaining_host[lane] = int(horizon_steps)
+
+    def admit_state(self, lane: int, state, steps: int) -> None:
+        """Scatter an EXPLICIT solo state into ``lane`` and arm ``steps``.
+
+        The continuation path (``SimServer.resubmit``): ``state`` is a
+        lane slice previously captured by :meth:`lane_state`, so
+        re-scattering it and stepping ``steps`` more is bitwise what a
+        longer original horizon would have produced (``step_where``
+        froze nothing but time in between). Reuses the one compiled
+        admit program — the state rides as data, same shapes.
+        """
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        if steps < 1:
+            raise ValueError(f"steps={steps} must be >= 1")
+        self.states, self.remaining = self._admit(
+            self.states,
+            self.remaining,
+            jnp.int32(lane),
+            state,
+            jnp.int32(steps),
+        )
+        self.remaining_host[lane] = int(steps)
+
+    def lane_state(self, lane: int):
+        """Host copy of one lane's current state (a solo-shaped pytree).
+
+        One small transfer (the lane slice, not the pool); the bits are
+        exactly what the resident program holds, so
+        ``admit_state(lane', lane_state(lane), ...)`` continues the
+        scenario bitwise.
+        """
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        return jax.device_get(
+            jax.tree.map(lambda x: x[lane], self.states)
+        )
 
     def release(self, lane: int) -> None:
         """Free a lane before its horizon elapsed (cancel/deadline): zero

@@ -49,6 +49,9 @@ class ServerMetrics:
       one of these (rejected = bounded-queue backpressure).
     - ``admitted``: requests scattered into a lane.
     - ``retired``: horizons that ran to completion.
+    - ``resubmitted``: continuation tickets created by
+      ``SimServer.resubmit`` (a held DONE request extended past its
+      horizon — the sweep driver's rung promotions).
     - ``timeouts``: deadline expiries (queued or mid-run).
     - ``cancelled``: explicit cancels (queued or mid-run).
     - ``failed``: admission-time construction errors (bad overrides).
@@ -68,6 +71,7 @@ class ServerMetrics:
         "rejected",
         "admitted",
         "retired",
+        "resubmitted",
         "timeouts",
         "cancelled",
         "failed",

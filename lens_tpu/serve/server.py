@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import os
 import time
+from dataclasses import replace as dc_replace
 from typing import Any, Dict, List, Mapping, Optional
 
 import jax
@@ -235,8 +236,8 @@ class SimServer:
             for name, cfg in buckets.items()
         }
         self.queue = RequestQueue(queue_depth)
-        self.metrics = ServerMetrics()
-        self.metrics.lanes_total = sum(
+        self._metrics = ServerMetrics()
+        self._metrics.lanes_total = sum(
             b.pool.n_lanes for b in self.buckets.values()
         )
         self.out_dir = out_dir
@@ -275,20 +276,7 @@ class SimServer:
                 f"no bucket serves composite {request.composite!r}; "
                 f"configured: {sorted(self.buckets)}"
             )
-        pool = bucket.pool
-        steps = int(round(float(request.horizon) / pool.timestep))
-        if steps < 1 or abs(
-            steps * pool.timestep - float(request.horizon)
-        ) > 1e-6 * max(abs(float(request.horizon)), 1.0):
-            raise ValueError(
-                f"horizon={request.horizon} is not a positive multiple "
-                f"of the bucket timestep {pool.timestep}"
-            )
-        if steps % pool.emit_every != 0:
-            raise ValueError(
-                f"horizon steps ({steps}) must be a multiple of the "
-                f"bucket emit_every ({pool.emit_every})"
-            )
+        steps = self._horizon_steps(bucket, request.horizon)
         every = int((request.emit or {}).get("every", 1))
         if every < 1:
             raise ValueError(f"emit every={every} must be >= 1")
@@ -300,13 +288,96 @@ class SimServer:
         try:
             self.queue.push(ticket, retry_after=self._retry_after())
         except QueueFull:
-            self.metrics.inc("rejected")
-            self.metrics.queue_depth = len(self.queue)
+            self._metrics.inc("rejected")
+            self._metrics.queue_depth = len(self.queue)
             raise
-        self.metrics.inc("submitted")
-        self.metrics.queue_depth = len(self.queue)
+        self._metrics.inc("submitted")
+        self._metrics.queue_depth = len(self.queue)
         self.tickets[ticket.request_id] = ticket
         return ticket.request_id
+
+    @staticmethod
+    def _horizon_steps(bucket: _Bucket, horizon: float) -> int:
+        """Validate a horizon against the bucket's step/emit grid and
+        return it in steps (shared by ``submit`` and ``resubmit``)."""
+        pool = bucket.pool
+        steps = int(round(float(horizon) / pool.timestep))
+        if steps < 1 or abs(
+            steps * pool.timestep - float(horizon)
+        ) > 1e-6 * max(abs(float(horizon)), 1.0):
+            raise ValueError(
+                f"horizon={horizon} is not a positive multiple "
+                f"of the bucket timestep {pool.timestep}"
+            )
+        if steps % pool.emit_every != 0:
+            raise ValueError(
+                f"horizon steps ({steps}) must be a multiple of the "
+                f"bucket emit_every ({pool.emit_every})"
+            )
+        return steps
+
+    def resubmit(self, request_id: str, extra_horizon: float) -> str:
+        """EXTEND a DONE ``hold_state`` request by ``extra_horizon`` sim
+        seconds: queue a continuation ticket that is admitted from the
+        parent's held final state instead of a fresh seed-built one.
+
+        The continuation's emitted rows carry times following straight
+        on from the parent's, and the combined trajectory is bitwise
+        identical to one original request with the longer horizon (the
+        held state is the lane's exact bits; ``tests/test_serve.py``
+        pins it). Returns the continuation's request id — a NEW id:
+        the parent stays DONE with its own streamed records, so result
+        consumers stitch segments by ``parent`` linkage (the sweep
+        driver does).
+
+        Raises ``ValueError`` if the parent is not DONE, was not
+        submitted with ``hold_state=True``, or its held state was
+        already consumed/released; ``QueueFull`` for backpressure, like
+        ``submit``.
+        """
+        parent = self._ticket(request_id)
+        if parent.status != DONE:
+            raise ValueError(
+                f"request {request_id} is {parent.status}; only DONE "
+                f"requests can be extended"
+            )
+        if parent.final_state is None:
+            raise ValueError(
+                f"request {request_id} holds no final state (submit "
+                f"with hold_state=True, and resubmit at most once)"
+            )
+        bucket = self.buckets[parent.request.composite]
+        extra_steps = self._horizon_steps(bucket, extra_horizon)
+        request = dc_replace(
+            parent.request,
+            horizon=float(parent.request.horizon) + float(extra_horizon),
+        )
+        ticket = Ticket(
+            request_id=self.queue.next_id(),
+            request=request,
+            horizon_steps=parent.horizon_steps + extra_steps,
+            steps_done=parent.steps_done,
+            emit_count=parent.emit_count,
+            carry_state=parent.final_state,
+            parent=parent.request_id,
+        )
+        try:
+            self.queue.push(ticket, retry_after=self._retry_after())
+        except QueueFull:
+            self._metrics.inc("rejected")
+            self._metrics.queue_depth = len(self.queue)
+            raise
+        parent.final_state = None  # consumed: exactly-once continuation
+        self._metrics.inc("resubmitted")
+        self._metrics.queue_depth = len(self.queue)
+        self.tickets[ticket.request_id] = ticket
+        return ticket.request_id
+
+    def release_state(self, request_id: str) -> None:
+        """Drop a DONE request's held final state (a halving loser that
+        will never be extended) so its host RAM is reclaimed now rather
+        than at server close."""
+        self._ticket(request_id).final_state = None
 
     def status(self, request_id: str) -> Dict[str, Any]:
         t = self._ticket(request_id)
@@ -317,7 +388,46 @@ class SimServer:
             "horizon_steps": t.horizon_steps,
             "error": t.error,
             "result_path": t.result_path,
+            "parent": t.parent,
+            "server": self._gauges(),
         }
+
+    def metrics(self) -> Dict[str, Any]:
+        """A LIVE metrics snapshot: counters plus gauges recomputed at
+        call time (queue depth, busy lanes, retraces), so any caller —
+        the sweep driver pacing its submissions, an operator poking a
+        resident server — reads current health without waiting for the
+        next tick or for ``server_meta.json`` at close."""
+        self._refresh_gauges()
+        return self._metrics.snapshot()
+
+    def _gauges(self) -> Dict[str, Any]:
+        """The small live-health dict embedded in ``status()``."""
+        self._refresh_gauges()
+        return {
+            "occupancy": self._metrics.occupancy(),
+            "queue_depth": self._metrics.queue_depth,
+            "lanes_busy": self._metrics.lanes_busy,
+            "lanes_total": self._metrics.lanes_total,
+            "retraces": self._metrics.retraces,
+        }
+
+    def reset_samples(self) -> None:
+        """Drop accumulated latency/wait/window samples (counters stay).
+        Benchmark hygiene: called after a warmup round so compile-time
+        outliers never dilute the measured percentiles."""
+        self._metrics.latency_seconds.clear()
+        self._metrics.wait_seconds.clear()
+        self._metrics.window_seconds.clear()
+
+    def _refresh_gauges(self) -> None:
+        self._metrics.queue_depth = len(self.queue)
+        self._metrics.lanes_busy = sum(
+            len(b.assignments) for b in self.buckets.values()
+        )
+        self._metrics.retraces = sum(
+            b.pool.retraces() for b in self.buckets.values()
+        )
 
     def result(self, request_id: str):
         """The request's streamed trajectory: a stacked timeseries tree
@@ -340,8 +450,8 @@ class SimServer:
         t = self._ticket(request_id)
         if t.status == QUEUED and self.queue.drop(t):
             self._finish(t, CANCELLED)
-            self.metrics.inc("cancelled")
-            self.metrics.queue_depth = len(self.queue)
+            self._metrics.inc("cancelled")
+            self._metrics.queue_depth = len(self.queue)
         elif t.status == RUNNING:
             t.cancel_requested = True
         return t.status
@@ -359,14 +469,14 @@ class SimServer:
         per occupied bucket, stream, retire. Returns False when the
         server is fully idle (nothing queued, no lane busy)."""
         now = time.perf_counter()
-        self.metrics.inc("ticks")
+        self._metrics.inc("ticks")
         did_work = False
 
         # 1. queued-side expiry (cancel of queued tickets is immediate
         #    in cancel(); only deadlines need the sweep)
         for t in self.queue.expire(now):
             self._finish(t, TIMEOUT)
-            self.metrics.inc("timeouts")
+            self._metrics.inc("timeouts")
 
         # 2. running-side cancel/expiry: reclaim lanes BEFORE admission
         #    so freed lanes are reusable this very tick
@@ -377,10 +487,10 @@ class SimServer:
                     del bucket.assignments[lane]
                     if t.cancel_requested:
                         self._finish(t, CANCELLED)
-                        self.metrics.inc("cancelled")
+                        self._metrics.inc("cancelled")
                     else:
                         self._finish(t, TIMEOUT)
-                        self.metrics.inc("timeouts")
+                        self._metrics.inc("timeouts")
                     did_work = True
 
         # 3. admission: FIFO over the queue, per-bucket free lanes
@@ -392,7 +502,7 @@ class SimServer:
         ):
             did_work = True
             self._admit(t, now)
-        self.metrics.queue_depth = len(self.queue)
+        self._metrics.queue_depth = len(self.queue)
 
         # 4. one window per bucket with any occupied lane
         for bucket in self.buckets.values():
@@ -401,10 +511,10 @@ class SimServer:
             did_work = True
             self._run_bucket_window(bucket)
 
-        self.metrics.lanes_busy = sum(
+        self._metrics.lanes_busy = sum(
             len(b.assignments) for b in self.buckets.values()
         )
-        self.metrics.retraces = sum(
+        self._metrics.retraces = sum(
             b.pool.retraces() for b in self.buckets.values()
         )
         return did_work
@@ -424,7 +534,7 @@ class SimServer:
                 raise RuntimeError(
                     f"server not idle after {ticks} ticks "
                     f"(queue={len(self.queue)}, "
-                    f"busy={self.metrics.lanes_busy})"
+                    f"busy={self._metrics.lanes_busy})"
                 )
 
     # -- internals -----------------------------------------------------------
@@ -437,34 +547,42 @@ class SimServer:
             b.pool.n_lanes for b in self.buckets.values()
         )
         backlog_windows = len(self.queue) / max(total_lanes, 1) + 1.0
-        return backlog_windows * self.metrics.avg_window_seconds()
+        return backlog_windows * self._metrics.avg_window_seconds()
 
     def _admit(self, t: Ticket, now: float) -> None:
         bucket = self.buckets[t.request.composite]
         lane = bucket.next_free_lane()
+        # a continuation ticket arms only its REMAINING steps (its
+        # steps_done already counts the parent's run); fresh tickets
+        # have steps_done == 0 so this is their full horizon
+        arm_steps = t.horizon_steps - t.steps_done
         try:
-            bucket.pool.admit(
-                lane,
-                seed=int(t.request.seed),
-                horizon_steps=t.horizon_steps,
-                n_agents=bucket.pool.default_agents(
-                    t.request.n_agents
-                    if t.request.n_agents is not None
-                    else bucket.cfg["n_agents"]
-                ),
-                overrides=t.request.overrides or None,
-            )
+            if t.carry_state is not None:
+                bucket.pool.admit_state(lane, t.carry_state, arm_steps)
+                t.carry_state = None  # scattered; free the host copy
+            else:
+                bucket.pool.admit(
+                    lane,
+                    seed=int(t.request.seed),
+                    horizon_steps=arm_steps,
+                    n_agents=bucket.pool.default_agents(
+                        t.request.n_agents
+                        if t.request.n_agents is not None
+                        else bucket.cfg["n_agents"]
+                    ),
+                    overrides=t.request.overrides or None,
+                )
         except Exception as e:  # bad overrides/counts: fail the REQUEST
             t.error = f"{type(e).__name__}: {e}"
             self._finish(t, FAILED)
-            self.metrics.inc("failed")
+            self._metrics.inc("failed")
             return
         t.status = RUNNING
         t.lane = lane
         t.admitted_at = now
         bucket.assignments[lane] = t
         self._results[t.request_id] = self._make_sink(t)
-        self.metrics.inc("admitted")
+        self._metrics.inc("admitted")
 
     def _make_sink(self, t: Ticket):
         if self.sink == "ram":
@@ -498,10 +616,10 @@ class SimServer:
         # per-segment transfer).
         host = jax.device_get(traj)
         wall = time.perf_counter() - t0
-        self.metrics.inc("windows")
-        self.metrics.inc("lane_windows_busy", len(bucket.assignments))
-        self.metrics.inc("lane_windows_total", pool.n_lanes)
-        self.metrics.observe_window(wall)
+        self._metrics.inc("windows")
+        self._metrics.inc("lane_windows_busy", len(bucket.assignments))
+        self._metrics.inc("lane_windows_total", pool.n_lanes)
+        self._metrics.observe_window(wall)
 
         for lane, t in list(bucket.assignments.items()):
             before = int(remaining_before[lane])
@@ -509,9 +627,14 @@ class SimServer:
             ran = min(before, pool.window_steps)
             t.steps_done += ran
             if before <= pool.window_steps:  # horizon elapsed: retire
+                if t.request.hold_state:
+                    # capture the lane's exact final bits BEFORE the
+                    # lane can be reassigned, so a later resubmit
+                    # continues the scenario bitwise
+                    t.final_state = pool.lane_state(lane)
                 del bucket.assignments[lane]
                 self._finish(t, DONE)
-                self.metrics.inc("retired")
+                self._metrics.inc("retired")
 
     def _stream_lane(
         self, pool: LanePool, t: Ticket, lane: int, before: int, host
@@ -532,12 +655,20 @@ class SimServer:
         if not rows:
             return
         idx = np.asarray(rows)
-        tree = jax.tree.map(lambda leaf: np.asarray(leaf)[idx, lane], host)
+        # path-filter BEFORE slicing: the filter is a pure projection,
+        # so it commutes with the row/lane slice below — but applying
+        # it first means the per-lane-per-window host work touches only
+        # the kept leaves (a sweep trial keeps objective-sized slices
+        # of a much wider emit tree)
         paths = (t.request.emit or {}).get("paths")
+        source = host
         if paths:
-            tree = _filter_paths(tree, [str(p) for p in paths])
-            if not tree:
+            source = _filter_paths(host, [str(p) for p in paths])
+            if not source:
                 return
+        tree = jax.tree.map(
+            lambda leaf: np.asarray(leaf)[idx, lane], source
+        )
         times = (
             t.steps_done + (idx + 1) * pool.emit_every
         ) * pool.timestep
@@ -550,7 +681,7 @@ class SimServer:
         if sink is not None:
             sink.close()
         if t.admitted_at is not None:
-            self.metrics.observe_request(
+            self._metrics.observe_request(
                 t.admitted_at - t.submitted_at,
                 t.finished_at - t.submitted_at,
             )
@@ -564,10 +695,11 @@ class SimServer:
         for sink in self._results.values():
             sink.close()
         if self.out_dir:
+            self._refresh_gauges()
             write_server_meta(
                 self.out_dir,
                 {name: b.cfg for name, b in self.buckets.items()},
-                self.metrics,
+                self._metrics,
             )
 
     def __enter__(self) -> "SimServer":

@@ -1,0 +1,73 @@
+"""lens_tpu.sweep: resumable parameter sweeps & adaptive search.
+
+The fleet layer the reference ran as one submitted experiment cluster
+per parameter point (SURVEY.md §3.3), rebuilt over this repo's
+substrates: a declarative spec names a search space (grid / random /
+Latin hypercube), a scalar objective read off emitted trajectories, and
+a backend — the continuous-batching scenario server
+(:mod:`lens_tpu.serve`) for scheduled trials with successive-halving
+early stopping, or a direct vmapped :class:`~lens_tpu.colony.Ensemble`
+for dense grids. Trials and their PRNG seeds are a deterministic
+function of ``(sweep_seed, trial_index)``; every terminal fact lands in
+an fsynced append-only ledger, so a killed sweep resumes by replay and
+re-runs only unfinished trials. See docs/sweeps.md.
+
+    from lens_tpu.sweep import run_sweep
+    result = run_sweep({
+        "composite": "minimal_ode",
+        "space": {"kind": "grid", "params": {
+            "environment/glucose_external": {"grid": [0.2, 1.0, 5.0]},
+        }},
+        "horizon": 40.0,
+        "objective": {"path": "cell/glucose_internal",
+                      "reduction": "final_live_sum", "mode": "max"},
+    }, out_dir="out/sweep1")
+    print(result.best)
+
+or from the CLI: ``python -m lens_tpu sweep --spec sweep.json``.
+"""
+
+from lens_tpu.sweep.driver import (
+    SweepResult,
+    SweepSpec,
+    run_sweep,
+    rung_steps,
+)
+from lens_tpu.sweep.ledger import (
+    LEDGER_NAME,
+    TABLE_NAME,
+    MemoryLedger,
+    TrialLedger,
+    spec_fingerprint,
+)
+from lens_tpu.sweep.objective import REDUCTIONS, Objective
+from lens_tpu.sweep.space import (
+    GridSpace,
+    LatinHypercubeSpace,
+    RandomSpace,
+    Trial,
+    space_from_spec,
+    stack_overrides,
+    trial_seed,
+)
+
+__all__ = [
+    "GridSpace",
+    "LatinHypercubeSpace",
+    "LEDGER_NAME",
+    "MemoryLedger",
+    "Objective",
+    "RandomSpace",
+    "REDUCTIONS",
+    "SweepResult",
+    "SweepSpec",
+    "TABLE_NAME",
+    "Trial",
+    "TrialLedger",
+    "run_sweep",
+    "rung_steps",
+    "space_from_spec",
+    "spec_fingerprint",
+    "stack_overrides",
+    "trial_seed",
+]

@@ -1,0 +1,782 @@
+"""The sweep driver: a declarative spec -> a scored, resumable trial table.
+
+Two backends over one scheduling contract:
+
+- **server** (:class:`_ServerSweep`): trials ride a
+  :class:`~lens_tpu.serve.SimServer` as ordinary scenario requests —
+  per-trial seed, overrides, horizon, and an emit spec narrowed to what
+  the objective reads — with bounded in-flight concurrency. Trials
+  inherit serve's co-batching determinism: a trial's trajectory (and so
+  its objective) is BITWISE what a solo request with the same
+  seed/overrides would produce, regardless of which other trials share
+  the lanes or how the sweep is scheduled/resumed.
+- **ensemble** (:class:`_EnsembleSweep`): dense grids skip the
+  scheduler entirely — trials are packed into fixed-size chunks on the
+  replicate axis of an :class:`~lens_tpu.colony.ensemble.Ensemble`, one
+  compiled program per chunk size, per-trial PRNG keys derived from
+  ``(sweep_seed, trial_index)`` via the explicit ``keys=`` hook. The
+  chunk partition is a pure function of the trial list, so a resumed
+  sweep re-runs each unfinished chunk with its original composition and
+  reproduces the same bits.
+
+Early stopping is successive halving (the ASHA family): rung horizons
+``min_horizon * eta^r``, at each rung keep the top ``1/eta`` of
+survivors and stop the rest. Survivors are EXTENDED, never rerun —
+each rung's request asks ``hold_state=True``, and promotion is a
+``SimServer.resubmit`` that re-arms the held lane state for the next
+rung's extra steps (bitwise a longer original request; losers'
+objectives are scored from the trajectory prefix they already
+streamed). The wasted work of a classical restart-per-rung
+implementation (re-simulating every survivor's prefix eta times) never
+happens.
+
+Crash safety is the ledger's (``lens_tpu.sweep.ledger``): every
+terminal fact is fsynced before the driver acts on it, resume replays
+the ledger and re-runs only trials without terminal events, and the
+final table of a killed-and-resumed sweep is identical — objective
+values bitwise — to an uninterrupted run's.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from lens_tpu.sweep.ledger import (
+    LEDGER_NAME,
+    TABLE_NAME,
+    TRIAL_DONE,
+    TRIAL_RUNG,
+    TRIAL_STOPPED,
+    MemoryLedger,
+    TrialLedger,
+    spec_fingerprint,
+    write_table,
+)
+from lens_tpu.sweep.objective import Objective
+from lens_tpu.sweep.space import Trial, space_from_spec, stack_overrides
+
+#: statuses a trial row can carry in the result table
+DONE_S, STOPPED_S, FAILED_S, PENDING_S = "done", "stopped", "failed", "pending"
+
+_SPEC_KEYS = {
+    "composite", "config", "space", "seed", "horizon", "objective",
+    "backend", "asha", "n_agents", "capacity", "timestep", "emit_every",
+    "save_trajectories",
+}
+
+
+@dataclass
+class SweepSpec:
+    """The declarative sweep description (see docs/sweeps.md).
+
+    ``backend`` carries scheduling knobs only (``kind`` plus lanes /
+    window / queue_depth / max_in_flight for the server backend,
+    ``batch`` for the ensemble backend); everything that shapes the
+    simulation or the trial set is a top-level field and part of the
+    resume fingerprint.
+    """
+
+    composite: str
+    space: Mapping[str, Any]
+    horizon: float
+    objective: Mapping[str, Any]
+    config: Mapping[str, Any] = field(default_factory=dict)
+    seed: int = 0
+    backend: Mapping[str, Any] = field(default_factory=dict)
+    asha: Optional[Mapping[str, Any]] = None
+    n_agents: Any = 1
+    capacity: Optional[int] = None
+    timestep: float = 1.0
+    emit_every: int = 1
+    save_trajectories: bool = False
+
+    @classmethod
+    def from_mapping(cls, spec: Mapping[str, Any] | "SweepSpec") -> "SweepSpec":
+        if isinstance(spec, SweepSpec):
+            return spec
+        unknown = set(spec) - _SPEC_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown sweep spec keys {sorted(unknown)}; known: "
+                f"{sorted(_SPEC_KEYS)}"
+            )
+        missing = [
+            k for k in ("composite", "space", "horizon", "objective")
+            if k not in spec
+        ]
+        if missing:
+            raise ValueError(f"sweep spec is missing {missing}")
+        return cls(**dict(spec))
+
+    @property
+    def kind(self) -> str:
+        return str((self.backend or {}).get("kind", "server"))
+
+    def canonical(self) -> Dict[str, Any]:
+        """The fields that determine the trial set and its scoring —
+        the resume fingerprint. Scheduling knobs (lanes, window,
+        queue depth, in-flight bound) are deliberately absent: serve's
+        co-batching determinism makes results independent of them. The
+        ensemble chunk size IS included — it fixes chunk composition,
+        the unit of bitwise reproducibility for that backend.
+
+        The space's ``params`` mapping is rendered as an ORDERED list
+        of ``[path, spec]`` pairs: trial enumeration (grid product
+        order, per-param draw order) follows parameter insertion
+        order, so a spec whose params were merely re-keyed in a
+        different order is a DIFFERENT sweep and must not pass the
+        resume fingerprint (``sort_keys`` canonicalization would
+        otherwise erase exactly the order that matters)."""
+        space = dict(self.space)
+        if isinstance(space.get("params"), Mapping):
+            space["params"] = [
+                [str(path), dict(p) if isinstance(p, Mapping) else p]
+                for path, p in space["params"].items()
+            ]
+        out = {
+            "composite": self.composite,
+            "config": dict(self.config or {}),
+            "space": space,
+            "seed": int(self.seed),
+            "horizon": float(self.horizon),
+            "objective": Objective.from_spec(self.objective).spec(),
+            "n_agents": self.n_agents,
+            "capacity": self.capacity,
+            "timestep": float(self.timestep),
+            "emit_every": int(self.emit_every),
+            "asha": dict(self.asha) if self.asha else None,
+            "backend_kind": self.kind,
+        }
+        if self.kind == "ensemble":
+            out["batch"] = (self.backend or {}).get("batch")
+        return out
+
+
+@dataclass
+class SweepResult:
+    """What a sweep run hands back: the per-trial table (trial order),
+    the best full-horizon trial, backend/server metrics, per-trial
+    timeseries (emitted paths only; absent for trials finished in a
+    PREVIOUS run — their objectives replay from the ledger but their
+    trajectories were not re-simulated), and the written table path."""
+
+    table: List[Dict[str, Any]]
+    best: Optional[Dict[str, Any]]
+    metrics: Dict[str, Any]
+    timeseries: Dict[int, Dict[str, Any]]
+    path: Optional[str] = None
+
+
+def rung_steps(
+    min_steps: int, eta: int, max_steps: int, emit_every: int
+) -> List[int]:
+    """Successive-halving rung horizons in steps: geometric in ``eta``
+    from ``min_steps``, each snapped UP to the emit grid, capped and
+    terminated at ``max_steps`` (always the last rung)."""
+    if eta < 2:
+        raise ValueError(f"eta={eta} must be >= 2")
+    if min_steps < 1:
+        raise ValueError(f"min_horizon must be >= one step")
+    rungs: List[int] = []
+    s = float(min_steps)
+    while True:
+        snapped = max(emit_every, int(math.ceil(s / emit_every)) * emit_every)
+        if snapped >= max_steps:
+            break
+        if not rungs or snapped > rungs[-1]:
+            rungs.append(snapped)
+        s *= eta
+    rungs.append(int(max_steps))
+    return rungs
+
+
+def _concat_ts(parts: List[Mapping]) -> Dict[str, Any]:
+    """Stitch continuation segments ([T_i, ...] trees sharing one
+    structure) into one timeseries along the time axis."""
+    if len(parts) == 1:
+        return dict(parts[0])
+    import jax
+
+    return jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts
+    )
+
+
+class _ServerSweep:
+    """Drive trials through a SimServer with bounded in-flight
+    concurrency; optionally successive-halving with hold-state
+    extension."""
+
+    def __init__(
+        self,
+        spec: SweepSpec,
+        trials: List[Trial],
+        objective: Objective,
+        ledger: TrialLedger,
+        server=None,
+    ):
+        from lens_tpu.serve import SimServer
+
+        self.spec = spec
+        self.trials = {t.index: t for t in trials}
+        self.order = [t.index for t in trials]
+        self.objective = objective
+        self.ledger = ledger
+        backend = dict(spec.backend or {})
+        backend.pop("kind", None)
+        self.max_in_flight = backend.pop("max_in_flight", None)
+        self.owns_server = server is None
+        if server is None:
+            server = SimServer.single_bucket(
+                spec.composite,
+                config=dict(spec.config or {}),
+                capacity=spec.capacity,
+                n_agents=spec.n_agents,
+                timestep=spec.timestep,
+                emit_every=spec.emit_every,
+                **backend,
+            )
+        if spec.composite not in server.buckets:
+            raise ValueError(
+                f"server has no bucket for composite "
+                f"{spec.composite!r}; configured: "
+                f"{sorted(server.buckets)}"
+            )
+        self.server = server
+        pool = server.buckets[spec.composite].pool
+        self.dt = pool.timestep
+        self.emit_every = pool.emit_every
+        if self.max_in_flight is None:
+            self.max_in_flight = 2 * pool.n_lanes
+        emit_paths = objective.emit_paths()
+        self.emit_spec = {"paths": emit_paths} if emit_paths else None
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _submit(self, request) -> str:
+        from lens_tpu.serve import QueueFull
+
+        while True:
+            try:
+                return self.server.submit(request)
+            except QueueFull:
+                self.server.tick()
+
+    def _resubmit(self, rid: str, extra_horizon: float) -> str:
+        from lens_tpu.serve import QueueFull
+
+        while True:
+            try:
+                return self.server.resubmit(rid, extra_horizon)
+            except QueueFull:
+                self.server.tick()
+
+    def _request(self, trial: Trial, horizon: float, hold: bool):
+        from lens_tpu.serve import ScenarioRequest
+
+        return ScenarioRequest(
+            composite=self.spec.composite,
+            seed=trial.seed,
+            horizon=horizon,
+            overrides=trial.overrides(),
+            emit=self.emit_spec,
+            hold_state=hold,
+        )
+
+    def _record_done(self, index, objective, status, steps, on_trial):
+        if self.ledger.terminal(index):
+            return  # replay idempotence: never double-record a trial
+        event = {
+            "event": TRIAL_DONE,
+            "trial": index,
+            "seed": self.trials[index].seed,
+            "objective": objective,
+            "status": status,
+            "steps": steps,
+        }
+        self.ledger.append(event)
+        if on_trial is not None:
+            on_trial(index, event)
+
+    def run(self, on_trial=None) -> Tuple[Dict[int, Dict], Dict[str, Any]]:
+        if self.spec.asha:
+            ts = self._run_halving(on_trial)
+        else:
+            ts = self._run_race(on_trial)
+        return ts, {"backend": "server", "server": self.server.metrics()}
+
+    def close(self) -> None:
+        if self.owns_server:
+            self.server.close()
+
+    # -- race: every trial to the full horizon -------------------------------
+
+    def _run_race(self, on_trial) -> Dict[int, Dict]:
+        from lens_tpu.serve import CANCELLED, DONE, FAILED, TIMEOUT
+
+        pending = [
+            self.trials[i] for i in self.order
+            if not self.ledger.terminal(i)
+        ]
+        inflight: Dict[str, Trial] = {}
+        ts_by_trial: Dict[int, Dict] = {}
+        k = 0
+        while k < len(pending) or inflight:
+            while k < len(pending) and len(inflight) < self.max_in_flight:
+                t = pending[k]
+                rid = self._submit(
+                    self._request(t, self.spec.horizon, hold=False)
+                )
+                inflight[rid] = t
+                k += 1
+            self.server.tick()
+            for rid, t in list(inflight.items()):
+                status = self.server.status(rid)["status"]
+                if status == DONE:
+                    ts = self.server.result(rid)
+                    ts_by_trial[t.index] = ts
+                    del inflight[rid]
+                    self._record_done(
+                        t.index,
+                        self.objective.value(ts),
+                        DONE_S,
+                        self.server.status(rid)["steps_done"],
+                        on_trial,
+                    )
+                elif status in (FAILED, TIMEOUT, CANCELLED):
+                    del inflight[rid]
+                    self._record_done(t.index, None, FAILED_S, 0, on_trial)
+        return ts_by_trial
+
+    # -- successive halving --------------------------------------------------
+
+    def _run_halving(self, on_trial) -> Dict[int, Dict]:
+        from lens_tpu.serve import CANCELLED, DONE, FAILED, TIMEOUT
+
+        asha = dict(self.spec.asha)
+        eta = int(asha.get("eta", 3))
+        min_h = asha.get("min_horizon")
+        if min_h is None:
+            raise ValueError("asha spec needs min_horizon")
+        max_steps = int(round(float(self.spec.horizon) / self.dt))
+        rungs = rung_steps(
+            int(round(float(min_h) / self.dt)),
+            eta,
+            max_steps,
+            self.emit_every,
+        )
+        ledger = self.ledger
+        rid_of: Dict[int, str] = {}
+        # trials whose CURRENT chain leg is queued/running — maintained
+        # explicitly (add on submit/resubmit, drop when the leg is
+        # observed terminal) so the in-flight bound costs O(1) instead
+        # of a status() poll over every rid ever created
+        in_flight: set = set()
+        segments: Dict[int, List[Mapping]] = {}
+        scored: Dict[int, str] = {}  # rid whose result is already stitched
+        ts_by_trial: Dict[int, Dict] = {}
+
+        def participants(r: int) -> List[int]:
+            """Trials ranked at rung ``r``: everything not stopped at an
+            EARLIER rung and not failed. Trials already stopped AT rung
+            ``r`` (a resume replaying a half-recorded cut) stay in, so
+            the recomputed cut sees the original cohort size and
+            re-derives the original decision; trials finished in a
+            previous run stay in so the original winner can win again."""
+            out = []
+            for i in self.order:
+                stop = ledger.stopped.get(i)
+                if stop is not None and int(stop.get("rung", -1)) < r:
+                    continue
+                done = ledger.done.get(i)
+                if done is not None and done.get("objective") is None:
+                    continue  # failed trials are never ranked
+                out.append(i)
+            return out
+
+        for r, steps_r in enumerate(rungs):
+            t_r = steps_r * self.dt
+            # drive every participant that still needs to REACH rung r
+            # by simulation (finished-in-ledger trials replay their
+            # recorded rung values instead)
+            while True:
+                need = [
+                    i for i in participants(r)
+                    if i not in ledger.done
+                    and r not in ledger.rungs.get(i, {})
+                ]
+                if not need:
+                    break
+                for i in need:
+                    if len(in_flight) >= self.max_in_flight:
+                        break
+                    if i not in rid_of:
+                        # fresh submission straight to rung r's horizon
+                        # (resume path: recorded earlier rungs replay)
+                        rid_of[i] = self._submit(
+                            self._request(self.trials[i], t_r, hold=True)
+                        )
+                        in_flight.add(i)
+                self.server.tick()
+                for i in list(need):
+                    rid = rid_of.get(i)
+                    if rid is None:
+                        continue
+                    status = self.server.status(rid)["status"]
+                    if status == DONE and scored.get(i) != rid:
+                        in_flight.discard(i)
+                        segments.setdefault(i, []).append(
+                            self.server.result(rid)
+                        )
+                        scored[i] = rid
+                        ledger.append({
+                            "event": TRIAL_RUNG,
+                            "trial": i,
+                            "rung": r,
+                            "objective": self.objective.value(
+                                _concat_ts(segments[i]), up_to_time=t_r
+                            ),
+                        })
+                    elif status in (FAILED, TIMEOUT, CANCELLED):
+                        in_flight.discard(i)
+                        self._record_done(i, None, FAILED_S, 0, on_trial)
+
+            cohort = participants(r)
+            if r < len(rungs) - 1:
+                # the halving cut over the FULL rung-r cohort (stops
+                # already recorded at r re-derive identically and are
+                # not re-appended)
+                values = {
+                    i: (
+                        ledger.done[i]["objective"]
+                        if i in ledger.done
+                        and r not in ledger.rungs.get(i, {})
+                        else ledger.rungs[i][r]
+                    )
+                    for i in cohort
+                }
+                ranked = self.objective.rank(values)
+                keep = max(1, len(ranked) // eta)
+                for i in ranked[keep:]:
+                    if i not in ledger.stopped:
+                        ledger.append({
+                            "event": TRIAL_STOPPED,
+                            "trial": i,
+                            "rung": r,
+                            "objective": values[i],
+                        })
+                    if i in rid_of:
+                        self.server.release_state(rid_of[i])
+                    if i in segments:
+                        ts_by_trial[i] = _concat_ts(segments.pop(i))
+                extra = (rungs[r + 1] - steps_r) * self.dt
+                for i in ranked[:keep]:
+                    if i in ledger.done or i not in rid_of:
+                        continue  # replayed trial; submits at its next rung
+                    rid_of[i] = self._resubmit(rid_of[i], extra)
+                    in_flight.add(i)
+            else:
+                for i in cohort:
+                    if i in ledger.done:
+                        continue
+                    if i in segments:
+                        ts = _concat_ts(segments.pop(i))
+                        ts_by_trial[i] = ts
+                        value = self.objective.value(ts)
+                    else:
+                        # resume killed between the final TRIAL_RUNG
+                        # append and TRIAL_DONE: the full-horizon sim
+                        # already ran, and the final rung's objective
+                        # IS the full-horizon objective (same bits) —
+                        # finish from the ledger, nothing to re-run
+                        value = ledger.rungs[i][r]
+                    if i in rid_of:
+                        self.server.release_state(rid_of[i])
+                    self._record_done(i, value, DONE_S, steps_r, on_trial)
+        return ts_by_trial
+
+
+class _EnsembleSweep:
+    """Dense grids as chunked one-compile ensemble runs (no scheduler,
+    no early stopping — every trial runs the full horizon)."""
+
+    def __init__(
+        self,
+        spec: SweepSpec,
+        trials: List[Trial],
+        objective: Objective,
+        ledger: TrialLedger,
+    ):
+        self.spec = spec
+        self.trials = trials
+        self.objective = objective
+        self.ledger = ledger
+        if spec.asha:
+            raise ValueError(
+                "the ensemble backend has no early stopping; use "
+                "backend kind 'server' for asha sweeps"
+            )
+        batch = (spec.backend or {}).get("batch")
+        self.batch = int(batch) if batch else min(len(trials), 64)
+        if self.batch < 1:
+            raise ValueError(f"batch={self.batch} must be >= 1")
+
+    def run(self, on_trial=None) -> Tuple[Dict[int, Dict], Dict[str, Any]]:
+        import jax
+        import jax.numpy as jnp
+
+        from lens_tpu.colony.ensemble import Ensemble
+        from lens_tpu.experiment import build_model
+
+        spec, ledger = self.spec, self.ledger
+        steps = int(round(float(spec.horizon) / spec.timestep))
+        if steps < 1 or steps % spec.emit_every != 0:
+            raise ValueError(
+                f"horizon={spec.horizon} must be a positive multiple of "
+                f"timestep*emit_every "
+                f"({spec.timestep}*{spec.emit_every})"
+            )
+        sim = build_model(
+            spec.composite,
+            dict(spec.config or {}),
+            capacity=spec.capacity,
+            n_agents=spec.n_agents,
+        ).sim
+        times = (
+            np.arange(1, steps // spec.emit_every + 1)
+            * spec.emit_every
+            * spec.timestep
+        )
+        # The chunk partition is fixed by (trial list, batch): the unit
+        # of resume. A partially-finished chunk re-runs WHOLE (same
+        # composition -> same compiled program -> same bits) and only
+        # its unfinished trials append ledger events.
+        chunks = [
+            self.trials[i:i + self.batch]
+            for i in range(0, len(self.trials), self.batch)
+        ]
+        runners: Dict[int, Any] = {}  # chunk size -> jitted program
+        ts_by_trial: Dict[int, Dict] = {}
+        windows = 0
+        for chunk in chunks:
+            if all(ledger.terminal(t.index) for t in chunk):
+                continue
+            n = len(chunk)
+            ens = Ensemble(sim, n)
+            keys = jnp.stack(
+                [jax.random.PRNGKey(t.seed) for t in chunk]
+            )
+            rep = stack_overrides(chunk) if chunk[0].params else None
+            states = ens.initial_state(
+                spec.n_agents, keys=keys, replicate_overrides=rep
+            )
+            runner = runners.get(n)
+            if runner is None:
+                runner = jax.jit(
+                    lambda s, e=ens: e.run(
+                        s,
+                        float(spec.horizon),
+                        spec.timestep,
+                        emit_every=spec.emit_every,
+                    )
+                )
+                runners[n] = runner
+            _, traj = runner(states)
+            host = jax.device_get(traj)
+            windows += 1
+            for r, t in enumerate(chunk):
+                ts = jax.tree.map(lambda x: np.asarray(x)[:, r], host)
+                ts["__times__"] = times
+                ts_by_trial[t.index] = ts
+                if ledger.terminal(t.index):
+                    continue
+                event = {
+                    "event": TRIAL_DONE,
+                    "trial": t.index,
+                    "seed": t.seed,
+                    "objective": self.objective.value(ts),
+                    "status": DONE_S,
+                    "steps": steps,
+                }
+                ledger.append(event)
+                if on_trial is not None:
+                    on_trial(t.index, event)
+        return ts_by_trial, {
+            "backend": "ensemble",
+            "batch": self.batch,
+            "chunks_run": windows,
+            "chunks_total": len(chunks),
+        }
+
+    def close(self) -> None:
+        pass
+
+
+def _build_table(
+    trials: List[Trial], ledger: TrialLedger, objective: Objective
+) -> Tuple[List[Dict[str, Any]], Optional[Dict[str, Any]]]:
+    rows = []
+    for t in trials:
+        row = {
+            "trial": t.index,
+            "seed": t.seed,
+            "params": dict(t.params),
+        }
+        if t.index in ledger.done:
+            ev = ledger.done[t.index]
+            row.update(
+                status=ev.get("status", DONE_S),
+                objective=ev.get("objective"),
+                steps=ev.get("steps"),
+            )
+        elif t.index in ledger.stopped:
+            ev = ledger.stopped[t.index]
+            row.update(
+                status=STOPPED_S,
+                objective=ev.get("objective"),
+                rung=ev.get("rung"),
+            )
+        else:
+            row.update(status=PENDING_S, objective=None)
+        rows.append(row)
+    finished = {
+        r["trial"]: r["objective"]
+        for r in rows
+        if r["status"] == DONE_S and r["objective"] is not None
+    }
+    best = None
+    if finished:
+        best_index = objective.rank(finished)[0]
+        best = next(r for r in rows if r["trial"] == best_index)
+    return rows, best
+
+
+def _save_trajectories(
+    out_dir: str, timeseries: Mapping[int, Mapping], spec: SweepSpec
+) -> str:
+    """One framed emit log per trial under ``<out_dir>/trials/`` — the
+    layout ``analysis.load_many`` loads back."""
+    from lens_tpu.emit import LogEmitter
+
+    trial_dir = os.path.join(out_dir, "trials")
+    os.makedirs(trial_dir, exist_ok=True)
+    for index, ts in sorted(timeseries.items()):
+        path = os.path.join(trial_dir, f"trial_{index:05d}.lens")
+        if os.path.exists(path):
+            os.remove(path)  # re-run of this trial wholly owns its log
+        tree = {k: v for k, v in ts.items() if k != "__times__"}
+        emitter = LogEmitter(
+            experiment_id=f"trial_{index:05d}",
+            config={"sweep": spec.canonical(), "trial": index},
+            path=path,
+        )
+        emitter.emit_trajectory(tree, times=ts.get("__times__"))
+        emitter.close()
+    return trial_dir
+
+
+def run_sweep(
+    spec: Mapping[str, Any] | SweepSpec,
+    out_dir: Optional[str] = None,
+    resume: bool = False,
+    server=None,
+    on_trial: Optional[Callable[[int, Mapping], None]] = None,
+) -> SweepResult:
+    """Run (or resume) a sweep to completion. The one entry point the
+    CLI, examples, benches, and tests share.
+
+    out_dir:
+        Where the ledger, ``sweep_result.json``, and (with
+        ``save_trajectories``) per-trial logs live. Without it the
+        sweep runs with an in-memory ledger — fine for interactive use,
+        nothing to resume from.
+    resume:
+        Required to reuse an out_dir holding a non-empty ledger (the
+        crash-recovery path); refused otherwise so two different sweeps
+        cannot interleave one ledger. The spec fingerprint must match.
+    server:
+        An existing ``SimServer`` to drive (the bench reuses one across
+        reps to keep compiles out of timings); the sweep then does NOT
+        close it.
+    on_trial:
+        ``(trial_index, terminal_event_dict)`` callback after each
+        trial's terminal ledger append — progress reporting, or a test
+        harness raising mid-sweep to exercise the resume contract.
+    """
+    spec = SweepSpec.from_mapping(spec)
+    space = space_from_spec(spec.space)
+    trials = space.trials(spec.seed)
+    objective = Objective.from_spec(spec.objective)
+    fingerprint = spec_fingerprint(spec.canonical())
+
+    if out_dir:
+        path = os.path.join(out_dir, LEDGER_NAME)
+        exists = os.path.exists(path) and os.path.getsize(path) > 0
+        if exists and not resume:
+            raise ValueError(
+                f"{path} already holds a sweep ledger; pass "
+                f"resume=True to continue it (or use a fresh out_dir)"
+            )
+        ledger: TrialLedger = TrialLedger(path)
+    else:
+        ledger = MemoryLedger()
+
+    t0 = time.perf_counter()
+    backend_cls = {
+        "server": _ServerSweep,
+        "ensemble": _EnsembleSweep,
+    }.get(spec.kind)
+    if backend_cls is None:
+        raise ValueError(
+            f"unknown backend kind {spec.kind!r}; known: server, ensemble"
+        )
+    try:
+        ledger.begin(
+            fingerprint,
+            {"n_trials": len(trials), "composite": spec.composite},
+        )
+        if backend_cls is _ServerSweep:
+            backend = _ServerSweep(
+                spec, trials, objective, ledger, server=server
+            )
+        else:
+            if server is not None:
+                raise ValueError(
+                    "server= only applies to the server backend"
+                )
+            backend = _EnsembleSweep(spec, trials, objective, ledger)
+        try:
+            timeseries, metrics = backend.run(on_trial)
+        finally:
+            backend.close()
+        metrics["wall_seconds"] = time.perf_counter() - t0
+        table, best = _build_table(trials, ledger, objective)
+        result = SweepResult(
+            table=table,
+            best=best,
+            metrics=metrics,
+            timeseries=timeseries,
+        )
+        if out_dir:
+            if spec.save_trajectories:
+                _save_trajectories(out_dir, timeseries, spec)
+            result.path = write_table(
+                os.path.join(out_dir, TABLE_NAME),
+                {
+                    "fingerprint": fingerprint,
+                    "spec": spec.canonical(),
+                    "n_trials": len(trials),
+                    "best": best,
+                    "metrics": metrics,
+                    "table": table,
+                },
+            )
+        return result
+    finally:
+        ledger.close()

@@ -1,0 +1,195 @@
+"""The trial ledger: append-only framed JSON events = crash-safe resume.
+
+The sweep driver's only durable state. Every scheduling fact that must
+survive a kill — which trials finished and with what objective, which
+rung objectives were recorded, which trials halving stopped — is one
+JSON event appended to ``sweep.ledger`` and flushed+fsynced before the
+driver acts on it. Resume is replay: re-enumerate the (deterministic)
+trial list from the spec, replay the ledger into per-trial state, and
+re-run only what has no terminal event. Because trials are bitwise
+reproducible within a backend, the resumed table is identical to an
+uninterrupted run's.
+
+Framing reuses the emit-log record frame (``emit/log.py``: magic + crc
++ length, via :func:`~lens_tpu.emit.log.iter_frames`) with a JSON
+payload instead of npz — same truncation semantics: a kill mid-append
+loses at most the torn tail frame, which replay silently drops. The
+final ``sweep_result.json`` table is written with ``checkpoint.py``'s
+write-tmp-then-rename discipline so a kill mid-write can never leave a
+torn table shadowing a good ledger.
+
+A ``sweep_begin`` event pins the spec fingerprint: resuming with a spec
+whose trial set or scoring could differ (changed space, seed, horizon,
+objective, ...) is refused instead of silently mixing two sweeps'
+trials in one ledger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from lens_tpu.emit.log import frame, iter_frames
+
+#: Event types (the full vocabulary — replay ignores unknown events so
+#: old readers tolerate newer ledgers).
+SWEEP_BEGIN = "sweep_begin"
+TRIAL_RUNG = "trial_rung"     # {trial, rung, objective}
+TRIAL_STOPPED = "trial_stopped"  # {trial, rung, objective} halving loser
+TRIAL_DONE = "trial_done"     # {trial, objective, status, ...} terminal
+
+LEDGER_NAME = "sweep.ledger"
+TABLE_NAME = "sweep_result.json"
+
+
+def spec_fingerprint(canonical: Mapping[str, Any]) -> str:
+    """sha256 over the canonical (sorted-key) JSON of the spec fields
+    that determine the trial set and its scoring."""
+    blob = json.dumps(canonical, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def write_table(path: str, table: Mapping[str, Any]) -> str:
+    """Atomic JSON write (tmp + rename), same discipline as
+    ``Checkpointer.save``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+class TrialLedger:
+    """One sweep's event log, replayed at open.
+
+    Replayed state (all idempotent — a re-appended duplicate event,
+    possible when a resumed run re-derives a decision, just overwrites
+    with identical content):
+
+    - ``meta``: the ``sweep_begin`` payload, or ``None`` on a fresh file;
+    - ``done``: ``{trial_index: trial_done event}``;
+    - ``stopped``: ``{trial_index: trial_stopped event}``;
+    - ``rungs``: ``{trial_index: {rung: objective}}``.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self.meta: Optional[Dict[str, Any]] = None
+        self.done: Dict[int, Dict[str, Any]] = {}
+        self.stopped: Dict[int, Dict[str, Any]] = {}
+        self.rungs: Dict[int, Dict[int, float]] = {}
+        self.events: List[Dict[str, Any]] = []
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            good = 0  # offset past the last COMPLETE frame
+            for payload, end in iter_frames(path, with_offsets=True):
+                try:
+                    event = json.loads(payload.decode())
+                except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                    raise ValueError(
+                        f"{path}: complete frame with undecodable JSON "
+                        f"payload ({e}) — not a sweep ledger?"
+                    )
+                self._apply(event)
+                good = end
+            if os.path.getsize(path) > good:
+                # a kill mid-append left a torn tail frame: drop it NOW,
+                # before reopening for append — otherwise this run's
+                # events would land after the torn bytes and every
+                # later replay would read garbage (CRC error) from the
+                # first resume onward
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+        self._file = open(path, "ab")
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def _apply(self, event: Dict[str, Any]) -> None:
+        self.events.append(event)
+        kind = event.get("event")
+        if kind == SWEEP_BEGIN:
+            self.meta = event
+        elif kind == TRIAL_DONE:
+            self.done[int(event["trial"])] = event
+        elif kind == TRIAL_STOPPED:
+            self.stopped[int(event["trial"])] = event
+        elif kind == TRIAL_RUNG:
+            self.rungs.setdefault(int(event["trial"]), {})[
+                int(event["rung"])
+            ] = event["objective"]
+        # unknown events: kept in .events, no state
+
+    def terminal(self, index: int) -> bool:
+        """True when the trial needs no further simulation (finished or
+        stopped by halving)."""
+        return index in self.done or index in self.stopped
+
+    def begin(self, fingerprint: str, meta: Mapping[str, Any]) -> None:
+        """Pin (or verify) the sweep identity. On a replayed ledger the
+        recorded fingerprint must match — resuming under a different
+        spec is refused."""
+        if self.meta is not None:
+            if self.meta.get("fingerprint") != fingerprint:
+                raise ValueError(
+                    f"{self.path} belongs to sweep fingerprint "
+                    f"{self.meta.get('fingerprint')!r}, not "
+                    f"{fingerprint!r} — the spec changed; use a fresh "
+                    f"out_dir (or restore the original spec) instead "
+                    f"of resuming"
+                )
+            return
+        self.append(
+            {"event": SWEEP_BEGIN, "fingerprint": fingerprint, **meta}
+        )
+
+    def append(self, event: Mapping[str, Any]) -> None:
+        """Durably append one event: framed, flushed, fsynced BEFORE the
+        driver acts on it — the ordering that makes replay an upper
+        bound on lost work (at most the in-flight trials)."""
+        event = dict(event)
+        payload = json.dumps(event, sort_keys=True, default=float).encode()
+        self._file.write(frame(payload))
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        self._apply(event)
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "TrialLedger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class MemoryLedger(TrialLedger):
+    """The no-``out_dir`` stand-in: same replayed-state interface, no
+    disk, nothing to resume from. Lets the driver run one code path."""
+
+    def __init__(self):
+        self.path = "<memory>"
+        self.meta = None
+        self.done = {}
+        self.stopped = {}
+        self.rungs = {}
+        self.events = []
+        self._file = None
+
+    def append(self, event: Mapping[str, Any]) -> None:
+        self._apply(dict(event))
+
+    def begin(self, fingerprint: str, meta: Mapping[str, Any]) -> None:
+        if self.meta is None:
+            self.append(
+                {"event": SWEEP_BEGIN, "fingerprint": fingerprint, **meta}
+            )
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,204 @@
+"""Scalar objectives: an emitted trajectory -> one number per trial.
+
+An objective is ``(path, reduction, mode)``: a schema-variable path into
+the emitted tree, a reduction collapsing its ``[T, rows, ...]`` leaf to
+a scalar, and whether bigger or smaller is better. It composes with
+serve's per-request emit specs through :meth:`Objective.emit_paths` —
+the sweep driver asks each trial's request to stream ONLY the leaves the
+objective reads (plus ``alive`` for live-masked reductions), so a
+thousand-trial sweep moves objective-sized traffic, not whole-state
+traffic, off the device.
+
+Reductions see the same timeseries trees every other consumer sees
+(``SimServer.result`` ram sinks, ``analysis.load`` trees, sliced
+ensemble trajectories); the ``__times__``/``__time__`` key carries the
+emit times, which is what lets successive-halving score a PARTIAL
+trajectory at a rung horizon (``up_to_time``) without touching the
+device program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from lens_tpu.emit.log import SEP
+from lens_tpu.utils.dicts import get_path
+
+#: reduction name -> (needs the alive mask, needs the full series)
+REDUCTIONS: Dict[str, Tuple[bool, bool]] = {
+    "final_live_sum": (True, False),
+    "final_live_mean": (True, False),
+    "final_sum": (False, False),
+    "final_mean": (False, False),
+    "final_alive_count": (True, False),
+    "mean": (False, True),
+    "max": (False, True),
+    "min": (False, True),
+}
+
+MODES = ("max", "min")
+
+
+def _times_of(timeseries: Mapping) -> Optional[np.ndarray]:
+    """The emit-time vector under either spelling: ``__times__`` (serve
+    ram sinks) or ``__time__`` (emit-log read path)."""
+    for key in ("__times__", "__time__"):
+        if key in timeseries:
+            return np.asarray(timeseries[key])
+    return None
+
+
+class Objective:
+    """One scalar read off a trajectory, plus its comparison direction.
+
+    path:
+        ``/``-joined string or component sequence into the emitted tree
+        (e.g. ``"global/mass"`` or ``("global", "mass")``). Ignored by
+        ``final_alive_count`` (which reads only the mask) but still
+        accepted for uniform specs.
+    reduction:
+        One of :data:`REDUCTIONS`. ``final_*`` reductions read the last
+        emitted frame (``live`` variants weight rows by the colony
+        ``alive`` mask — the batch-culture "final live biomass" read);
+        ``mean``/``max``/``min`` reduce over every frame and axis.
+    mode:
+        ``"max"`` or ``"min"`` — which direction the driver's ranking
+        (and successive halving's survivor cut) treats as better.
+    """
+
+    def __init__(
+        self,
+        path: str | Sequence[str],
+        reduction: str = "final_live_sum",
+        mode: str = "max",
+    ):
+        if reduction not in REDUCTIONS:
+            raise ValueError(
+                f"unknown reduction {reduction!r}; known: "
+                f"{sorted(REDUCTIONS)}"
+            )
+        if mode not in MODES:
+            raise ValueError(
+                f"unknown mode {mode!r}; known: {MODES}"
+            )
+        if isinstance(path, str):
+            self.path: Tuple[str, ...] = tuple(
+                p for p in path.split(SEP) if p
+            )
+        else:
+            self.path = tuple(str(p) for p in path)
+        if not self.path and reduction != "final_alive_count":
+            raise ValueError("objective needs a non-empty path")
+        self.reduction = reduction
+        self.mode = mode
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any] | "Objective") -> "Objective":
+        if isinstance(spec, Objective):
+            return spec
+        if not isinstance(spec, Mapping) or "path" not in spec:
+            raise ValueError(
+                f"objective spec needs a 'path', got {spec!r}"
+            )
+        return cls(
+            spec["path"],
+            reduction=str(spec.get("reduction", "final_live_sum")),
+            mode=str(spec.get("mode", "max")),
+        )
+
+    def spec(self) -> Dict[str, Any]:
+        return {
+            "path": SEP.join(self.path),
+            "reduction": self.reduction,
+            "mode": self.mode,
+        }
+
+    # -- emit coupling -------------------------------------------------------
+
+    def emit_paths(self) -> List[str]:
+        """The path prefixes a trial's serve request must stream for
+        this objective to be computable — the per-request emit filter
+        (``ScenarioRequest.emit["paths"]``) that keeps sweep traffic
+        objective-sized."""
+        needs_alive, _ = REDUCTIONS[self.reduction]
+        paths = []
+        if self.path:
+            paths.append(SEP.join(self.path))
+        if needs_alive and "alive" not in paths:
+            paths.append("alive")
+        return paths
+
+    # -- evaluation ----------------------------------------------------------
+
+    def value(
+        self, timeseries: Mapping, up_to_time: Optional[float] = None
+    ) -> float:
+        """The objective scalar, optionally truncated to emits with
+        ``time <= up_to_time`` — how halving scores a still-running
+        trial at a rung horizon from its streamed prefix."""
+        needs_alive, _ = REDUCTIONS[self.reduction]
+        times = _times_of(timeseries)
+        if up_to_time is not None:
+            if times is None:
+                raise ValueError(
+                    "up_to_time needs a __times__/__time__ key in the "
+                    "trajectory"
+                )
+            keep = times <= float(up_to_time) * (1.0 + 1e-9)
+            n = int(np.count_nonzero(keep))
+        else:
+            n = None  # all rows
+
+        def rows(leaf) -> np.ndarray:
+            arr = np.asarray(leaf)
+            return arr if n is None else arr[:n]
+
+        if self.reduction == "final_alive_count":
+            alive = rows(timeseries["alive"])
+            self._require_rows(alive)
+            return float(np.asarray(alive[-1], dtype=np.float64).sum())
+
+        leaf = rows(get_path(timeseries, self.path))
+        self._require_rows(leaf)
+        if self.reduction in ("mean", "max", "min"):
+            return float(getattr(np, self.reduction)(leaf))
+        last = leaf[-1]
+        if needs_alive:
+            alive = np.asarray(rows(timeseries["alive"])[-1], bool)
+            # alive is [rows]; broadcast across any trailing leaf axes
+            mask = alive.reshape(
+                alive.shape + (1,) * (last.ndim - alive.ndim)
+            )
+            masked = np.where(mask, last, 0.0)
+            if self.reduction == "final_live_sum":
+                return float(masked.sum())
+            live = max(int(alive.sum()), 1) * max(
+                int(np.prod(last.shape[alive.ndim:], dtype=int)), 1
+            )
+            return float(masked.sum() / live)
+        if self.reduction == "final_sum":
+            return float(np.asarray(last, dtype=np.float64).sum())
+        return float(np.asarray(last, dtype=np.float64).mean())
+
+    @staticmethod
+    def _require_rows(arr: np.ndarray) -> None:
+        if arr.shape[0] == 0:
+            raise ValueError(
+                "trajectory has no emitted rows in range — horizon "
+                "shorter than one emit interval, or truncation before "
+                "the first emit"
+            )
+
+    # -- comparison ----------------------------------------------------------
+
+    def better(self, a: float, b: float) -> bool:
+        """True when ``a`` beats ``b`` under this objective's mode."""
+        return a > b if self.mode == "max" else a < b
+
+    def rank(self, values: Mapping[int, float]) -> List[int]:
+        """Trial indices best-first; ties break toward the LOWER trial
+        index so rankings (and halving cuts) are deterministic."""
+        sign = -1.0 if self.mode == "max" else 1.0
+        return sorted(values, key=lambda i: (sign * values[i], i))

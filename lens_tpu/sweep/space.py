@@ -1,0 +1,261 @@
+"""Search spaces: a declarative spec -> a deterministic trial list.
+
+The reference ran a parameter study as one submitted experiment cluster
+per parameter point (SURVEY.md §3.3); the sweep subsystem's first job is
+to make the *trial set itself* a pure function of the spec, so every
+layer above it (driver scheduling, the crash-resume ledger, analysis)
+can identify a trial by its index alone:
+
+- trial parameters AND the per-trial simulation seed are derived
+  deterministically from ``(sweep_seed, trial_index)`` via
+  ``np.random.SeedSequence`` — the same spec + seed always enumerates
+  the same trials, on any host, in any order, resumed or not;
+- for the random space each trial's draw depends ONLY on its own
+  ``(sweep_seed, index)`` pair, so growing ``n_trials`` extends the
+  trial list without disturbing existing trials (the resume ledger
+  stays valid under a widened sweep);
+- the Latin hypercube is a whole-design object (its stratification
+  couples trials by construction), so its generator is seeded from
+  ``(sweep_seed, n_trials)`` — same spec, same design.
+
+A trial's ``params`` map ``/``-joined schema-variable paths to values;
+``overrides()`` nests them into the tree shape shared by
+``Colony.initial_state(overrides=...)``, ``Ensemble.initial_state(
+replicate_overrides=...)`` (via :func:`stack_overrides`) and serve's
+``ScenarioRequest.overrides`` — one override language across the
+one-shot, dense-grid, and served paths.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Tuple
+
+import numpy as np
+
+from lens_tpu.emit.log import SEP
+from lens_tpu.utils.dicts import set_path
+
+#: Spec keys recognized per parameter.
+_GRID_KEYS = {"grid"}
+_DIST_KEYS = {"low", "high", "scale"}
+
+
+def trial_seed(sweep_seed: int, index: int) -> int:
+    """The per-trial simulation seed: one 31-bit word from the
+    ``(sweep_seed, trial_index)`` SeedSequence. Positive so it survives
+    JSON/CLI round-trips that assume ordinary ints."""
+    word = np.random.SeedSequence(
+        [int(sweep_seed), int(index)]
+    ).generate_state(1)[0]
+    return int(word) & 0x7FFFFFFF
+
+
+@dataclass(frozen=True)
+class Trial:
+    """One point of a sweep: immutable, identified by ``index``."""
+
+    index: int
+    seed: int
+    params: Mapping[str, Any] = field(default_factory=dict)
+
+    def overrides(self) -> Dict[str, Any]:
+        """The nested override tree (``a/b`` keys split on the emit-log
+        separator) the sim layers consume."""
+        tree: Dict[str, Any] = {}
+        for joined, value in self.params.items():
+            tree = set_path(tree, tuple(str(joined).split(SEP)), value)
+        return tree
+
+
+def _scaled(u: np.ndarray | float, low: float, high: float, scale: str):
+    if scale == "linear":
+        return low + u * (high - low)
+    if scale == "log":
+        if low <= 0 or high <= 0:
+            raise ValueError(
+                f"log scale needs positive bounds, got [{low}, {high}]"
+            )
+        return float(np.exp(np.log(low) + u * (np.log(high) - np.log(low))))
+    raise ValueError(f"unknown scale {scale!r}; known: linear, log")
+
+
+def _check_bounds(path: str, spec: Mapping) -> Tuple[float, float, str]:
+    try:
+        low, high = float(spec["low"]), float(spec["high"])
+    except KeyError as e:
+        raise ValueError(
+            f"parameter {path!r} needs low/high bounds, got {dict(spec)}"
+        ) from e
+    if not high > low:
+        raise ValueError(
+            f"parameter {path!r}: high ({high}) must exceed low ({low})"
+        )
+    return low, high, str(spec.get("scale", "linear"))
+
+
+class GridSpace:
+    """The explicit cartesian grid: every combination of every
+    parameter's listed values, enumerated row-major in parameter
+    insertion order (first parameter slowest). ``n_trials`` is the
+    product — dense and finite, the shape the direct-ensemble backend
+    eats whole."""
+
+    kind = "grid"
+
+    def __init__(self, params: Mapping[str, Mapping]):
+        if not params:
+            raise ValueError("grid space needs at least one parameter")
+        self.axes: Dict[str, List[Any]] = {}
+        for path, spec in params.items():
+            values = spec.get("grid") if isinstance(spec, Mapping) else spec
+            if values is None or not len(values):
+                raise ValueError(
+                    f"grid parameter {path!r} needs a non-empty "
+                    f"'grid' list, got {spec!r}"
+                )
+            self.axes[str(path)] = [
+                v if isinstance(v, (int, float)) else float(v)
+                for v in values
+            ]
+        self.n_trials = math.prod(len(v) for v in self.axes.values())
+
+    def trials(self, sweep_seed: int) -> List[Trial]:
+        out = []
+        for i, combo in enumerate(
+            itertools.product(*self.axes.values())
+        ):
+            params = dict(zip(self.axes.keys(), combo))
+            out.append(
+                Trial(index=i, seed=trial_seed(sweep_seed, i), params=params)
+            )
+        return out
+
+
+class RandomSpace:
+    """Independent log/linear-uniform draws per trial. Each trial's
+    parameter vector comes from the ``(sweep_seed, trial_index)``
+    stream alone, so trial ``i`` is the same whether the sweep asks for
+    8 trials or 800."""
+
+    kind = "random"
+
+    def __init__(self, params: Mapping[str, Mapping], n_trials: int):
+        if n_trials < 1:
+            raise ValueError(f"n_trials={n_trials} must be >= 1")
+        self.bounds = {
+            str(p): _check_bounds(str(p), spec)
+            for p, spec in params.items()
+        }
+        self.n_trials = int(n_trials)
+
+    def trials(self, sweep_seed: int) -> List[Trial]:
+        out = []
+        for i in range(self.n_trials):
+            # sub-stream 1: parameter draws; the bare (seed, i) stream
+            # is the sim seed (trial_seed) — kept disjoint so adding a
+            # parameter never perturbs the sim seeds
+            rng = np.random.default_rng(
+                np.random.SeedSequence([int(sweep_seed), i, 1])
+            )
+            params = {
+                p: _scaled(float(rng.random()), lo, hi, scale)
+                for p, (lo, hi, scale) in self.bounds.items()
+            }
+            out.append(
+                Trial(index=i, seed=trial_seed(sweep_seed, i), params=params)
+            )
+        return out
+
+
+class LatinHypercubeSpace:
+    """Latin hypercube: ``n_trials`` strata per dimension, one sample
+    per stratum per dimension, strata assigned by an independent
+    permutation per dimension — space-filling where pure random
+    clumps. The design is a whole-sweep object (the permutations
+    couple trials), so it is seeded from ``(sweep_seed, n_trials)``."""
+
+    kind = "lhs"
+
+    def __init__(self, params: Mapping[str, Mapping], n_trials: int):
+        if n_trials < 1:
+            raise ValueError(f"n_trials={n_trials} must be >= 1")
+        self.bounds = {
+            str(p): _check_bounds(str(p), spec)
+            for p, spec in params.items()
+        }
+        self.n_trials = int(n_trials)
+
+    def trials(self, sweep_seed: int) -> List[Trial]:
+        n = self.n_trials
+        rng = np.random.default_rng(
+            np.random.SeedSequence([int(sweep_seed), n, 2])
+        )
+        columns = {}
+        for p, (lo, hi, scale) in self.bounds.items():
+            strata = rng.permutation(n)
+            jitter = rng.random(n)
+            u = (strata + jitter) / n
+            columns[p] = [_scaled(float(x), lo, hi, scale) for x in u]
+        return [
+            Trial(
+                index=i,
+                seed=trial_seed(sweep_seed, i),
+                params={p: columns[p][i] for p in columns},
+            )
+            for i in range(n)
+        ]
+
+
+def space_from_spec(spec: Mapping[str, Any]):
+    """``{"kind": ..., "params": {...}, ["n_trials": N]}`` -> a space.
+
+    ``kind`` defaults to ``grid``. Grid specs take
+    ``{path: {"grid": [...]}}`` entries; random/lhs take
+    ``{path: {"low": a, "high": b, "scale": "linear"|"log"}}`` plus a
+    top-level ``n_trials``.
+    """
+    if not isinstance(spec, Mapping) or "params" not in spec:
+        raise ValueError(
+            f"space spec needs a 'params' mapping, got {spec!r}"
+        )
+    kind = str(spec.get("kind", "grid"))
+    params = spec["params"]
+    if kind == "grid":
+        return GridSpace(params)
+    n_trials = spec.get("n_trials")
+    if n_trials is None:
+        raise ValueError(f"{kind} space needs an explicit n_trials")
+    if kind == "random":
+        return RandomSpace(params, int(n_trials))
+    if kind == "lhs":
+        return LatinHypercubeSpace(params, int(n_trials))
+    raise ValueError(
+        f"unknown space kind {kind!r}; known: grid, random, lhs"
+    )
+
+
+def stack_overrides(trials: List[Trial]) -> Dict[str, Any]:
+    """Trials -> one ``replicate_overrides`` tree: each parameter
+    becomes a leaf with a leading ``[len(trials)]`` axis, in trial
+    order — the shape ``Ensemble.initial_state`` scans over. All trials
+    must share one parameter set (spaces guarantee it)."""
+    if not trials:
+        raise ValueError("no trials to stack")
+    paths = list(trials[0].params.keys())
+    for t in trials:
+        if list(t.params.keys()) != paths:
+            raise ValueError(
+                f"trial {t.index} has parameters "
+                f"{sorted(t.params)} != {sorted(paths)}"
+            )
+    tree: Dict[str, Any] = {}
+    for p in paths:
+        tree = set_path(
+            tree,
+            tuple(p.split(SEP)),
+            np.asarray([t.params[p] for t in trials]),
+        )
+    return tree

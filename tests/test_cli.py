@@ -1,0 +1,186 @@
+"""The ``python -m lens_tpu`` CLI: argument parsing + command smoke runs.
+
+The command surface is the repo's outermost contract (the reference's
+control/boot scripts, reconstructed SURVEY.md §3.1) and was previously
+untested end to end. Parsing tests are jax-free and instant; the smoke
+runs drive the real ``main()`` on the tiniest composites — ``run``,
+``serve``, and ``sweep`` each produce their documented artifacts.
+"""
+
+import json
+import os
+
+import pytest
+
+from lens_tpu.__main__ import _build_parser, _validate_run_args, main
+
+
+class TestParsing:
+    def test_run_defaults_and_overrides(self):
+        args = _build_parser().parse_args(
+            ["run", "--composite", "toggle_colony", "--time", "50",
+             "--n-agents", "3", "--emitter", "log",
+             "--out-dir", "out/x"]
+        )
+        assert args.command == "run"
+        assert args.composite == "toggle_colony"
+        assert args.time == 50.0
+        assert args.n_agents == 3
+        assert args.emitter == "log"
+
+    def test_run_n_agents_accepts_per_species_json(self):
+        args = _build_parser().parse_args(
+            ["run", "--n-agents", '{"ecoli": 4, "scavenger": 2}']
+        )
+        assert args.n_agents == {"ecoli": 4, "scavenger": 2}
+
+    def test_run_mesh_spec(self):
+        args = _build_parser().parse_args(["run", "--mesh", "4x2"])
+        assert args.mesh == {"agents": 4, "space": 2}
+        with pytest.raises(SystemExit):
+            _build_parser().parse_args(["run", "--mesh", "axb"])
+
+    def test_validate_rejects_bad_flag_combinations(self):
+        # auto-expand without segments would silently do nothing
+        args = _build_parser().parse_args(
+            ["run", "--auto-expand", "0.3"]
+        )
+        with pytest.raises(SystemExit, match="checkpoint-every"):
+            _validate_run_args(args)
+        # replicate-overrides needs the scan axis
+        args = _build_parser().parse_args(
+            ["run", "--replicate-overrides", '{"global": {"volume": [1]}}']
+        )
+        with pytest.raises(SystemExit, match="--replicates"):
+            _validate_run_args(args)
+        args = _build_parser().parse_args(
+            ["run", "--replicates", "2", "--replicate-overrides", "not json"]
+        )
+        with pytest.raises(SystemExit, match="not valid JSON"):
+            _validate_run_args(args)
+
+    def test_serve_args(self):
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "reqs.json", "--lanes", "8",
+             "--window", "16", "--queue-depth", "7"]
+        )
+        assert args.command == "serve"
+        assert (args.lanes, args.window, args.queue_depth) == (8, 16, 7)
+        with pytest.raises(SystemExit):  # --requests is required
+            _build_parser().parse_args(["serve"])
+
+    def test_sweep_args(self):
+        args = _build_parser().parse_args(
+            ["sweep", "--spec", "sweep.json", "--out-dir", "out/s",
+             "--resume", "--save-trajectories"]
+        )
+        assert args.command == "sweep"
+        assert args.spec == "sweep.json"
+        assert args.resume and args.save_trajectories
+        with pytest.raises(SystemExit):  # --spec is required
+            _build_parser().parse_args(["sweep"])
+
+    def test_unknown_command_rejected(self):
+        with pytest.raises(SystemExit):
+            _build_parser().parse_args(["deploy"])
+
+
+class TestListCommand:
+    def test_lists_registries(self, capsys):
+        assert main(["list"]) == 0
+        out = capsys.readouterr().out
+        assert "toggle_colony" in out
+        assert "log" in out
+
+
+class TestRunCommand:
+    def test_run_smoke_writes_emit_log(self, tmp_path, capsys):
+        out = str(tmp_path / "exp")
+        rc = main([
+            "run", "--composite", "minimal_ode", "--time", "4",
+            "--capacity", "4", "--emitter", "log", "--out-dir", out,
+            "--quiet",
+        ])
+        assert rc == 0
+        assert "done:" in capsys.readouterr().out
+        assert os.path.exists(os.path.join(out, "emit.lens"))
+
+
+class TestServeCommand:
+    def test_serve_smoke_writes_results_and_meta(self, tmp_path, capsys):
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps([
+            {"seed": 1, "horizon": 8.0},
+            {"seed": 2, "horizon": 16.0,
+             "emit": {"paths": ["alive"]}},
+        ]))
+        out = str(tmp_path / "served")
+        rc = main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4",
+            "--requests", str(reqs), "--out-dir", out,
+        ])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "served 2 requests" in printed
+        assert "done=2" in printed
+        assert os.path.exists(os.path.join(out, "server_meta.json"))
+        lens = [f for f in os.listdir(out) if f.endswith(".lens")]
+        assert len(lens) == 2
+
+
+class TestSweepCommand:
+    def _spec(self, tmp_path):
+        spec = {
+            "composite": "minimal_ode",
+            "space": {"kind": "grid", "params": {
+                "environment/glucose_external": {"grid": [0.5, 1.0, 2.0]},
+            }},
+            "horizon": 8.0,
+            "objective": {"path": "cell/glucose_internal",
+                          "reduction": "final_live_sum", "mode": "max"},
+            "capacity": 4,
+            "backend": {"kind": "server", "lanes": 2, "window": 4},
+        }
+        path = tmp_path / "sweep.json"
+        path.write_text(json.dumps(spec))
+        return str(path)
+
+    def test_sweep_smoke_writes_table_and_ledger(self, tmp_path, capsys):
+        out = str(tmp_path / "sweep")
+        rc = main([
+            "sweep", "--spec", self._spec(tmp_path), "--out-dir", out,
+        ])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "sweep: 3 trials (done=3)" in printed
+        assert "best: trial 2" in printed
+        with open(os.path.join(out, "sweep_result.json")) as f:
+            table = json.load(f)
+        assert len(table["table"]) == 3
+        assert table["best"]["trial"] == 2
+        assert os.path.exists(os.path.join(out, "sweep.ledger"))
+        # a complete sweep resumes as a no-op, same exit code
+        rc = main([
+            "sweep", "--spec", self._spec(tmp_path), "--out-dir", out,
+            "--resume", "--quiet",
+        ])
+        assert rc == 0
+        assert "done=3" in capsys.readouterr().out
+
+    def test_sweep_save_trajectories_needs_out_dir(self, tmp_path):
+        with pytest.raises(SystemExit, match="out-dir"):
+            main(["sweep", "--spec", self._spec(tmp_path),
+                  "--save-trajectories"])
+
+    def test_sweep_resume_needs_out_dir(self, tmp_path):
+        """--resume without the ledger directory must refuse, not
+        silently re-run everything against an in-memory ledger."""
+        with pytest.raises(SystemExit, match="out-dir"):
+            main(["sweep", "--spec", self._spec(tmp_path), "--resume"])
+
+    def test_sweep_rejects_non_object_spec(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("[1, 2, 3]")
+        with pytest.raises(SystemExit, match="JSON object"):
+            main(["sweep", "--spec", str(bad)])

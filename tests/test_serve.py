@@ -284,6 +284,83 @@ class TestCoBatchingDeterminism:
         assert np.asarray(solo["global"]["volume"])[:, 0].max() >= 1.3
 
 
+class TestHoldStateResubmit:
+    """Extension contract: a hold_state request resubmitted K times is
+    bitwise ONE request with the summed horizon — the mechanism sweep
+    successive-halving rungs ride (survivors extend, never rerun)."""
+
+    def _stitch(self, parts):
+        return jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+            *parts,
+        )
+
+    def test_resubmit_chain_is_bitwise_one_long_request(self):
+        srv = SimServer.single_bucket(
+            "hybrid_cell", lanes=4, window=8, capacity=16
+        )
+        # one-shot reference and the chain's first leg share the server
+        # (and a seed): same bits per the co-batching contract
+        one_shot = srv.submit(ScenarioRequest(
+            composite="hybrid_cell", seed=3, horizon=24.0
+        ))
+        rid = srv.submit(ScenarioRequest(
+            composite="hybrid_cell", seed=3, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=200)
+        parts = [srv.result(rid)]
+        for _ in range(2):
+            rid = srv.resubmit(rid, extra_horizon=8.0)
+            srv.run_until_idle(max_ticks=200)
+            parts.append(srv.result(rid))
+        assert srv.status(rid)["parent"] is not None
+        assert srv.metrics()["counters"]["resubmitted"] == 2
+        chained = self._stitch(parts)
+        ref = srv.result(one_shot)
+        np.testing.assert_array_equal(
+            chained["__times__"], ref["__times__"]
+        )
+        assert _leaves_equal(chained, ref)
+        srv.close()
+
+    def test_resubmit_validates_and_is_exactly_once(self):
+        srv = _toggle_server(lanes=2)
+        plain = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        held = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=8.0,
+            hold_state=True,
+        ))
+        with pytest.raises(ValueError, match="only DONE"):
+            srv.resubmit(held, 8.0)
+        srv.run_until_idle(max_ticks=100)
+        with pytest.raises(ValueError, match="no final state"):
+            srv.resubmit(plain, 8.0)  # not submitted with hold_state
+        with pytest.raises(ValueError, match="not a positive multiple"):
+            srv.resubmit(held, 0.25)  # off the step grid
+        cont = srv.resubmit(held, 8.0)
+        with pytest.raises(ValueError, match="no final state"):
+            srv.resubmit(held, 8.0)  # held state consumed exactly once
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(cont)["status"] == DONE
+        assert srv.status(cont)["steps_done"] == 16
+        srv.close()
+
+    def test_release_state_drops_held_state(self):
+        srv = _toggle_server(lanes=2)
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.release_state(rid)  # the halving-loser path
+        with pytest.raises(ValueError, match="no final state"):
+            srv.resubmit(rid, 8.0)
+        srv.close()
+
+
 class TestMultiSpeciesBucket:
     def test_default_n_agents_fans_out_per_species(self):
         """A multi-species bucket must serve requests that omit
@@ -328,10 +405,10 @@ class TestBackpressureAndLifecycle:
                                 horizon=8.0)
             )
         assert exc.value.retry_after > 0
-        assert srv.metrics.counters["rejected"] == 1
+        assert srv.metrics()["counters"]["rejected"] == 1
         # the backlog still drains normally after the reject
         srv.run_until_idle(max_ticks=100)
-        assert srv.metrics.counters["retired"] == 2
+        assert srv.metrics()["counters"]["retired"] == 2
         srv.close()
 
     def test_submit_validates(self):
@@ -375,7 +452,7 @@ class TestBackpressureAndLifecycle:
         srv.run_until_idle(max_ticks=100)
         assert srv.status(long)["status"] == DONE
         assert srv.status(doomed)["status"] == TIMEOUT
-        assert srv.metrics.counters["timeouts"] == 1
+        assert srv.metrics()["counters"]["timeouts"] == 1
         with pytest.raises(ValueError, match="never admitted"):
             srv.result(doomed)
         srv.close()
@@ -391,7 +468,7 @@ class TestBackpressureAndLifecycle:
         time.sleep(0.35)
         srv.tick()  # expiry sweep reclaims the lane
         assert srv.status(rid)["status"] == TIMEOUT
-        assert srv.metrics.lanes_busy == 0
+        assert srv.metrics()["lanes_busy"] == 0
         partial = srv.result(rid)
         assert 0 < len(partial["__times__"]) < 400
         # the freed lane serves the next request normally
@@ -419,8 +496,9 @@ class TestBackpressureAndLifecycle:
         srv.cancel(running)
         srv.tick()
         assert srv.status(running)["status"] == CANCELLED
-        assert srv.metrics.lanes_busy == 0
-        assert srv.metrics.counters["cancelled"] == 2
+        snap = srv.metrics()
+        assert snap["lanes_busy"] == 0
+        assert snap["counters"]["cancelled"] == 2
         srv.close()
 
 
@@ -463,13 +541,22 @@ class TestEmitSpecAndMetrics:
                                 horizon=16.0)
             )
         srv.run_until_idle(max_ticks=100)
-        c = srv.metrics.counters
+        snap = srv.metrics()
+        c = snap["counters"]
         assert c["submitted"] == c["admitted"] == c["retired"] == n
         assert c["lane_windows_busy"] <= c["lane_windows_total"]
-        assert srv.metrics.occupancy() > 0
-        assert srv.metrics.retraces == 0
-        snap = srv.metrics.snapshot()
+        assert snap["occupancy"] > 0
+        assert snap["retraces"] == 0
         assert snap["latency_seconds"]["p50"] is not None
+        # status() surfaces the same live gauges per request
+        rid = srv.submit(
+            ScenarioRequest(composite="toggle_colony", seed=77,
+                            horizon=16.0)
+        )
+        gauges = srv.status(rid)["server"]
+        assert gauges["queue_depth"] == 1  # not yet ticked into a lane
+        assert gauges["lanes_total"] == 2
+        srv.run_until_idle(max_ticks=100)
         srv.close()
 
     def test_server_meta_sidecar(self, tmp_path):
@@ -573,7 +660,7 @@ class TestServeSoak:
         ]
         ids = {}
         i = 0
-        while i < len(pending) or len(srv.queue) or srv.metrics.lanes_busy:
+        while i < len(pending) or len(srv.queue) or srv.metrics()["lanes_busy"]:
             while i < len(pending):
                 try:
                     ids[i] = srv.submit(pending[i])
@@ -582,11 +669,12 @@ class TestServeSoak:
                 i += 1
             srv.tick()
         srv.run_until_idle(max_ticks=1000)
-        c = srv.metrics.counters
+        snap = srv.metrics()
+        c = snap["counters"]
         assert len(ids) == n
         assert c["retired"] == c["admitted"] == n
         assert c["rejected"] >= 1  # the bounded queue really pushed back
-        assert srv.metrics.retraces == 0
+        assert snap["retraces"] == 0
         for probe in (0, 137, 299):
             st = srv.status(ids[probe])
             assert st["status"] == DONE

@@ -1,0 +1,646 @@
+"""The sweep subsystem: spaces, objectives, ledger, driver backends.
+
+The load-bearing contracts, in this repo's bitwise culture:
+
+- same spec + sweep seed => the SAME trial list (params and per-trial
+  PRNG seeds), on any host, resumed or not;
+- a server-backend trial's trajectory/objective is BITWISE what a solo
+  serve request with the same seed/overrides produces (inherited from
+  serve's co-batching determinism);
+- a killed sweep resumes from the ledger, re-runs ONLY unfinished
+  trials, and its final table is bitwise identical to an uninterrupted
+  run's;
+- successive halving finds the same top trial as exhaustive
+  full-horizon evaluation on a monotone objective, with survivors
+  EXTENDED through serve's hold_state/resubmit (never rerun).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lens_tpu.sweep import (
+    GridSpace,
+    LatinHypercubeSpace,
+    MemoryLedger,
+    Objective,
+    RandomSpace,
+    TrialLedger,
+    run_sweep,
+    rung_steps,
+    space_from_spec,
+    spec_fingerprint,
+    stack_overrides,
+    trial_seed,
+)
+from lens_tpu.sweep.ledger import TRIAL_DONE
+
+#: Dose grid with a strictly monotone final-glucose-uptake response
+#: (verified by TestServerBackend.test_race_objectives_monotone).
+DOSES = [0.2, 0.5, 1.0, 2.0, 5.0]
+
+
+def _spec(**kw):
+    spec = {
+        "composite": "minimal_ode",
+        "space": {
+            "kind": "grid",
+            "params": {
+                "environment/glucose_external": {"grid": DOSES},
+            },
+        },
+        "horizon": 16.0,
+        "objective": {
+            "path": "cell/glucose_internal",
+            "reduction": "final_live_sum",
+            "mode": "max",
+        },
+        "capacity": 4,
+        "backend": {"kind": "server", "lanes": 2, "window": 4},
+    }
+    spec.update(kw)
+    return spec
+
+
+class _Kill(Exception):
+    """Stand-in for a mid-sweep crash in the resume tests."""
+
+
+def _killer_after(n):
+    count = [0]
+
+    def on_trial(index, event):
+        count[0] += 1
+        if count[0] >= n:
+            raise _Kill
+
+    return on_trial
+
+
+class TestSpaces:
+    def test_grid_enumerates_cartesian_product_in_order(self):
+        space = GridSpace({
+            "a/x": {"grid": [1.0, 2.0]},
+            "b": {"grid": [10.0, 20.0, 30.0]},
+        })
+        assert space.n_trials == 6
+        trials = space.trials(0)
+        assert [t.index for t in trials] == list(range(6))
+        # first param slowest, row-major
+        assert [t.params["a/x"] for t in trials] == [1, 1, 1, 2, 2, 2]
+        assert [t.params["b"] for t in trials] == [10, 20, 30] * 2
+        # override trees nest on the path separator
+        assert trials[0].overrides() == {"a": {"x": 1.0}, "b": 10.0}
+
+    def test_trials_are_deterministic_functions_of_seed(self):
+        spec = {"kind": "random", "n_trials": 6, "params": {
+            "p": {"low": 0.1, "high": 10.0, "scale": "log"},
+            "q": {"low": -1.0, "high": 1.0},
+        }}
+        a = space_from_spec(spec).trials(7)
+        b = space_from_spec(spec).trials(7)
+        assert a == b
+        c = space_from_spec(spec).trials(8)
+        assert a != c
+        # per-trial sim seeds come from (sweep_seed, index) alone
+        assert [t.seed for t in a] == [trial_seed(7, i) for i in range(6)]
+
+    def test_random_trial_i_stable_under_widening(self):
+        """Growing n_trials must EXTEND the trial list, not reshuffle it
+        (a widened sweep keeps its resume ledger valid)."""
+        spec = {"kind": "random", "params": {
+            "p": {"low": 0.1, "high": 10.0, "scale": "log"},
+        }}
+        small = space_from_spec({**spec, "n_trials": 4}).trials(3)
+        big = space_from_spec({**spec, "n_trials": 16}).trials(3)
+        assert big[:4] == small
+        for t in big:
+            assert 0.1 <= t.params["p"] <= 10.0
+
+    def test_lhs_stratifies_every_dimension(self):
+        n = 8
+        space = LatinHypercubeSpace(
+            {"p": {"low": 2.0, "high": 10.0},
+             "q": {"low": 1.0, "high": 100.0, "scale": "log"}},
+            n_trials=n,
+        )
+        trials = space.trials(5)
+        assert space.trials(5) == trials  # whole-design determinism
+        # invert each scale back to u in [0,1): exactly one sample per
+        # stratum [k/n, (k+1)/n) per dimension
+        u_p = [(t.params["p"] - 2.0) / 8.0 for t in trials]
+        u_q = [
+            np.log(t.params["q"] / 1.0) / np.log(100.0) for t in trials
+        ]
+        for u in (u_p, u_q):
+            assert sorted(int(x * n) for x in u) == list(range(n))
+
+    def test_stack_overrides_shapes(self):
+        trials = GridSpace(
+            {"a/x": {"grid": [1.0, 2.0, 3.0]}}
+        ).trials(0)
+        tree = stack_overrides(trials)
+        np.testing.assert_array_equal(tree["a"]["x"], [1.0, 2.0, 3.0])
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="params"):
+            space_from_spec({"kind": "grid"})
+        with pytest.raises(ValueError, match="n_trials"):
+            space_from_spec({"kind": "random", "params": {
+                "p": {"low": 0, "high": 1}}})
+        with pytest.raises(ValueError, match="unknown space kind"):
+            space_from_spec({"kind": "bayes", "params": {}, "n_trials": 1})
+        with pytest.raises(ValueError, match="positive bounds"):
+            RandomSpace(
+                {"p": {"low": -1.0, "high": 1.0, "scale": "log"}}, 2
+            ).trials(0)
+        with pytest.raises(ValueError, match="must exceed"):
+            RandomSpace({"p": {"low": 2.0, "high": 1.0}}, 2)
+        with pytest.raises(ValueError, match="non-empty"):
+            GridSpace({"p": {"grid": []}})
+
+
+class TestObjective:
+    TS = {
+        "alive": np.array([[1, 1, 0], [1, 0, 0]], bool),
+        "x": np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]),
+        "__times__": np.array([1.0, 2.0]),
+    }
+
+    @pytest.mark.parametrize("reduction,expected", [
+        ("final_live_sum", 4.0),
+        ("final_live_mean", 4.0),
+        ("final_sum", 15.0),
+        ("final_mean", 5.0),
+        ("mean", 3.5),
+        ("max", 6.0),
+        ("min", 1.0),
+        ("final_alive_count", 1.0),
+    ])
+    def test_reductions(self, reduction, expected):
+        assert Objective("x", reduction).value(self.TS) == expected
+
+    def test_truncation_scores_a_prefix(self):
+        """up_to_time is how halving scores a rung from a partial
+        stream: only emits at time <= the rung horizon count."""
+        obj = Objective("x", "final_live_sum")
+        assert obj.value(self.TS, up_to_time=1.0) == 3.0  # 1 + 2
+        assert obj.value(self.TS, up_to_time=5.0) == 4.0
+        with pytest.raises(ValueError, match="no emitted rows"):
+            obj.value(self.TS, up_to_time=0.5)
+
+    def test_emit_paths_cover_exactly_what_the_reduction_reads(self):
+        assert Objective("a/b", "final_live_sum").emit_paths() == [
+            "a/b", "alive",
+        ]
+        assert Objective("a/b", "final_sum").emit_paths() == ["a/b"]
+        assert Objective(
+            "alive", "final_alive_count"
+        ).emit_paths() == ["alive"]
+
+    def test_rank_modes_and_deterministic_ties(self):
+        values = {0: 2.0, 1: 5.0, 2: 5.0, 3: 1.0}
+        assert Objective("x", mode="max").rank(values) == [1, 2, 0, 3]
+        assert Objective("x", mode="min").rank(values) == [3, 0, 1, 2]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown reduction"):
+            Objective("x", "median")
+        with pytest.raises(ValueError, match="unknown mode"):
+            Objective("x", mode="argmax")
+        with pytest.raises(ValueError, match="'path'"):
+            Objective.from_spec({"reduction": "mean"})
+
+
+class TestLedger:
+    def test_replay_roundtrip(self, tmp_path):
+        p = str(tmp_path / "sweep.ledger")
+        with TrialLedger(p) as led:
+            led.begin("fp1", {"n_trials": 3})
+            led.append({"event": "trial_rung", "trial": 0, "rung": 0,
+                        "objective": 1.5})
+            led.append({"event": "trial_stopped", "trial": 1, "rung": 0,
+                        "objective": 0.5})
+            led.append({"event": TRIAL_DONE, "trial": 0,
+                        "objective": 2.5, "status": "done"})
+        replayed = TrialLedger(p)
+        assert replayed.meta["fingerprint"] == "fp1"
+        assert replayed.rungs == {0: {0: 1.5}}
+        assert set(replayed.stopped) == {1}
+        assert replayed.done[0]["objective"] == 2.5
+        assert replayed.terminal(0) and replayed.terminal(1)
+        assert not replayed.terminal(2)
+        replayed.close()
+
+    def test_torn_tail_frame_is_dropped_and_truncated(self, tmp_path):
+        p = str(tmp_path / "sweep.ledger")
+        with TrialLedger(p) as led:
+            led.begin("fp1", {})
+            led.append({"event": TRIAL_DONE, "trial": 0,
+                        "objective": 1.0, "status": "done"})
+        size = os.path.getsize(p)
+        with open(p, "ab") as f:  # a kill mid-append: torn tail frame
+            from lens_tpu.emit.log import frame
+
+            f.write(frame(b'{"event": "trial_done", "trial": 1}')[:-3])
+        replayed = TrialLedger(p)
+        assert set(replayed.done) == {0}  # tail dropped, prefix intact
+        # reopening TRUNCATED the torn bytes, so appends from the
+        # resumed run land on a clean frame boundary — a SECOND replay
+        # must read everything (a raw append-after-torn-tail would CRC-
+        # poison every event the resume wrote)
+        assert os.path.getsize(p) == size
+        replayed.append({"event": TRIAL_DONE, "trial": 2,
+                         "objective": 2.0, "status": "done"})
+        replayed.close()
+        again = TrialLedger(p)
+        assert set(again.done) == {0, 2}
+        again.close()
+
+    def test_fingerprint_guard_refuses_a_changed_spec(self, tmp_path):
+        p = str(tmp_path / "sweep.ledger")
+        with TrialLedger(p) as led:
+            led.begin("fp1", {})
+        led = TrialLedger(p)
+        led.begin("fp1", {})  # same sweep: fine
+        with pytest.raises(ValueError, match="fingerprint"):
+            led.begin("fp2", {})
+        led.close()
+        assert spec_fingerprint({"a": 1}) != spec_fingerprint({"a": 2})
+
+    def test_memory_ledger_same_interface(self):
+        led = MemoryLedger()
+        led.begin("fp", {})
+        led.append({"event": TRIAL_DONE, "trial": 4, "objective": 1.0,
+                    "status": "done"})
+        assert led.terminal(4) and not led.terminal(0)
+        led.close()
+
+
+class TestRungSteps:
+    def test_geometric_snapped_capped(self):
+        assert rung_steps(4, 2, 16, 1) == [4, 8, 16]
+        # snapping UP to the emit grid, dedup, final always max_steps
+        assert rung_steps(3, 2, 24, 4) == [4, 8, 12, 24]
+        assert rung_steps(20, 3, 16, 1) == [16]
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="eta"):
+            rung_steps(4, 1, 16, 1)
+        with pytest.raises(ValueError, match="min_horizon"):
+            rung_steps(0, 2, 16, 1)
+
+
+class TestServerBackend:
+    def test_race_objectives_monotone_and_best(self, tmp_path):
+        res = run_sweep(_spec(), out_dir=str(tmp_path / "s"))
+        assert [r["status"] for r in res.table] == ["done"] * len(DOSES)
+        objs = [r["objective"] for r in res.table]
+        assert all(np.diff(objs) > 0), objs  # monotone in dose
+        assert res.best["trial"] == len(DOSES) - 1
+        assert res.metrics["server"]["counters"]["retired"] >= len(DOSES)
+        # the table landed on disk, atomically
+        table_path = str(tmp_path / "s" / "sweep_result.json")
+        assert res.path == table_path
+        with open(table_path) as f:
+            assert len(json.load(f)["table"]) == len(DOSES)
+        assert not os.path.exists(table_path + ".tmp")
+
+    def test_trial_bitwise_equals_solo_serve_request(self):
+        """THE determinism contract: a sweep trial's trajectory is the
+        solo request's bits — scheduling (and the sweep around it)
+        changed nothing."""
+        from lens_tpu.serve import ScenarioRequest, SimServer
+
+        spec = _spec()
+        server = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4
+        )
+        res = run_sweep(spec, server=server)
+        target = space_from_spec(spec["space"]).trials(0)[2]
+        rid = server.submit(ScenarioRequest(
+            composite="minimal_ode",
+            seed=target.seed,
+            horizon=spec["horizon"],
+            overrides=target.overrides(),
+            emit={"paths": ["cell/glucose_internal", "alive"]},
+        ))
+        server.run_until_idle(max_ticks=200)
+        solo = server.result(rid)
+        swept = res.timeseries[2]
+        np.testing.assert_array_equal(
+            solo["__times__"], swept["__times__"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(solo["cell"]["glucose_internal"]),
+            np.asarray(swept["cell"]["glucose_internal"]),
+        )
+        server.close()
+
+    def test_emit_spec_streams_only_objective_paths(self):
+        res = run_sweep(_spec())
+        ts = res.timeseries[0]
+        leaves = {k for k in ts if k != "__times__"}
+        assert leaves == {"cell", "alive"}
+        assert set(ts["cell"]) == {"glucose_internal"}
+
+    def test_kill_and_resume_reruns_only_unfinished(self, tmp_path):
+        full = run_sweep(_spec(), out_dir=str(tmp_path / "full"))
+        kill_dir = str(tmp_path / "killed")
+        with pytest.raises(_Kill):
+            run_sweep(_spec(), out_dir=kill_dir,
+                      on_trial=_killer_after(2))
+        resumed = run_sweep(_spec(), out_dir=kill_dir, resume=True)
+        # only the 3 unfinished trials were re-simulated
+        assert resumed.metrics["server"]["counters"]["submitted"] == 3
+        for a, b in zip(full.table, resumed.table):
+            assert a["status"] == b["status"]
+            assert a["objective"] == b["objective"]  # bitwise
+
+    def test_resume_guards(self, tmp_path):
+        out = str(tmp_path / "s")
+        run_sweep(_spec(), out_dir=out)
+        with pytest.raises(ValueError, match="resume=True"):
+            run_sweep(_spec(), out_dir=out)  # refuse silent reuse
+        with pytest.raises(ValueError, match="fingerprint"):
+            run_sweep(_spec(seed=1), out_dir=out, resume=True)
+        # resume of a COMPLETE sweep re-runs nothing
+        res = run_sweep(_spec(), out_dir=out, resume=True)
+        assert res.metrics["server"]["counters"]["submitted"] == 0
+
+    def test_fingerprint_is_param_order_sensitive(self, tmp_path):
+        """Trial enumeration follows params insertion order (grid
+        product order, per-param draw order), so a spec with the SAME
+        params merely re-keyed in another order is a different sweep —
+        sort_keys canonicalization must not launder it through the
+        resume guard."""
+        params = {
+            "environment/glucose_external": {"grid": [0.5, 1.0]},
+            "cell/glucose_internal": {"grid": [0.0, 0.1]},
+        }
+        reordered = dict(reversed(list(params.items())))
+        spec_a = _spec(space={"kind": "grid", "params": params})
+        spec_b = _spec(space={"kind": "grid", "params": reordered})
+        out = str(tmp_path / "s")
+        run_sweep(spec_a, out_dir=out)
+        with pytest.raises(ValueError, match="fingerprint"):
+            run_sweep(spec_b, out_dir=out, resume=True)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="unknown sweep spec keys"):
+            run_sweep(_spec(horizons=3.0))
+        with pytest.raises(ValueError, match="missing"):
+            run_sweep({"composite": "minimal_ode"})
+        with pytest.raises(ValueError, match="unknown backend kind"):
+            run_sweep(_spec(backend={"kind": "slurm"}))
+
+
+class TestEnsembleBackend:
+    def test_matches_server_backend_ranking(self):
+        server = run_sweep(_spec())
+        ens = run_sweep(_spec(backend={"kind": "ensemble"}))
+        s_obj = [r["objective"] for r in server.table]
+        e_obj = [r["objective"] for r in ens.table]
+        # same physics modulo vmap-vs-solo op fusion (last-ulp); the
+        # ranking — what a search consumes — is identical
+        np.testing.assert_allclose(e_obj, s_obj, rtol=1e-5)
+        assert ens.best["trial"] == server.best["trial"]
+        assert ens.metrics["backend"] == "ensemble"
+
+    def test_chunked_run_is_reproducible_and_chunk_invariant(self):
+        a = run_sweep(_spec(backend={"kind": "ensemble", "batch": 2}))
+        b = run_sweep(_spec(backend={"kind": "ensemble", "batch": 2}))
+        objs = lambda r: [row["objective"] for row in r.table]
+        assert objs(a) == objs(b)  # bitwise run-to-run
+        c = run_sweep(_spec(backend={"kind": "ensemble", "batch": 5}))
+        np.testing.assert_allclose(objs(c), objs(a), rtol=1e-5)
+
+    def test_kill_and_resume_mid_chunk_bitwise(self, tmp_path):
+        spec = _spec(backend={"kind": "ensemble", "batch": 2})
+        full = run_sweep(spec, out_dir=str(tmp_path / "full"))
+        kill_dir = str(tmp_path / "killed")
+        with pytest.raises(_Kill):
+            run_sweep(spec, out_dir=kill_dir, on_trial=_killer_after(3))
+        resumed = run_sweep(spec, out_dir=kill_dir, resume=True)
+        # the partially-recorded chunk re-ran WHOLE (same composition),
+        # so every objective is bitwise the uninterrupted run's
+        assert [r["objective"] for r in resumed.table] == [
+            r["objective"] for r in full.table
+        ]
+        # fully-done chunks were skipped: only chunks 2 and 3 re-ran
+        assert resumed.metrics["chunks_run"] == 2
+
+    def test_asha_is_server_only(self):
+        with pytest.raises(ValueError, match="no early stopping"):
+            run_sweep(_spec(
+                backend={"kind": "ensemble"},
+                asha={"min_horizon": 4.0},
+            ))
+
+
+class TestSuccessiveHalving:
+    ASHA = {"min_horizon": 4.0, "eta": 2}
+
+    def test_finds_exhaustive_top_trial_on_monotone_objective(self):
+        exhaustive = run_sweep(_spec())
+        halved = run_sweep(_spec(asha=self.ASHA))
+        assert halved.best["trial"] == exhaustive.best["trial"]
+        assert (
+            halved.best["objective"] == exhaustive.best["objective"]
+        )  # the winner ran the same full horizon, bitwise
+
+    def test_halving_schedule_and_extension_accounting(self):
+        res = run_sweep(_spec(asha=self.ASHA))
+        by_status = {}
+        for r in res.table:
+            by_status.setdefault(r["status"], []).append(r)
+        # rungs [4, 8, 16]: 5 -> keep 2 (3 stopped at rung 0) -> keep 1
+        # (1 stopped at rung 1) -> 1 done
+        assert len(by_status["done"]) == 1
+        assert len(by_status["stopped"]) == 4
+        assert sorted(
+            r["rung"] for r in by_status["stopped"]
+        ) == [0, 0, 0, 1]
+        # stopped trials carry their rung-horizon objective
+        assert all(
+            r["objective"] is not None for r in by_status["stopped"]
+        )
+        counters = res.metrics["server"]["counters"]
+        # survivors EXTENDED via hold_state/resubmit: 2 promotions at
+        # rung 0 + 1 at rung 1; nothing was ever re-run from scratch
+        assert counters["resubmitted"] == 3
+        assert counters["submitted"] == len(DOSES)
+
+    def test_kill_and_resume_reproduces_decisions(self, tmp_path):
+        spec = _spec(asha=self.ASHA)
+        full = run_sweep(spec, out_dir=str(tmp_path / "full"))
+        kill_dir = str(tmp_path / "killed")
+        killed = False
+        try:
+            # terminal events are sparse under halving (one DONE here),
+            # so kill on the FIRST one to leave rung state mid-flight
+            run_sweep(spec, out_dir=kill_dir, on_trial=_killer_after(1))
+        except _Kill:
+            killed = True
+        assert killed
+        resumed = run_sweep(spec, out_dir=kill_dir, resume=True)
+        for a, b in zip(full.table, resumed.table):
+            assert a["status"] == b["status"]
+            assert a.get("rung") == b.get("rung")
+            assert a["objective"] == b["objective"]
+
+
+def _replay_filtered(src_dir, dst_dir, drop):
+    """Reconstruct a partial ledger — a sweep killed at a precise event
+    boundary — by replaying a finished sweep's events minus ``drop``."""
+    from lens_tpu.sweep.ledger import LEDGER_NAME
+
+    src = TrialLedger(os.path.join(src_dir, "sweep.ledger"))
+    events = list(src.events)
+    src.close()
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = TrialLedger(os.path.join(dst_dir, LEDGER_NAME))
+    for ev in events:
+        if not drop(ev):
+            dst.append(ev)
+    dst.close()
+
+
+class TestHalvingResumeEdges:
+    """Kills landing BETWEEN ledger appends of one halving decision:
+    resume must re-derive the original run's decisions exactly."""
+
+    ASHA = {"min_horizon": 4.0, "eta": 2}
+
+    def test_kill_between_final_rung_and_done_finishes_from_ledger(
+        self, tmp_path
+    ):
+        """The final rung's TRIAL_RUNG is fsynced before TRIAL_DONE; a
+        kill in that window leaves a fully-simulated winner with no
+        terminal event. Its final-rung objective IS the full-horizon
+        objective, so resume finishes it from the ledger — nothing
+        re-simulates."""
+        spec = _spec(asha=self.ASHA)
+        full_dir = str(tmp_path / "full")
+        full = run_sweep(spec, out_dir=full_dir)
+        winner = full.best["trial"]
+        kill_dir = str(tmp_path / "killed")
+        _replay_filtered(
+            full_dir, kill_dir,
+            drop=lambda ev: ev["event"] == TRIAL_DONE
+            and ev["trial"] == winner,
+        )
+        resumed = run_sweep(spec, out_dir=kill_dir, resume=True)
+        assert resumed.metrics["server"]["counters"]["submitted"] == 0
+        for a, b in zip(full.table, resumed.table):
+            assert (a["status"], a["objective"]) == (
+                b["status"], b["objective"],
+            )
+
+    def test_kill_mid_cut_re_derives_the_original_cohort(self, tmp_path):
+        """A kill after 2 of rung 0's 3 TRIAL_STOPPED appends: the
+        resumed cut must rank the ORIGINAL 5-trial cohort (keep 2),
+        not the 3 not-yet-stopped trials (which would keep 1 and stop
+        a trial the original run promoted)."""
+        spec = _spec(asha=self.ASHA)
+        full_dir = str(tmp_path / "full")
+        full = run_sweep(spec, out_dir=full_dir)
+        kill_dir = str(tmp_path / "killed")
+        stops = [0]
+
+        def drop(ev):
+            kind = ev["event"]
+            if kind == "sweep_begin":
+                return False
+            if kind == "trial_rung" and ev["rung"] == 0:
+                return False
+            if kind == "trial_stopped" and ev["rung"] == 0:
+                stops[0] += 1
+                return stops[0] > 2  # the third stop never landed
+            return True  # nothing past rung 0 landed either
+
+        _replay_filtered(full_dir, kill_dir, drop)
+        resumed = run_sweep(spec, out_dir=kill_dir, resume=True)
+        for a, b in zip(full.table, resumed.table):
+            assert a["status"] == b["status"]
+            assert a.get("rung") == b.get("rung")
+            assert a["objective"] == b["objective"]
+
+    def test_failed_trial_replayed_from_ledger_is_never_ranked(
+        self, tmp_path
+    ):
+        """A FAILED trial carries objective None; on resume it must be
+        excluded from halving cohorts instead of crashing the ranking."""
+        spec = _spec(asha=self.ASHA)
+        full_dir = str(tmp_path / "full")
+        run_sweep(spec, out_dir=full_dir)
+        kill_dir = str(tmp_path / "killed")
+        _replay_filtered(
+            full_dir, kill_dir, drop=lambda ev: ev.get("trial") == 0
+        )
+        led = TrialLedger(os.path.join(kill_dir, "sweep.ledger"))
+        led.append({
+            "event": TRIAL_DONE, "trial": 0, "seed": 0,
+            "objective": None, "status": "failed", "steps": 0,
+        })
+        led.close()
+        resumed = run_sweep(spec, out_dir=kill_dir, resume=True)
+        assert resumed.table[0]["status"] == "failed"
+        assert resumed.best is not None
+        assert resumed.best["trial"] == len(DOSES) - 1
+
+
+class TestSaveAndLoadMany:
+    def test_save_trajectories_roundtrip_via_load_many(self, tmp_path):
+        from lens_tpu.analysis import load_many
+
+        out = str(tmp_path / "s")
+        res = run_sweep(_spec(save_trajectories=True), out_dir=out)
+        trials_dir = os.path.join(out, "trials")
+        loaded = load_many(trials_dir)
+        assert sorted(loaded) == [
+            f"trial_{i:05d}" for i in range(len(DOSES))
+        ]
+        for i in range(len(DOSES)):
+            got = loaded[f"trial_{i:05d}"]
+            np.testing.assert_array_equal(
+                got["cell"]["glucose_internal"],
+                res.timeseries[i]["cell"]["glucose_internal"],
+            )
+            np.testing.assert_array_equal(
+                got["__time__"], res.timeseries[i]["__times__"]
+            )
+
+    def test_load_many_tolerates_ragged_fleets(self, tmp_path):
+        from lens_tpu.analysis import load_many
+
+        out = str(tmp_path / "s")
+        run_sweep(_spec(save_trajectories=True), out_dir=out)
+        trials_dir = os.path.join(out, "trials")
+        # torn tail on one log (killed writer): its only segment record
+        # is lost, so the log is skipped — with a warning, not a crash
+        torn = os.path.join(trials_dir, "trial_00001.lens")
+        size = os.path.getsize(torn)
+        with open(torn, "r+b") as f:
+            f.truncate(size - 7)
+        # an empty log (trial admitted, killed pre-emit): skipped
+        open(os.path.join(trials_dir, "trial_00099.lens"), "wb").close()
+        # corrupt magic mid-file: warned, skipped
+        bad = os.path.join(trials_dir, "trial_00098.lens")
+        with open(bad, "wb") as f:
+            f.write(b"\x00" * 64)
+        with pytest.warns(UserWarning):
+            loaded = load_many(trials_dir)
+        assert "trial_00001" not in loaded
+        assert "trial_00099" not in loaded
+        assert "trial_00098" not in loaded
+        assert len(loaded) == len(DOSES) - 1
+        assert "alive" in loaded["trial_00002"]
+
+    def test_load_many_requires_a_directory(self, tmp_path):
+        from lens_tpu.analysis import load_many
+
+        with pytest.raises(NotADirectoryError):
+            load_many(str(tmp_path / "nope"))
