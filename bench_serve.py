@@ -1,23 +1,26 @@
 """Serving benchmark: req/s + latency vs the bare Ensemble.run ceiling.
 
 Measures what the serve layer costs over the raw device program it
-wraps. Two kinds of record, written to ``BENCH_SERVE_CPU_r08.json``
+wraps. Three kinds of record, written to ``BENCH_SERVE_CPU_r10.json``
 (or ``--out``):
 
-1. **Saturation** (per lane count L): the bare ceiling — an
-   ``Ensemble(sim, L).run`` of the same composite for the same steps,
-   in row-steps/s — against the served throughput with every lane
-   occupied for the whole measurement (N = fill_rounds * L
+1. **Saturation A/B** (per lane count L, per pipeline mode): the bare
+   ceiling — an ``Ensemble(sim, L).run`` of the same composite for the
+   same steps, in row-steps/s — against the served throughput with
+   every lane occupied for the whole measurement (N = fill_rounds * L
    equal-horizon requests, so lanes retire and refill in lockstep and
    occupancy stays 1.0 until the drain tail). ``served_over_ceiling``
-   is the acceptance ratio: everything the scheduler adds (admission
-   scatters, per-window host transfer + slicing, Python bookkeeping)
-   shows up as the gap to 1.0.
-2. **Offered-load sweep** (per L): requests arriving at a paced rate
-   (0.5x / 0.9x / 1.5x the measured saturated req/s), p50/p95/p99
-   request latency + queue wait per load, plus reject counts at the
-   bounded queue — the latency-under-load curve a capacity planner
-   reads.
+   is the acceptance ratio: everything the scheduler adds shows up as
+   the gap to 1.0. Round 10 reports PIPELINED vs SYNC rows
+   interleaved (same warmed servers alternating per rep), plus the
+   new ``device_busy_fraction`` and stream-lag/host-gap columns from
+   the ``ServerMetrics`` stream samples — the direct measurement of
+   how much of the r08 host gap the pipeline recovered.
+2. **Offered-load sweep** (per L, pipelined): requests arriving at a
+   paced rate (0.5x / 0.9x / 1.5x the measured saturated req/s),
+   p50/p95/p99 request latency + queue wait per load, plus reject
+   counts at the bounded queue — the latency-under-load curve a
+   capacity planner reads.
 
 Composite: ``toggle_colony`` (config-1 cell; deterministic, light
 biology) — the point is to measure the SERVING machinery, not the
@@ -39,76 +42,17 @@ from lens_tpu.experiment import build_model
 from lens_tpu.serve import QueueFull, ScenarioRequest, SimServer
 
 
-def saturation_point(
-    composite: str, capacity: int, lanes: int, window: int,
-    emit_every: int, horizon_steps: int, fill_rounds: int,
-    reps: int = 3,
-):
-    """The per-lane-count saturation record: ceiling vs served,
-    INTERLEAVED min-of-reps (this host's wall clock wanders ±20% —
-    same protocol as bench_phases).
-
-    Ceiling: ``Ensemble.run`` at the serve bucket's exact shapes (same
-    emit cadence, plus a ``device_get`` of the trajectory, so the
-    device->host transfer the server also pays is inside the ceiling,
-    not counted against serving). Served: N = fill_rounds*L
-    equal-horizon requests, every lane occupied for the whole phase.
-    Both warmed before any timing; warmup samples dropped.
-    """
-    sim = build_model(composite, {}, capacity=capacity).sim
-    ens = Ensemble(sim, lanes)
-    states = ens.initial_state(1, key=jax.random.PRNGKey(0))
-    run = jax.jit(
-        lambda s: ens.run(
-            s, float(horizon_steps), 1.0, emit_every=emit_every
-        )
-    )
-    jax.block_until_ready(run(states)[0])  # compile + warm
-
-    srv = SimServer.single_bucket(
+def _make_server(composite, capacity, lanes, window, emit_every,
+                 queue_depth, pipeline):
+    return SimServer.single_bucket(
         composite,
         capacity=capacity,
         lanes=lanes,
         window=window,
         emit_every=emit_every,
-        queue_depth=max(2 * lanes * fill_rounds, 16),
+        queue_depth=queue_depth,
+        pipeline=pipeline,
     )
-    _warm(srv, composite, lanes, window)
-
-    n = fill_rounds * lanes
-    ceiling_wall = served_wall = float("inf")
-    counters0 = srv.metrics()["counters"]
-    busy0 = counters0["lane_windows_busy"]
-    total0 = counters0["lane_windows_total"]
-    for rep in range(reps):
-        t0 = time.perf_counter()
-        final, traj = run(states)
-        jax.device_get(traj)
-        jax.block_until_ready(final)
-        ceiling_wall = min(ceiling_wall, time.perf_counter() - t0)
-
-        t0 = time.perf_counter()
-        ids = [
-            srv.submit(ScenarioRequest(
-                composite=composite, seed=100 + rep * n + i,
-                horizon=float(horizon_steps),
-            ))
-            for i in range(n)
-        ]
-        srv.run_until_idle(max_ticks=100_000)
-        served_wall = min(served_wall, time.perf_counter() - t0)
-        assert all(
-            srv.status(r)["status"] == "done" for r in ids
-        )
-    snap = srv.metrics()
-    # occupancy of the measured phases only (warmup windows excluded)
-    snap["occupancy"] = (
-        snap["counters"]["lane_windows_busy"] - busy0
-    ) / max(snap["counters"]["lane_windows_total"] - total0, 1)
-    srv.close()
-    ceiling = lanes * capacity * horizon_steps / ceiling_wall
-    served = n * horizon_steps * capacity / served_wall
-    return ceiling, served, n / served_wall, snap
 
 
 def _warm(srv, composite, lanes, window) -> None:
@@ -123,6 +67,113 @@ def _warm(srv, composite, lanes, window) -> None:
     srv.reset_samples()
 
 
+def _occupancy_window(srv):
+    c = srv.metrics()["counters"]
+    return c["lane_windows_busy"], c["lane_windows_total"]
+
+
+def _serve_round(srv, composite, n, horizon_steps, seed0):
+    """Submit n equal-horizon requests, run to idle, return wall."""
+    t0 = time.perf_counter()
+    ids = [
+        srv.submit(ScenarioRequest(
+            composite=composite, seed=seed0 + i,
+            horizon=float(horizon_steps),
+        ))
+        for i in range(n)
+    ]
+    srv.run_until_idle(max_ticks=100_000)
+    wall = time.perf_counter() - t0
+    assert all(srv.status(r)["status"] == "done" for r in ids)
+    return wall
+
+
+def saturation_point(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, fill_rounds: int,
+    reps: int = 3,
+):
+    """The per-lane-count saturation record: ceiling vs served for BOTH
+    pipeline modes, INTERLEAVED min-of-reps (this host's wall clock
+    wanders ±20% — same protocol as bench_phases). Each rep times the
+    bare ensemble ceiling, the pipelined server, and the synchronous
+    server back to back on the same warmed programs.
+
+    Ceiling: ``Ensemble.run`` at the serve bucket's exact shapes (same
+    emit cadence, plus a ``device_get`` of the trajectory, so the
+    device->host transfer the server also pays is inside the ceiling,
+    not counted against serving).
+    """
+    sim = build_model(composite, {}, capacity=capacity).sim
+    ens = Ensemble(sim, lanes)
+    states = ens.initial_state(1, key=jax.random.PRNGKey(0))
+    run = jax.jit(
+        lambda s: ens.run(
+            s, float(horizon_steps), 1.0, emit_every=emit_every
+        )
+    )
+    jax.block_until_ready(run(states)[0])  # compile + warm
+
+    n = fill_rounds * lanes
+    depth = max(2 * n, 16)
+    servers = {
+        mode: _make_server(
+            composite, capacity, lanes, window, emit_every, depth, mode
+        )
+        for mode in ("on", "off")
+    }
+    for srv in servers.values():
+        _warm(srv, composite, lanes, window)
+    base = {m: _occupancy_window(s) for m, s in servers.items()}
+
+    ceiling_wall = float("inf")
+    served_wall = {"on": float("inf"), "off": float("inf")}
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        final, traj = run(states)
+        jax.device_get(traj)
+        jax.block_until_ready(final)
+        ceiling_wall = min(ceiling_wall, time.perf_counter() - t0)
+
+        for mode, srv in servers.items():
+            wall = _serve_round(
+                srv, composite, n, horizon_steps,
+                seed0=100 + rep * 2 * n + (0 if mode == "on" else n),
+            )
+            served_wall[mode] = min(served_wall[mode], wall)
+
+    ceiling = lanes * capacity * horizon_steps / ceiling_wall
+    rows = []
+    for mode, srv in servers.items():
+        snap = srv.metrics()
+        busy0, total0 = base[mode]
+        served = n * horizon_steps * capacity / served_wall[mode]
+        lag = snap["stream_lag_seconds"]
+        gap = snap["host_gap_seconds"]
+        rows.append({
+            "lanes": lanes,
+            "pipeline": mode,
+            "ceiling_row_steps_s": round(ceiling),
+            "served_row_steps_s": round(served),
+            "served_over_ceiling": round(served / ceiling, 4),
+            "saturated_req_s": round(n / served_wall[mode], 2),
+            "occupancy": (
+                snap["counters"]["lane_windows_busy"] - busy0
+            ) / max(snap["counters"]["lane_windows_total"] - total0, 1),
+            "retraces": snap["retraces"],
+            "device_busy_fraction": (
+                None if snap["device_busy_fraction"] is None
+                else round(snap["device_busy_fraction"], 4)
+            ),
+            "stream_lag_p50_s": lag["p50"],
+            "host_gap_p50_s": gap["p50"],
+            "stream_stalls": snap["stream_stalls"],
+            "latency_s": snap["latency_seconds"],
+        })
+        srv.close()
+    return rows
+
+
 def offered_load(
     composite: str, capacity: int, lanes: int, window: int,
     emit_every: int, horizon_steps: int, rate_req_s: float, n: int,
@@ -130,19 +181,14 @@ def offered_load(
     """Pace ``n`` arrivals at ``rate_req_s``; tick between arrivals.
     Returns latency/wait percentiles + reject count. Rejected requests
     are retried until admitted (the client-backoff model), so every
-    request's latency includes its backpressure delay."""
-    srv = SimServer.single_bucket(
-        composite,
-        capacity=capacity,
-        lanes=lanes,
-        window=window,
-        emit_every=emit_every,
-        queue_depth=2 * lanes,
+    request's latency includes its backpressure delay. Pipelined (the
+    serving default)."""
+    srv = _make_server(
+        composite, capacity, lanes, window, emit_every,
+        queue_depth=2 * lanes, pipeline="on",
     )
     _warm(srv, composite, lanes, window)
-    counters0 = srv.metrics()["counters"]
-    busy0 = counters0["lane_windows_busy"]
-    total0 = counters0["lane_windows_total"]
+    busy0, total0 = _occupancy_window(srv)
 
     interval = 1.0 / rate_req_s
     pending = [
@@ -176,6 +222,8 @@ def offered_load(
         "latency_s": snap["latency_seconds"],
         "queue_wait_s": snap["wait_seconds"],
         "rejects": rejects,
+        "device_busy_fraction": snap["device_busy_fraction"],
+        "stream_lag_p50_s": snap["stream_lag_seconds"]["p50"],
         "occupancy": (
             snap["counters"]["lane_windows_busy"] - busy0
         ) / max(snap["counters"]["lane_windows_total"] - total0, 1),
@@ -201,7 +249,7 @@ def main() -> int:
     )
     p.add_argument("--fill-rounds", type=int, default=4)
     p.add_argument("--sweep-n", type=int, default=48)
-    p.add_argument("--out", default="BENCH_SERVE_CPU_r08.json")
+    p.add_argument("--out", default="BENCH_SERVE_CPU_r10.json")
     args = p.parse_args()
 
     horizon_steps = args.horizon_windows * args.window
@@ -218,28 +266,21 @@ def main() -> int:
     }
 
     for lanes in args.lanes:
-        ceiling, served, req_s, snap = saturation_point(
+        rows = saturation_point(
             args.composite, args.capacity, lanes, args.window,
             args.emit_every, horizon_steps, args.fill_rounds,
         )
-        entry = {
-            "lanes": lanes,
-            "ceiling_row_steps_s": round(ceiling),
-            "served_row_steps_s": round(served),
-            "served_over_ceiling": round(served / ceiling, 4),
-            "saturated_req_s": round(req_s, 2),
-            "occupancy": snap["occupancy"],
-            "retraces": snap["retraces"],
-            "latency_s": snap["latency_seconds"],
-        }
-        record["saturation"].append(entry)
-        print(json.dumps(entry), flush=True)
+        for entry in rows:
+            record["saturation"].append(entry)
+            print(json.dumps(entry), flush=True)
 
+        piped = next(r for r in rows if r["pipeline"] == "on")
         for frac in (0.5, 0.9, 1.5):
             sweep = offered_load(
                 args.composite, args.capacity, lanes, args.window,
                 args.emit_every, horizon_steps,
-                rate_req_s=max(frac * req_s, 0.5), n=args.sweep_n,
+                rate_req_s=max(frac * piped["saturated_req_s"], 0.5),
+                n=args.sweep_n,
             )
             sweep["lanes"] = lanes
             sweep["load_fraction"] = frac
@@ -249,10 +290,12 @@ def main() -> int:
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2)
     print(f"wrote {args.out}")
-    worst = min(
-        e["served_over_ceiling"] for e in record["saturation"]
-    )
-    print(f"worst served/ceiling ratio: {worst:.3f}")
+    for mode in ("on", "off"):
+        worst = min(
+            e["served_over_ceiling"]
+            for e in record["saturation"] if e["pipeline"] == mode
+        )
+        print(f"worst served/ceiling (pipeline {mode}): {worst:.3f}")
     return 0
 
 

@@ -201,6 +201,24 @@ def _build_parser() -> argparse.ArgumentParser:
         "--out-dir", default="out/serve",
         help="per-request .lens result logs + server_meta.json land here",
     )
+    serve.add_argument(
+        "--pipeline", choices=["on", "off"], default="on",
+        help="depth-2 serve pipeline: overlap device windows with "
+        "background host-side streaming (off = the synchronous "
+        "debugging path; results are bitwise identical either way)",
+    )
+    serve.add_argument(
+        "--stream-queue", type=int, default=2,
+        help="max windows queued/processing on the background "
+        "streamer before the scheduler stalls (pipeline "
+        "backpressure depth)",
+    )
+    serve.add_argument(
+        "--flush-every", type=int, default=1,
+        help="flush each request's result log every k-th window "
+        "append (batched flush; 1 = tightest tailing-reader "
+        "visibility)",
+    )
 
     sweep = sub.add_parser(
         "sweep",
@@ -366,6 +384,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         queue_depth=args.queue_depth,
         out_dir=args.out_dir,
         sink="log",
+        pipeline=args.pipeline,
+        stream_queue=args.stream_queue,
+        flush_every=args.flush_every,
     )
     with server:
         ids = []
@@ -401,6 +422,14 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             print(
                 f"latency p50={lat['p50']:.3f}s p95={lat['p95']:.3f}s "
                 f"p99={lat['p99']:.3f}s"
+            )
+        busy = snap.get("device_busy_fraction")
+        if busy is not None:
+            lag = snap["stream_lag_seconds"]
+            print(
+                f"pipeline {args.pipeline}: device_busy={busy:.2f} "
+                f"stream_lag p50={lag['p50']:.4f}s "
+                f"stalls={snap['stream_stalls']}"
             )
         print(f"results: {args.out_dir}/<request-id>.lens")
         print(f"meta:    {args.out_dir}/server_meta.json")

@@ -48,6 +48,7 @@ import numpy as np
 
 from lens_tpu.core.schedule import scan_schedule
 from lens_tpu.utils.dicts import flatten_paths, set_path
+from lens_tpu.utils.hostio import copy_tree_to_host_async
 
 
 class Ensemble:
@@ -203,7 +204,9 @@ class Ensemble:
                 f"{type(self.sim).__name__} has no expanded(); capacity "
                 f"growth needs a Colony/SpatialColony-form sim"
             )
-        host = jax.device_get(states)
+        # start every leaf's DMA before the blocking fetch (the shared
+        # segment-loop policy; see utils.hostio)
+        host = jax.device_get(copy_tree_to_host_async(states))
         grown_sim = None
         slices = []
         # Delegating per replicate re-runs the (host-side, cheap)

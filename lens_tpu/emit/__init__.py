@@ -21,7 +21,6 @@ boot/experiment layer treats emitters as config, like the reference.
 from __future__ import annotations
 
 import os
-import threading
 import uuid
 from typing import Any, Dict, List, Mapping, Optional
 
@@ -29,8 +28,8 @@ import jax
 import numpy as np
 
 from lens_tpu.emit.log import (
+    FramedWriter,
     encode_record,
-    frame,
     make_header,
     make_segment,
     read_experiment,
@@ -108,52 +107,6 @@ class RamEmitter(Emitter):
         return stack_records(self.records)
 
 
-class _PyWriter:
-    """Pure-Python fallback with the native writer's file format and a
-    background thread (so the calling thread still never blocks on disk)."""
-
-    def __init__(self, path: str):
-        self._file = open(path, "ab")
-        self._queue: List[bytes] = []
-        self._cond = threading.Condition()
-        self._pending = 0  # queued + currently being written
-        self._stop = False
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self) -> None:
-        while True:
-            with self._cond:
-                self._cond.wait_for(lambda: self._queue or self._stop)
-                if not self._queue and self._stop:
-                    return
-                batch, self._queue = self._queue, []
-            for chunk in batch:
-                self._file.write(chunk)
-            with self._cond:
-                self._pending -= len(batch)
-                self._cond.notify_all()
-
-    def write(self, payload: bytes) -> None:
-        with self._cond:
-            self._queue.append(frame(payload))
-            self._pending += 1
-            self._cond.notify_all()
-
-    def flush(self) -> None:
-        with self._cond:
-            self._cond.wait_for(lambda: self._pending == 0)
-        self._file.flush()
-
-    def close(self) -> None:
-        with self._cond:
-            self._stop = True
-            self._cond.notify_all()
-        self._thread.join()
-        self._file.flush()
-        self._file.close()
-
-
 class _NativeWriter:
     """ctypes shim over lens_tpu/native/libemit_writer.so."""
 
@@ -185,8 +138,18 @@ class LogEmitter(Emitter):
     """Append records to a framed record log on disk.
 
     Uses the native C++ background writer when available; otherwise the
-    Python fallback (identical bytes). ``path`` defaults to
-    ``out/<experiment_id>.lens``.
+    pure-Python :class:`~lens_tpu.emit.log.FramedWriter` (identical
+    bytes). ``path`` defaults to ``out/<experiment_id>.lens``.
+
+    ``flush_every=k`` batches visibility flushes: the file buffer is
+    flushed after every ``k``-th record, so a tailing reader
+    (``log.tail_records``) sees records at that cadence without the
+    writer paying a flush per record. ``None`` (default) flushes only
+    on explicit :meth:`flush`/:meth:`close`. On the Python writer the
+    batched flush runs on the background thread (never blocks the
+    emitter); the native writer has no flush policy hook, so the
+    emitter counts records and issues its (queue-draining) flush every
+    ``k``-th — still amortized ``k``-fold.
     """
 
     def __init__(
@@ -195,11 +158,16 @@ class LogEmitter(Emitter):
         config: Mapping | None = None,
         path: str | None = None,
         native: bool = True,
+        flush_every: int | None = None,
     ):
         super().__init__(experiment_id, config)
+        if flush_every is not None and flush_every < 1:
+            raise ValueError(f"flush_every={flush_every} must be >= 1")
         self.path = path or os.path.join("out", f"{self.experiment_id}.lens")
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._writer = None
+        self._flush_every = flush_every
+        self._since_flush = 0
         if native:
             from lens_tpu.native import emit_writer_lib
 
@@ -207,14 +175,23 @@ class LogEmitter(Emitter):
             if lib is not None:
                 self._writer = _NativeWriter(lib, self.path)
         if self._writer is None:
-            self._writer = _PyWriter(self.path)
+            self._writer = FramedWriter(self.path, flush_every=flush_every)
+            self._flush_every = None  # the writer thread owns the policy
         self.native = isinstance(self._writer, _NativeWriter)
         self._writer.write(
             encode_record(make_header(self.experiment_id, self.config))
         )
 
+    def _write(self, payload: bytes) -> None:
+        self._writer.write(payload)
+        if self._flush_every is not None:
+            self._since_flush += 1
+            if self._since_flush >= self._flush_every:
+                self._writer.flush()
+                self._since_flush = 0
+
     def emit(self, record: Mapping[str, Any]) -> None:
-        self._writer.write(encode_record(record))
+        self._write(encode_record(record))
 
     def emit_trajectory(self, trajectory: Any, times: Any = None) -> None:
         """Write the whole segment as ONE record (O(leaves), not
@@ -226,7 +203,7 @@ class LogEmitter(Emitter):
         if got is None:
             return
         host, times = got
-        self._writer.write(encode_record(make_segment(host, times)))
+        self._write(encode_record(make_segment(host, times)))
 
     def flush(self) -> None:
         self._writer.flush()
@@ -255,6 +232,7 @@ def get_emitter(config: Mapping[str, Any] | None = None) -> Emitter:
 
 __all__ = [
     "Emitter",
+    "FramedWriter",
     "NullEmitter",
     "RamEmitter",
     "LogEmitter",

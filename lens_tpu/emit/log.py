@@ -20,8 +20,9 @@ import io
 import json
 import os
 import struct
+import threading
 import zlib
-from typing import Any, Dict, Iterator, List, Mapping, Tuple
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -70,6 +71,161 @@ def decode_record(payload: bytes) -> Dict[str, Any]:
 def frame(payload: bytes) -> bytes:
     """Wrap payload bytes in the record frame (magic, crc, length)."""
     return _FRAME.pack(MAGIC, zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+
+
+class FramedWriter:
+    """Off-thread framed appender with a batched flush policy.
+
+    The pure-Python peer of the native background writer
+    (``lens_tpu/native/emit_writer.cpp``), relocated next to the frame
+    format it writes: ``write(payload)`` frames the bytes and enqueues
+    them; a daemon thread drains the queue to an append-only file, so
+    the caller (the sim step loop, the serve streamer) never blocks on
+    disk.
+
+    ``flush_every=k`` flushes the file's user-space buffer after every
+    ``k``-th frame — ON THE WRITER THREAD, so callers never pay the
+    flush either. ``k=1`` makes every record promptly visible to a
+    tailing reader (``tail_records``); larger ``k`` batches the
+    syscalls for throughput; ``None`` flushes only on explicit
+    :meth:`flush`/:meth:`close`. Whatever the policy, readers only ever
+    see whole frames or a torn TAIL frame (appends are sequential), so
+    ``tail_records``'s resume contract holds under any cadence.
+
+    :meth:`flush` (explicit) still blocks until everything queued so
+    far is on disk — the barrier close/checkpoint paths need.
+
+    ``max_queue_bytes`` bounds the internal queue (the same 256 MiB
+    default cap as the native writer): a ``write`` past it BLOCKS
+    until the writer thread drains below the cap, so a disk slower
+    than the producer throttles the producer instead of growing host
+    RAM without bound — the serve pipeline's bounded-memory contract
+    leans on this (a blocked append holds its streamer slot, which
+    stalls the scheduler through ``stream_queue``).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        flush_every: Optional[int] = None,
+        max_queue_bytes: int = 256 << 20,
+    ):
+        if flush_every is not None and flush_every < 1:
+            raise ValueError(f"flush_every={flush_every} must be >= 1")
+        if max_queue_bytes < 1:
+            raise ValueError(
+                f"max_queue_bytes={max_queue_bytes} must be >= 1"
+            )
+        self._file = open(path, "ab")
+        self._flush_every = flush_every
+        self._max_queue_bytes = int(max_queue_bytes)
+        self._queued_bytes = 0
+        self._since_flush = 0
+        self._queue: List[bytes] = []
+        self._cond = threading.Condition()
+        self._pending = 0  # queued + currently being written
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._queue or self._stop)
+                if not self._queue and self._stop:
+                    return
+                batch, self._queue = self._queue, []
+                # bytes stay counted until WRITTEN: releasing them at
+                # take would let the producer queue another full cap
+                # while this batch is still in flight (~2x the bound)
+            try:
+                for chunk in batch:
+                    self._file.write(chunk)
+                    self._since_flush += 1
+                    if (
+                        self._flush_every is not None
+                        and self._since_flush >= self._flush_every
+                    ):
+                        self._file.flush()
+                        self._since_flush = 0
+            except BaseException as e:  # surfaced at the next write/flush
+                with self._cond:
+                    self._error = e
+                    self._pending -= len(batch)
+                    self._queued_bytes -= sum(len(c) for c in batch)
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._pending -= len(batch)
+                self._queued_bytes -= sum(len(c) for c in batch)
+                self._cond.notify_all()
+
+    def _check(self) -> None:
+        if self._error is not None:
+            raise self._error
+        if self._stop:
+            # fail fast: the writer thread is (being) joined — a frame
+            # enqueued now would be silently lost and a later flush
+            # would wait forever on it
+            raise RuntimeError("FramedWriter is closed")
+
+    def write(self, payload: bytes) -> None:
+        framed = frame(payload)
+        with self._cond:
+            self._check()
+            # disk backpressure: block (don't buffer without bound)
+            # while the writer thread is more than the cap behind
+            # _pending == 0 (fully drained AND written) admits a
+            # single frame larger than the cap rather than deadlocking
+            self._cond.wait_for(
+                lambda: self._queued_bytes + len(framed)
+                <= self._max_queue_bytes
+                or self._pending == 0
+                or self._error is not None
+                or self._stop
+            )
+            self._check()
+            self._queue.append(framed)
+            self._queued_bytes += len(framed)
+            self._pending += 1
+            self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Block until every frame queued so far is written and the
+        user-space buffer handed to the OS."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._pending == 0
+                or self._error is not None
+                or self._stop
+            )
+            self._check()
+        self._file.flush()
+        self._since_flush = 0
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
+        # Always close the fd, and never let a secondary flush/close
+        # failure mask the parked writer-thread error (the root cause
+        # of whatever went wrong on disk).
+        close_error: Optional[BaseException] = None
+        try:
+            self._file.flush()
+        except BaseException as e:
+            close_error = e
+        finally:
+            try:
+                self._file.close()
+            except BaseException as e:
+                close_error = close_error or e
+        if self._error is not None:
+            raise self._error
+        if close_error is not None:
+            raise close_error
 
 
 def iter_frames(

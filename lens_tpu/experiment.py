@@ -40,6 +40,7 @@ from lens_tpu.environment.multispecies import (
 from lens_tpu.environment.spatial import SpatialColony, SpatialState
 from lens_tpu.models.composites import composite_registry
 from lens_tpu.utils.dicts import deep_merge
+from lens_tpu.utils.hostio import copy_tree_to_host_async
 
 DEFAULT_CONFIG: Dict[str, Any] = {
     "composite": "grow_divide",     # name in models.composites registry
@@ -893,9 +894,7 @@ class Experiment:
                     + start_step * dt
                 )
                 if pipelined:
-                    for leaf in jax.tree.leaves(trajectory):
-                        if hasattr(leaf, "copy_to_host_async"):
-                            leaf.copy_to_host_async()
+                    copy_tree_to_host_async(trajectory)
                     self._flush_pending()
                     self._pending = (trajectory, times)
                 else:

@@ -21,6 +21,7 @@ from lens_tpu.serve.batcher import (
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
 from lens_tpu.serve.server import SimServer
+from lens_tpu.serve.streamer import Streamer
 
 __all__ = [
     "CANCELLED",
@@ -34,5 +35,6 @@ __all__ = [
     "ScenarioRequest",
     "ServerMetrics",
     "SimServer",
+    "Streamer",
     "write_server_meta",
 ]

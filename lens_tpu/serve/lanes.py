@@ -184,6 +184,12 @@ class LanePool:
             lambda remaining, lane: remaining.at[lane].set(0),
             donate_argnums=(0,) if donate else (),
         )
+        # Device-side lane snapshot (hold_state capture): lane is a
+        # traced scalar, so one compile serves every retirement. NOT
+        # donated — it reads the same pool the next window consumes.
+        self._lane_slice = jax.jit(
+            lambda states, lane: jax.tree.map(lambda x: x[lane], states)
+        )
         # Jitted solo-state builders, one per (n_agents, override
         # STRUCTURE) — admission's third resident program. The eager
         # op-by-op build cost ~0.8 ms per admission on this box's CPU
@@ -305,6 +311,24 @@ class LanePool:
         )
         self.remaining_host[lane] = int(steps)
 
+    def lane_state_device(self, lane: int):
+        """DEVICE-side snapshot of one lane's current state (a
+        solo-shaped pytree of device arrays) — no host sync.
+
+        The pipelined hold_state capture: the slice program is
+        dispatched before the lane can be reassigned (XLA sequences it
+        ahead of the next admit/window on the same buffers), so the
+        snapshot holds the lane's exact final bits while the scheduler
+        runs ahead. ``admit_state`` accepts the device tree directly,
+        so a later ``resubmit`` continues the scenario bitwise without
+        the state ever visiting the host; anything that does want host
+        bytes (``lane_state``, a client inspecting results) pays the
+        transfer then — deferred, off the window critical path.
+        """
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        return self._lane_slice(self.states, jnp.int32(lane))
+
     def lane_state(self, lane: int):
         """Host copy of one lane's current state (a solo-shaped pytree).
 
@@ -313,11 +337,7 @@ class LanePool:
         ``admit_state(lane', lane_state(lane), ...)`` continues the
         scenario bitwise.
         """
-        if not 0 <= lane < self.n_lanes:
-            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
-        return jax.device_get(
-            jax.tree.map(lambda x: x[lane], self.states)
-        )
+        return jax.device_get(self.lane_state_device(lane))
 
     def release(self, lane: int) -> None:
         """Free a lane before its horizon elapsed (cancel/deadline): zero

@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 
 def percentiles(samples: List[float], points=(50.0, 95.0, 99.0)) -> Dict[str, Optional[float]]:
@@ -92,6 +92,17 @@ class ServerMetrics:
         self.wait_seconds: List[float] = []
         self.latency_seconds: List[float] = []
         self.window_seconds: List[float] = []
+        # per streamed window: (dispatched_at, ready_at, streamed_at) —
+        # dispatch is when the scheduler enqueued the window program,
+        # ready is when its trajectory finished landing host-side, and
+        # streamed is when the last sink append for it returned. The
+        # pipeline gauges below (device busy fraction, host gap,
+        # stream lag) are all derived from these three timestamps.
+        self.stream_samples: List[Tuple[float, float, float]] = []
+        # scheduler seconds blocked on streamer backpressure (the
+        # bounded queue full — host streaming is the bottleneck)
+        self.stall_seconds = 0.0
+        self.stalls = 0
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] += by
@@ -102,6 +113,54 @@ class ServerMetrics:
 
     def observe_window(self, wall_s: float) -> None:
         self.window_seconds.append(float(wall_s))
+
+    def observe_stream(
+        self, dispatched_at: float, ready_at: float, streamed_at: float
+    ) -> None:
+        self.stream_samples.append(
+            (float(dispatched_at), float(ready_at), float(streamed_at))
+        )
+
+    def observe_stall(self, seconds: float) -> None:
+        if seconds > 0:
+            self.stall_seconds += float(seconds)
+            self.stalls += 1
+
+    def device_busy_fraction(self) -> Optional[float]:
+        """Fraction of the streamed span the device had a window in
+        flight: per window, busy time runs from max(its dispatch, the
+        previous window's ready) to its ready — windows queue behind
+        each other on the device, so the previous ready is when this
+        one's compute could start. An approximation (ready includes
+        the host transfer tail), but it moves the right way: 1.0 means
+        the device never waited for the host; the r08 synchronous path
+        idled the device for the whole slice/append/flush stretch of
+        every window."""
+        samples = sorted(self.stream_samples)
+        if not samples:
+            return None
+        span = max(s[2] for s in samples) - samples[0][0]
+        if span <= 0:
+            return None
+        busy = 0.0
+        prev_ready = None
+        for dispatched, ready, _ in samples:
+            start = dispatched if prev_ready is None else max(
+                dispatched, prev_ready
+            )
+            busy += max(ready - start, 0.0)
+            prev_ready = ready
+        return min(busy / span, 1.0)
+
+    def host_gap_seconds(self) -> List[float]:
+        """Per-window host streaming time (ready -> last append)."""
+        return [s[2] - s[1] for s in self.stream_samples]
+
+    def stream_lag_seconds(self) -> List[float]:
+        """Per-window dispatch -> fully-streamed latency: how far
+        behind the device the sinks run (a tailing reader's staleness
+        bound)."""
+        return [s[2] - s[0] for s in self.stream_samples]
 
     def avg_window_seconds(self, default: float = 0.1) -> float:
         """Recent mean window wall time — the unit the backpressure
@@ -130,6 +189,11 @@ class ServerMetrics:
             ),
             "latency_seconds": percentiles(self.latency_seconds),
             "wait_seconds": percentiles(self.wait_seconds),
+            "device_busy_fraction": self.device_busy_fraction(),
+            "host_gap_seconds": percentiles(self.host_gap_seconds()),
+            "stream_lag_seconds": percentiles(self.stream_lag_seconds()),
+            "stream_stall_seconds": self.stall_seconds,
+            "stream_stalls": self.stalls,
         }
 
 

@@ -19,7 +19,12 @@ environments, LLM continuous batching):
 - a bounded queue rejects with a retry-after hint when full
   (batcher.QueueFull), per-request wall-clock deadlines expire queued
   AND running work, and counters (metrics.ServerMetrics) plus a
-  ``server_meta.json`` sidecar make the whole thing observable.
+  ``server_meta.json`` sidecar make the whole thing observable;
+- the serve path is a depth-2 pipeline (round 10): the tick dispatches
+  window k+1 while a background streamer thread (streamer.Streamer)
+  slices/filters/appends window k — bookkeeping reads only host
+  mirrors, hold_state snapshots stay on-device, and ``pipeline="off"``
+  preserves the synchronous path (bitwise-identical results).
 
 Determinism contract (pinned in tests/test_serve.py): a request's
 emitted trajectory is BITWISE identical served solo or co-batched with
@@ -41,6 +46,7 @@ or from the CLI: ``python -m lens_tpu serve --requests reqs.json``.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import replace as dc_replace
 from typing import Any, Dict, List, Mapping, Optional
@@ -64,7 +70,15 @@ from lens_tpu.serve.batcher import (
 )
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
+from lens_tpu.serve.streamer import (
+    LaneSlice,
+    Streamer,
+    WindowItem,
+    process_window,
+    subsample_rows,
+)
 from lens_tpu.utils.dicts import flatten_paths, get_path, set_path
+from lens_tpu.utils.hostio import copy_tree_to_host_async
 
 #: Per-bucket knobs and their defaults; see ``SimServer`` docstring.
 BUCKET_DEFAULTS: Dict[str, Any] = {
@@ -78,21 +92,6 @@ BUCKET_DEFAULTS: Dict[str, Any] = {
     "timestep": 1.0,        # sim seconds per step
     "emit_every": 1,        # device emit cadence within the window
 }
-
-
-def _filter_paths(tree: Mapping, prefixes: List[str]) -> Dict:
-    """Keep leaves whose ``/``-joined path starts with any prefix
-    (component-aligned: prefix ``cell`` matches ``cell/volume``, not
-    ``cells``). Host-side, post-device — a pure projection of the
-    emitted bits, so it can never perturb them."""
-    out: Dict = {}
-    for path, value in flatten_paths(tree):
-        joined = SEP.join(str(p) for p in path)
-        if any(
-            joined == p or joined.startswith(p + SEP) for p in prefixes
-        ):
-            out = set_path(out, path, value)
-    return out
 
 
 class _RamResult:
@@ -124,13 +123,14 @@ class _RamResult:
 
 class _LogResult:
     """Disk result sink: one framed ``.lens`` log per request (header +
-    one SEGMENT record per window), flushed after every append so a
-    concurrent reader can stream it with ``emit.log.tail_records``."""
+    one SEGMENT record per window). ``flush_every=k`` makes records
+    visible to tailing readers (``emit.log.tail_records``) every ``k``
+    windows — the batched flush policy; ``None`` defers visibility to
+    close."""
 
     def __init__(self, path: str, request_id: str, config: Mapping,
-                 stream_flush: bool = True):
+                 flush_every: Optional[int] = 1):
         self.path = path
-        self._stream_flush = stream_flush
         # A request wholly owns its log. LogEmitter APPENDS (the run
         # path's resume semantics) — but serve request ids restart at
         # req-000000 per server, so a reused out_dir would silently
@@ -139,13 +139,12 @@ class _LogResult:
         if os.path.exists(path):
             os.remove(path)
         self._emitter = LogEmitter(
-            experiment_id=request_id, config=config, path=path
+            experiment_id=request_id, config=config, path=path,
+            flush_every=flush_every,
         )
 
     def append(self, tree: Mapping, times: np.ndarray) -> None:
         self._emitter.emit_trajectory(tree, times=times)
-        if self._stream_flush:
-            self._emitter.flush()
 
     def close(self) -> None:
         self._emitter.close()
@@ -212,9 +211,26 @@ class SimServer:
         ``<out_dir>/<request_id>.lens`` — readable while still being
         written via :func:`lens_tpu.emit.log.tail_records`.
     stream_flush:
-        With the log sink, flush after every window so concurrent
-        readers see records promptly (off = fewer fsync-ish stalls,
-        records visible only at close).
+        With the log sink, flush so concurrent readers see records
+        promptly (off = records visible only at close). The cadence is
+        ``flush_every``.
+    flush_every:
+        Batched flush policy for the log sink: flush each request's
+        log after every k-th window append (1 = per window, the
+        tightest tailing-reader staleness; larger batches the flush
+        syscalls). Ignored when ``stream_flush`` is off.
+    pipeline:
+        ``"on"`` (default): depth-2 pipeline — the scheduler
+        dispatches window k+1 while a background streamer thread
+        slices/filters/appends window k (docs/serving.md, "Pipelining
+        & backpressure"). ``"off"``: the synchronous r08 path (every
+        tick blocks on the window's host transfer and sink appends) —
+        the debugging baseline; both produce bitwise-identical
+        results.
+    stream_queue:
+        Pipeline depth bound: at most this many windows may be queued
+        or in processing on the streamer; the scheduler stalls past it
+        (backpressure — bounded memory, bounded reader staleness).
     """
 
     def __init__(
@@ -224,6 +240,9 @@ class SimServer:
         out_dir: Optional[str] = None,
         sink: str = "ram",
         stream_flush: bool = True,
+        flush_every: int = 1,
+        pipeline: str = "on",
+        stream_queue: int = 2,
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -231,6 +250,12 @@ class SimServer:
             raise ValueError(f"unknown sink {sink!r}; known: ram, log")
         if sink == "log" and not out_dir:
             raise ValueError("sink='log' needs out_dir")
+        if pipeline not in ("on", "off"):
+            raise ValueError(
+                f"unknown pipeline {pipeline!r}; known: on, off"
+            )
+        if flush_every < 1:
+            raise ValueError(f"flush_every={flush_every} must be >= 1")
         self.buckets = {
             name: _Bucket(name, dict(cfg or {}))
             for name, cfg in buckets.items()
@@ -243,16 +268,32 @@ class SimServer:
         self.out_dir = out_dir
         self.sink = sink
         self.stream_flush = stream_flush
+        self.flush_every = int(flush_every)
+        self.pipeline = pipeline
+        self._streamer: Optional[Streamer] = (
+            Streamer(max_inflight=int(stream_queue),
+                     metrics=self._metrics)
+            if pipeline == "on"
+            else None
+        )
         self.tickets: Dict[str, Ticket] = {}
         self._results: Dict[str, Any] = {}
+        # per-request stream-completion events (pipelined): set once
+        # the request's last sink append + close landed, so result()
+        # can wait for ONE request instead of draining the whole pipe
+        self._stream_done: Dict[str, threading.Event] = {}
         self._closed = False
 
     @classmethod
     def single_bucket(cls, composite: str, **kwargs) -> "SimServer":
         """Convenience: one bucket named after its composite. Bucket
         knobs (lanes, window, ...) ride ``kwargs``; server knobs
-        (queue_depth, out_dir, sink, stream_flush) are split off."""
-        server_keys = ("queue_depth", "out_dir", "sink", "stream_flush")
+        (queue_depth, out_dir, sink, stream_flush, flush_every,
+        pipeline, stream_queue) are split off."""
+        server_keys = (
+            "queue_depth", "out_dir", "sink", "stream_flush",
+            "flush_every", "pipeline", "stream_queue",
+        )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
         }
@@ -416,9 +457,14 @@ class SimServer:
         """Drop accumulated latency/wait/window samples (counters stay).
         Benchmark hygiene: called after a warmup round so compile-time
         outliers never dilute the measured percentiles."""
+        if self._streamer is not None:
+            self._streamer.drain()  # in-flight windows would re-sample
         self._metrics.latency_seconds.clear()
         self._metrics.wait_seconds.clear()
         self._metrics.window_seconds.clear()
+        self._metrics.stream_samples.clear()
+        self._metrics.stall_seconds = 0.0
+        self._metrics.stalls = 0
 
     def _refresh_gauges(self) -> None:
         self._metrics.queue_depth = len(self.queue)
@@ -433,7 +479,17 @@ class SimServer:
         """The request's streamed trajectory: a stacked timeseries tree
         (ram sink) or the path of its ``.lens`` log (log sink). Partial
         for TIMEOUT/CANCELLED requests — whatever was streamed before
-        retirement."""
+        retirement.
+
+        With the pipeline on, a terminal status can precede the last
+        window's sink appends (bookkeeping runs ahead of streaming), so
+        this waits for THIS request's stream completion first (its
+        per-request event, set by the stream thread after the final
+        append + close) — other requests' windows keep pipelining,
+        which matters to the sweep driver polling results mid-flight.
+        A non-terminal (running) request falls back to a full drain
+        barrier before returning its partial records.
+        """
         t = self._ticket(request_id)
         sink = self._results.get(request_id)
         if sink is None:
@@ -441,6 +497,17 @@ class SimServer:
                 f"request {request_id} ({t.status}) has no result — it "
                 f"was never admitted to a lane"
             )
+        if self._streamer is not None:
+            event = self._stream_done.get(request_id)
+            if event is not None and t.status in (
+                DONE, TIMEOUT, CANCELLED, FAILED
+            ):
+                while not event.wait(0.05):
+                    # surface a parked stream error instead of
+                    # waiting forever on an event it will never set
+                    self._streamer.check()
+            else:
+                self._streamer.drain()
         return sink.timeseries()
 
     def cancel(self, request_id: str) -> str:
@@ -467,7 +534,17 @@ class SimServer:
     def tick(self) -> bool:
         """One scheduler iteration: expire/cancel, admit, run one window
         per occupied bucket, stream, retire. Returns False when the
-        server is fully idle (nothing queued, no lane busy)."""
+        server is fully idle (nothing queued, no lane busy).
+
+        With the pipeline on, "stream" means HAND OFF: the tick
+        dispatches the window, does retire/admit bookkeeping from host
+        mirrors, and enqueues the (already async-copying) trajectory on
+        the background streamer — so the next tick dispatches window
+        k+1 while window k's host work runs off-thread. A stream-thread
+        failure from an earlier tick is raised here, at the top.
+        """
+        if self._streamer is not None:
+            self._streamer.check()
         now = time.perf_counter()
         self._metrics.inc("ticks")
         did_work = False
@@ -529,6 +606,11 @@ class SimServer:
             busy = self.tick()
             ticks += 1
             if not busy and not len(self.queue):
+                # idle = every result fully streamed, not just every
+                # window dispatched: drain the pipeline before
+                # reporting idle (also surfaces stream errors here)
+                if self._streamer is not None:
+                    self._streamer.drain()
                 return ticks
             if max_ticks is not None and ticks >= max_ticks:
                 raise RuntimeError(
@@ -582,6 +664,8 @@ class SimServer:
         t.admitted_at = now
         bucket.assignments[lane] = t
         self._results[t.request_id] = self._make_sink(t)
+        if self._streamer is not None:
+            self._stream_done[t.request_id] = threading.Event()
         self._metrics.inc("admitted")
 
     def _make_sink(self, t: Ticket):
@@ -604,83 +688,174 @@ class SimServer:
                 },
                 "emit": dict(req.emit or {}),
             },
-            stream_flush=self.stream_flush,
+            flush_every=self.flush_every if self.stream_flush else None,
         )
 
     def _run_bucket_window(self, bucket: _Bucket) -> None:
+        """Dispatch one window and route its host work.
+
+        Pipelined (default): start the trajectory's device->host copy,
+        do ALL retire/admit bookkeeping from the host-mirrored
+        counters (no device readback), enqueue the window on the
+        background streamer, and return — the next tick dispatches
+        window k+1 while the streamer slices/appends window k. A
+        retiring hold_state lane is snapshotted ON-DEVICE here (before
+        any reassignment) with the host fetch deferred.
+
+        Synchronous (``pipeline="off"``): the r08 path — one blocking
+        ``device_get``, then inline slicing/appends via the same
+        ``process_window`` the streamer runs, so both modes produce
+        byte-identical sink contents.
+        """
         pool = bucket.pool
+        pipelined = self._streamer is not None
         t0 = time.perf_counter()
         remaining_before, traj = pool.run_window()
-        # ONE device->host transfer for the whole window, shared by
-        # every lane's slicing below (same policy as the run path's
-        # per-segment transfer).
-        host = jax.device_get(traj)
-        wall = time.perf_counter() - t0
         self._metrics.inc("windows")
         self._metrics.inc("lane_windows_busy", len(bucket.assignments))
         self._metrics.inc("lane_windows_total", pool.n_lanes)
-        self._metrics.observe_window(wall)
 
+        if pipelined:
+            copy_tree_to_host_async(traj)
+            host = ready = None
+        else:
+            # ONE device->host transfer for the whole window, shared by
+            # every lane's slicing below (same policy as the run path's
+            # per-segment transfer).
+            host = jax.device_get(traj)
+            ready = time.perf_counter()
+
+        slices: List[LaneSlice] = []
+        retiring = []
         for lane, t in list(bucket.assignments.items()):
             before = int(remaining_before[lane])
-            self._stream_lane(pool, t, lane, before, host)
-            ran = min(before, pool.window_steps)
-            t.steps_done += ran
-            if before <= pool.window_steps:  # horizon elapsed: retire
-                if t.request.hold_state:
-                    # capture the lane's exact final bits BEFORE the
-                    # lane can be reassigned, so a later resubmit
-                    # continues the scenario bitwise
-                    t.final_state = pool.lane_state(lane)
-                del bucket.assignments[lane]
-                self._finish(t, DONE)
-                self._metrics.inc("retired")
+            job = self._lane_slice(pool, t, lane, before)
+            retire = before <= pool.window_steps  # horizon elapsed
+            if job is not None:
+                slices.append(job)
+            elif retire and pipelined:
+                # no rows kept this window, but the sink must still
+                # close AFTER any appends already queued for it
+                job = LaneSlice(
+                    t.request_id, self._results[t.request_id]
+                )
+                slices.append(job)
+            if retire:
+                if pipelined:
+                    # close + completion bookkeeping ride the slice so
+                    # they happen when the records are actually down,
+                    # keeping latency_seconds comparable with the
+                    # synchronous path (status flips DONE now; the
+                    # sample lands at stream completion)
+                    job.close_after = True
+                    job.on_close = self._completion_cb(t)
+                retiring.append((lane, t))
 
-    def _stream_lane(
-        self, pool: LanePool, t: Ticket, lane: int, before: int, host
-    ) -> None:
-        """Slice lane ``lane``'s VALID rows out of the window trajectory
-        and append them to the request's sink. All host-side numpy — the
-        bits are exactly what the device emitted for that lane."""
+        if not pipelined:
+            # append BEFORE retiring: _finish closes sinks inline in
+            # sync mode, and a request's final rows precede its close
+            process_window(host, slices)
+            done = time.perf_counter()
+            self._metrics.observe_window(done - t0)
+            self._metrics.observe_stream(t0, ready, done)
+
+        for lane, t in retiring:
+            if t.request.hold_state:
+                # capture the lane's exact final bits BEFORE the lane
+                # can be reassigned, so a later resubmit continues the
+                # scenario bitwise; pipelined capture stays on-device
+                # (no sync) — admit_state takes the device tree as-is,
+                # host bytes only if a client inspects them
+                t.final_state = (
+                    pool.lane_state_device(lane) if pipelined
+                    else pool.lane_state(lane)
+                )
+            del bucket.assignments[lane]
+            self._finish(t, DONE)
+            self._metrics.inc("retired")
+
+        if pipelined:
+            stall = self._streamer.submit(
+                WindowItem(traj, slices, dispatched_at=t0)
+            )
+            self._metrics.observe_stall(stall)
+            # window wall (dispatch -> trajectory host-side) is
+            # observed by the streamer; the dispatch itself is ~free
+
+    def _lane_slice(
+        self, pool: LanePool, t: Ticket, lane: int, before: int
+    ) -> Optional[LaneSlice]:
+        """Bookkeep one lane's window and build its stream slice (rows
+        kept after the request's ``every`` subsample + path filter), or
+        None if nothing is kept. Host arithmetic only — the scheduler
+        never reads the device. Advances ``t.emit_count`` and
+        ``t.steps_done``."""
         n_valid = pool.valid_emits(before)
-        if n_valid == 0:
-            return
-        every = int((t.request.emit or {}).get("every", 1))
-        # global (request-local) emit indices of this window's rows
-        first = t.emit_count  # 0-based count of rows emitted so far
-        rows = [
-            r for r in range(n_valid) if (first + r + 1) % every == 0
-        ]
-        t.emit_count += n_valid
-        if not rows:
-            return
-        idx = np.asarray(rows)
-        # path-filter BEFORE slicing: the filter is a pure projection,
-        # so it commutes with the row/lane slice below — but applying
-        # it first means the per-lane-per-window host work touches only
-        # the kept leaves (a sweep trial keeps objective-sized slices
-        # of a much wider emit tree)
-        paths = (t.request.emit or {}).get("paths")
-        source = host
-        if paths:
-            source = _filter_paths(host, [str(p) for p in paths])
-            if not source:
-                return
-        tree = jax.tree.map(
-            lambda leaf: np.asarray(leaf)[idx, lane], source
-        )
+        ran = min(before, pool.window_steps)
+        idx = None
+        if n_valid:
+            every = int((t.request.emit or {}).get("every", 1))
+            # global (request-local) emit indices of this window's rows
+            idx = subsample_rows(t.emit_count, n_valid, every)
+            t.emit_count += n_valid
+        if idx is None or not idx.size:
+            t.steps_done += ran
+            return None
         times = (
             t.steps_done + (idx + 1) * pool.emit_every
         ) * pool.timestep
-        self._results[t.request_id].append(tree, times)
+        t.steps_done += ran
+        paths = (t.request.emit or {}).get("paths")
+        return LaneSlice(
+            t.request_id,
+            self._results[t.request_id],
+            lane=lane,
+            idx=idx,
+            times=times,
+            paths=[str(p) for p in paths] if paths else None,
+        )
+
+    def _completion_cb(self, t: Ticket):
+        """Completion bookkeeping for a pipelined DONE request, run by
+        the stream thread after the final append + sink close: stamps
+        the data-available finish time and records the latency sample
+        there, so pipelined percentiles measure when ``result()`` could
+        actually return, not when bookkeeping ran ahead."""
+
+        def done() -> None:
+            t.finished_at = time.perf_counter()
+            if t.admitted_at is not None:
+                self._metrics.observe_request(
+                    t.admitted_at - t.submitted_at,
+                    t.finished_at - t.submitted_at,
+                )
+            ev = self._stream_done.get(t.request_id)
+            if ev is not None:
+                ev.set()
+
+        return done
 
     def _finish(self, t: Ticket, status: str) -> None:
         t.status = status
         t.finished_at = time.perf_counter()
         sink = self._results.get(t.request_id)
+        pipelined_done = self._streamer is not None and status == DONE
         if sink is not None:
-            sink.close()
-        if t.admitted_at is not None:
+            if self._streamer is None:
+                sink.close()
+            elif status != DONE:
+                # cancel/timeout of a RUNNING request: its last window
+                # may still be queued on the streamer — close in FIFO
+                # order so partial records land before the close
+                ev = self._stream_done.get(t.request_id)
+                self._streamer.submit_close(
+                    sink, on_close=ev.set if ev is not None else None
+                )
+            # pipelined DONE: the retiring window's LaneSlice carries
+            # close_after, keeping append->close order per request
+        if t.admitted_at is not None and not pipelined_done:
+            # pipelined DONE latency is observed by _completion_cb at
+            # stream completion instead
             self._metrics.observe_request(
                 t.admitted_at - t.submitted_at,
                 t.finished_at - t.submitted_at,
@@ -689,21 +864,49 @@ class SimServer:
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
+        """Drain and join the streamer thread, close every sink, and
+        write ``server_meta.json`` — in that order, each step running
+        even if an earlier one fails, so a crashed driver can never
+        leak open log handles or lose the metrics sidecar. Idempotent.
+        The first error (a parked stream failure, a sink close) is
+        re-raised AFTER cleanup completes."""
         if self._closed:
             return
         self._closed = True
+        first_error: Optional[BaseException] = None
+        if self._streamer is not None:
+            try:
+                self._streamer.close()
+            except BaseException as e:
+                first_error = e
         for sink in self._results.values():
-            sink.close()
+            try:
+                sink.close()
+            except BaseException as e:
+                first_error = first_error or e
         if self.out_dir:
-            self._refresh_gauges()
-            write_server_meta(
-                self.out_dir,
-                {name: b.cfg for name, b in self.buckets.items()},
-                self._metrics,
-            )
+            try:
+                self._refresh_gauges()
+                write_server_meta(
+                    self.out_dir,
+                    {name: b.cfg for name, b in self.buckets.items()},
+                    self._metrics,
+                )
+            except BaseException as e:
+                # never let a failed meta write mask the root cause
+                first_error = first_error or e
+        if first_error is not None:
+            raise first_error
 
     def __enter__(self) -> "SimServer":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, *exc) -> None:
+        try:
+            self.close()
+        except BaseException:
+            # cleanup errors must not mask the exception already
+            # unwinding through the with-block; surface them only on
+            # the clean-exit path
+            if exc_type is None:
+                raise

@@ -1,0 +1,296 @@
+"""Background window streaming: the host half of the serve pipeline.
+
+``BENCH_SERVE_CPU_r08`` measured the scheduler at 0.83-0.89 of the bare
+``Ensemble`` ceiling at full occupancy, and the gap was all host time
+spent while the device idled: every tick blocked on ``jax.device_get``
+of the window trajectory, then did per-lane slicing, emit filtering,
+and sink appends inline before dispatching the next window. Podracer's
+Sebulba (PAPERS.md) names the fix: keep the device loop hot and move
+host-side data handling off the critical path.
+
+This module is that off-path half. The scheduler dispatches window
+``k+1`` immediately after bookkeeping window ``k`` (retire/admit read
+only the host-mirrored counters — no readback) and hands window ``k``'s
+already-async-copying trajectory to a :class:`Streamer` — ONE daemon
+thread per server draining a bounded queue in FIFO order, so every
+request's records land in order while the device computes ahead.
+
+Contracts:
+
+- **Backpressure.** At most ``max_inflight`` windows may be queued or
+  in processing; ``submit`` blocks the scheduler past that (returned
+  stall seconds feed the metrics). The device can therefore run at most
+  ``max_inflight`` windows ahead of the slowest sink — bounded memory,
+  bounded staleness for tailing readers.
+- **Ordering.** One thread, one FIFO: a request's appends happen in
+  window order, and its sink ``close`` (a :class:`LaneSlice` with
+  ``close_after`` or a bare close item) happens after its last append.
+- **Exception propagation.** A failure on the stream thread (sink I/O,
+  a poisoned device buffer surfacing in ``device_get``) parks the
+  error and stops the thread; the next scheduler call into the
+  streamer (``check`` at tick start, ``submit``, ``drain``) raises it.
+- **Bits.** Everything here is host-side numpy projection of what the
+  device emitted — reordering WHEN it happens cannot change a record's
+  bytes, which is why the solo==co-batched determinism pins hold with
+  the pipeline on (tests/test_streamer.py pins pipelined==sync too).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from lens_tpu.emit.log import SEP
+from lens_tpu.utils.dicts import flatten_paths, set_path
+
+
+def filter_paths(tree: Any, prefixes: List[str]) -> Dict:
+    """Keep leaves whose ``/``-joined path starts with any prefix
+    (component-aligned: prefix ``cell`` matches ``cell/volume``, not
+    ``cells``). Host-side, post-device — a pure projection of the
+    emitted bits, so it can never perturb them."""
+    out: Dict = {}
+    for path, value in flatten_paths(tree):
+        joined = SEP.join(str(p) for p in path)
+        if any(
+            joined == p or joined.startswith(p + SEP) for p in prefixes
+        ):
+            out = set_path(out, path, value)
+    return out
+
+
+def subsample_rows(first_emit: int, n_valid: int, every: int) -> np.ndarray:
+    """Window-local indices of the rows a request's ``every``-k emit
+    spec keeps, given ``first_emit`` rows already emitted before this
+    window. Vectorized arange/modulo — the per-row Python loop it
+    replaces ran O(rows) interpreted work per lane per window on the
+    hot streaming path. ``every < 1`` is a caller bug (``submit``
+    validates requests) — raise rather than silently keeping all."""
+    if every < 1:
+        raise ValueError(f"every={every} must be >= 1")
+    idx = np.arange(n_valid)
+    if every > 1:
+        idx = idx[(first_emit + idx + 1) % every == 0]
+    return idx
+
+
+@dataclass
+class LaneSlice:
+    """One lane's share of a window: which rows to keep, where they go.
+
+    ``idx is None`` marks a close-only slice (a retiring lane whose
+    final window kept no rows, or a cancelled/expired request whose
+    sink must close AFTER its already-queued appends).
+    """
+
+    request_id: str
+    sink: Any
+    lane: int = 0
+    idx: Optional[np.ndarray] = None      # window-local rows to keep
+    times: Optional[np.ndarray] = None    # sim times for those rows
+    paths: Optional[List[str]] = None     # emit path-prefix filter
+    close_after: bool = False             # final slice: close the sink
+    on_close: Optional[Any] = None        # callback after the close
+    # (the scheduler hangs request-completion bookkeeping here so a
+    # pipelined request's latency is measured when its records are
+    # actually available, not when bookkeeping ran ahead)
+
+
+@dataclass
+class WindowItem:
+    """One dispatched window handed to the stream thread: the device
+    trajectory (async host copy already started) plus every occupied
+    lane's slice. ``traj is None`` for pure control items (closes)."""
+
+    traj: Any
+    slices: List[LaneSlice] = field(default_factory=list)
+    dispatched_at: float = 0.0
+
+
+def process_window(host: Any, slices: List[LaneSlice]) -> None:
+    """Apply every slice of one window to its sink, in order. Shared by
+    the stream thread and the ``pipeline="off"`` synchronous path, so
+    both produce byte-identical sink contents."""
+    for s in slices:
+        if s.idx is not None:
+            source = host
+            if s.paths:
+                source = filter_paths(host, s.paths)
+            if source:
+                tree = jax.tree.map(
+                    lambda leaf: np.asarray(leaf)[s.idx, s.lane], source
+                )
+                s.sink.append(tree, s.times)
+        if s.close_after:
+            s.sink.close()
+        if s.on_close is not None:
+            s.on_close()
+
+
+class Streamer:
+    """Bounded-queue background consumer of :class:`WindowItem`\\ s.
+
+    ``max_inflight`` bounds queued + currently-processing REAL windows
+    (close-only control items ride free — they hold no device memory
+    and must never deadlock a shutdown). ``metrics`` (a
+    ``ServerMetrics``) receives per-window stream samples.
+    """
+
+    def __init__(self, max_inflight: int = 2, metrics: Any = None):
+        if max_inflight < 1:
+            raise ValueError(
+                f"max_inflight={max_inflight} must be >= 1"
+            )
+        self.max_inflight = int(max_inflight)
+        self._metrics = metrics
+        self._queue: List[WindowItem] = []
+        self._cond = threading.Condition()
+        self._inflight = 0  # real windows queued or being processed
+        self._busy = False  # an item popped but not yet finished
+        self._prev_done = None  # previous window's streamed_at
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- scheduler-side surface ---------------------------------------------
+
+    def check(self) -> None:
+        """Raise a stream-thread failure into the caller (the scheduler
+        calls this at every tick)."""
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+
+    def submit(self, item: WindowItem) -> float:
+        """Enqueue a window; BLOCKS while ``max_inflight`` windows are
+        already queued/processing (the pipeline's backpressure: the
+        scheduler — and therefore the device — stalls instead of racing
+        ahead of the slowest sink). Returns seconds stalled."""
+        stalled = 0.0
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+            if self._stop:
+                # fail fast: the thread is (being) joined, so a queued
+                # item would never drain — blocking here is a silent
+                # deadlock for a caller ticking a closed server
+                raise RuntimeError(
+                    "streamer is closed; the server was shut down"
+                )
+            real = item.traj is not None
+            if real and self._inflight >= self.max_inflight:
+                t0 = time.perf_counter()
+                self._cond.wait_for(
+                    lambda: self._inflight < self.max_inflight
+                    or self._error is not None
+                    or self._stop
+                )
+                stalled = time.perf_counter() - t0
+                if self._error is not None:
+                    raise self._error
+                if self._stop:
+                    # close() raced the stall: enqueueing now would
+                    # silently drop the item (nothing will process it)
+                    raise RuntimeError(
+                        "streamer is closed; the server was shut down"
+                    )
+            if real:
+                self._inflight += 1
+            self._queue.append(item)
+            self._cond.notify_all()
+        return stalled
+
+    def submit_close(self, sink: Any, on_close: Any = None) -> None:
+        """Queue a sink close behind everything already queued (a
+        cancelled/expired request's ordered shutdown). ``on_close``
+        runs after the close — completion signalling."""
+        self.submit(
+            WindowItem(
+                traj=None,
+                slices=[LaneSlice(
+                    "", sink, close_after=True, on_close=on_close
+                )],
+            )
+        )
+
+    def drain(self) -> None:
+        """Block until every queued item is fully processed; raise any
+        stream-thread failure. The barrier ``result()``,
+        ``run_until_idle()``, and ``close()`` sit behind."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: (not self._queue and self._inflight == 0
+                         and not self._busy)
+                or self._error is not None
+            )
+            if self._error is not None:
+                raise self._error
+
+    def close(self) -> None:
+        """Drain, stop, and join the stream thread. Raises a parked
+        stream error after the thread is down (cleanup first)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
+        self.check()
+
+    # -- stream thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._queue or self._stop)
+                if not self._queue:
+                    return  # stopped and drained
+                item = self._queue.pop(0)
+                self._busy = True
+            try:
+                self._process(item)
+            except BaseException as e:
+                with self._cond:
+                    # Park the error and stop: appending LATER windows
+                    # after a dropped one would tear request streams.
+                    self._error = e
+                    self._queue.clear()
+                    self._inflight = 0
+                    self._busy = False
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                if item.traj is not None:
+                    self._inflight -= 1
+                self._busy = False
+                self._cond.notify_all()
+
+    def _process(self, item: WindowItem) -> None:
+        host = None
+        if item.traj is not None:
+            # waits for compute + the async copy started at dispatch
+            host = jax.device_get(item.traj)
+        ready = time.perf_counter()
+        process_window(host, item.slices)
+        if item.traj is not None:
+            done = time.perf_counter()
+            if self._metrics is not None:
+                self._metrics.observe_stream(
+                    item.dispatched_at, ready, done
+                )
+                # keep avg_window_seconds (the retry-after pacing unit)
+                # meaningful under the pipeline: the incremental wall
+                # per window through the WHOLE pipe in steady state —
+                # max(device, host) per window — which is exactly the
+                # rate the backlog drains at. (dispatch -> ready alone
+                # would double-count queue wait behind earlier
+                # windows' host work when the streamer is backlogged.)
+                start = item.dispatched_at
+                if self._prev_done is not None:
+                    start = max(start, self._prev_done)
+                self._metrics.observe_window(done - start)
+            self._prev_done = done

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import math
 import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
@@ -533,6 +534,7 @@ class _EnsembleSweep:
 
         from lens_tpu.colony.ensemble import Ensemble
         from lens_tpu.experiment import build_model
+        from lens_tpu.utils.hostio import copy_tree_to_host_async
 
         spec, ledger = self.spec, self.ledger
         steps = int(round(float(spec.horizon) / spec.timestep))
@@ -564,32 +566,11 @@ class _EnsembleSweep:
         runners: Dict[int, Any] = {}  # chunk size -> jitted program
         ts_by_trial: Dict[int, Dict] = {}
         windows = 0
-        for chunk in chunks:
-            if all(ledger.terminal(t.index) for t in chunk):
-                continue
-            n = len(chunk)
-            ens = Ensemble(sim, n)
-            keys = jnp.stack(
-                [jax.random.PRNGKey(t.seed) for t in chunk]
-            )
-            rep = stack_overrides(chunk) if chunk[0].params else None
-            states = ens.initial_state(
-                spec.n_agents, keys=keys, replicate_overrides=rep
-            )
-            runner = runners.get(n)
-            if runner is None:
-                runner = jax.jit(
-                    lambda s, e=ens: e.run(
-                        s,
-                        float(spec.horizon),
-                        spec.timestep,
-                        emit_every=spec.emit_every,
-                    )
-                )
-                runners[n] = runner
-            _, traj = runner(states)
+
+        def score_chunk(chunk, traj) -> None:
+            # blocking fetch (the async copy started at dispatch) +
+            # per-trial slicing, ledger appends, callbacks — all host
             host = jax.device_get(traj)
-            windows += 1
             for r, t in enumerate(chunk):
                 ts = jax.tree.map(lambda x: np.asarray(x)[:, r], host)
                 ts["__times__"] = times
@@ -607,6 +588,64 @@ class _EnsembleSweep:
                 ledger.append(event)
                 if on_trial is not None:
                     on_trial(t.index, event)
+
+        # Depth-2 pipeline over chunks (the serve path's policy, via
+        # the same utils.hostio helper): dispatch chunk k+1 and start
+        # its trajectory's host copy BEFORE scoring chunk k, so chunk
+        # k's host-side slicing/objective/ledger work overlaps chunk
+        # k+1's device compute. Purely a reordering of host work —
+        # each chunk's program and bits are untouched, so resumed ==
+        # uninterrupted still holds, and a crash between dispatch and
+        # scoring just leaves the chunk unfinished in the ledger
+        # (re-run whole, the existing resume unit).
+        pending = None  # (chunk, traj) dispatched but not yet scored
+        try:
+            for chunk in chunks:
+                if all(ledger.terminal(t.index) for t in chunk):
+                    continue
+                n = len(chunk)
+                ens = Ensemble(sim, n)
+                keys = jnp.stack(
+                    [jax.random.PRNGKey(t.seed) for t in chunk]
+                )
+                rep = stack_overrides(chunk) if chunk[0].params else None
+                states = ens.initial_state(
+                    spec.n_agents, keys=keys, replicate_overrides=rep
+                )
+                runner = runners.get(n)
+                if runner is None:
+                    runner = jax.jit(
+                        lambda s, e=ens: e.run(
+                            s,
+                            float(spec.horizon),
+                            spec.timestep,
+                            emit_every=spec.emit_every,
+                        )
+                    )
+                    runners[n] = runner
+                _, traj = runner(states)
+                copy_tree_to_host_async(traj)
+                windows += 1
+                if pending is not None:
+                    done, pending = pending, None
+                    score_chunk(*done)
+                pending = (chunk, traj)
+        finally:
+            # score the trailing in-flight chunk even if a later
+            # dispatch raised — its results are real and its ledger
+            # events keep the resume honest
+            if pending is not None:
+                if sys.exc_info()[0] is None:
+                    score_chunk(*pending)
+                else:
+                    # already unwinding (device likely unhealthy):
+                    # best-effort score, but never let a secondary
+                    # failure here mask the root-cause exception —
+                    # the chunk just stays unfinished in the ledger
+                    try:
+                        score_chunk(*pending)
+                    except BaseException:
+                        pass
         return ts_by_trial, {
             "backend": "ensemble",
             "batch": self.batch,

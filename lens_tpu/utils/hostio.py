@@ -1,0 +1,40 @@
+"""Async device->host transfer helpers shared by every segment loop.
+
+Three places move emitted trajectories off the device while the next
+chunk of compute is already in flight — the ``Experiment`` segment
+loop, the serve layer's window streamer (``lens_tpu.serve.streamer``),
+and the sweep ensemble backend's chunk loop. They all want the same
+two-step dance:
+
+1. :func:`copy_tree_to_host_async` right after dispatching the NEXT
+   device program — every leaf starts its DMA immediately, so the
+   transfer rides alongside the in-flight compute instead of after it;
+2. a later ``jax.device_get`` (or numpy coercion) that finds the bytes
+   already host-side and returns without a device round-trip.
+
+Keeping the helper in one place pins the policy: the async copy is a
+pure hint (arrays without ``copy_to_host_async`` — numpy leaves,
+older jax — are silently fine), and it never changes bits, only WHEN
+the transfer happens.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def copy_tree_to_host_async(tree: Any) -> Any:
+    """Start a device->host copy of every array leaf; returns ``tree``
+    unchanged (the handles still resolve via ``jax.device_get``).
+
+    Safe on any pytree: leaves lacking ``copy_to_host_async`` (numpy
+    arrays, scalars) are skipped. Callers dispatch their next device
+    program FIRST, then call this, then do host work — the eventual
+    ``device_get`` overlaps both.
+    """
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+    return tree

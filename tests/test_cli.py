@@ -66,8 +66,24 @@ class TestParsing:
         )
         assert args.command == "serve"
         assert (args.lanes, args.window, args.queue_depth) == (8, 16, 7)
+        # pipeline knobs default on with depth 2, per-window flush
+        assert (args.pipeline, args.stream_queue, args.flush_every) \
+            == ("on", 2, 1)
         with pytest.raises(SystemExit):  # --requests is required
             _build_parser().parse_args(["serve"])
+
+    def test_serve_pipeline_args(self):
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json", "--pipeline", "off",
+             "--stream-queue", "4", "--flush-every", "8"]
+        )
+        assert args.pipeline == "off"
+        assert args.stream_queue == 4
+        assert args.flush_every == 8
+        with pytest.raises(SystemExit):  # only on|off
+            _build_parser().parse_args(
+                ["serve", "--requests", "r.json", "--pipeline", "maybe"]
+            )
 
     def test_sweep_args(self):
         args = _build_parser().parse_args(
@@ -127,6 +143,24 @@ class TestServeCommand:
         assert os.path.exists(os.path.join(out, "server_meta.json"))
         lens = [f for f in os.listdir(out) if f.endswith(".lens")]
         assert len(lens) == 2
+        # the pipelined default surfaces its gauges in the summary
+        assert "device_busy=" in printed
+
+    def test_serve_smoke_pipeline_off(self, tmp_path, capsys):
+        """The synchronous knob serves the same request list and writes
+        the same artifacts (the debugging path stays usable end to
+        end)."""
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps([{"seed": 1, "horizon": 8.0}]))
+        out = str(tmp_path / "served_sync")
+        rc = main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4", "--pipeline", "off",
+            "--requests", str(reqs), "--out-dir", out,
+        ])
+        assert rc == 0
+        assert "served 1 requests" in capsys.readouterr().out
+        assert os.path.exists(os.path.join(out, "server_meta.json"))
 
 
 class TestSweepCommand:

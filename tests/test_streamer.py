@@ -1,0 +1,484 @@
+"""The round-10 serve pipeline: background streaming, batched flush,
+deferred hold_state, and the pins that keep it honest.
+
+The load-bearing claims, in this repo's bitwise culture:
+
+- pipelined == synchronous == solo, BITWISE, including the stochastic
+  tau-leap composite — the pipeline reorders WHEN host work happens,
+  never what bits it projects;
+- a tailing reader under the batched-flush writer still sees only
+  whole frames and resumes across a torn trailing frame;
+- backpressure really stalls the scheduler (bounded staleness), and a
+  stream-thread failure really surfaces in ``tick()``;
+- ``close()`` drains/joins the streamer and writes ``server_meta.json``
+  even when the driver is unwinding an exception.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lens_tpu.emit import LogEmitter
+from lens_tpu.emit.log import (
+    FramedWriter,
+    encode_record,
+    frame,
+    read_experiment,
+    tail_records,
+)
+from lens_tpu.serve import ScenarioRequest, SimServer
+from lens_tpu.serve.streamer import (
+    LaneSlice,
+    Streamer,
+    WindowItem,
+    subsample_rows,
+)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def _serve_one(submissions, target_seed, composite="toggle_colony",
+               **kw):
+    kw.setdefault("lanes", 4)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    srv = SimServer.single_bucket(composite, **kw)
+    target = None
+    for sub in submissions:
+        rid = srv.submit(ScenarioRequest(composite=composite, **sub))
+        if sub.get("seed") == target_seed:
+            target = rid
+    srv.run_until_idle(max_ticks=300)
+    out = srv.result(target)
+    srv.close()
+    return out
+
+
+class TestSubsampleRows:
+    def test_matches_the_replaced_python_loop(self):
+        for first in (0, 1, 3, 7, 40):
+            for n_valid in (0, 1, 5, 8, 33):
+                for every in (1, 2, 3, 4, 7):
+                    ref = [
+                        r for r in range(n_valid)
+                        if (first + r + 1) % every == 0
+                    ]
+                    got = subsample_rows(first, n_valid, every)
+                    np.testing.assert_array_equal(got, ref)
+
+
+class TestPipelinedParity:
+    """solo == co-batched == pipelined, bitwise — the r10 contract."""
+
+    def test_pipelined_equals_sync_stochastic_cobatch(self):
+        """hybrid_cell (tau-leap Gillespie): the composite where any
+        pipeline-induced reordering of device work would show."""
+        subs = [
+            {"seed": 7, "horizon": 8.0},
+            {"seed": 3, "horizon": 24.0},
+            {"seed": 11, "horizon": 40.0},
+            {"seed": 5, "horizon": 16.0},
+        ]
+        piped = _serve_one(
+            subs, 3, composite="hybrid_cell", pipeline="on"
+        )
+        sync = _serve_one(
+            subs, 3, composite="hybrid_cell", pipeline="off"
+        )
+        solo = _serve_one(
+            [{"seed": 3, "horizon": 24.0}], 3,
+            composite="hybrid_cell", pipeline="on",
+        )
+        assert _leaves_equal(piped, sync)
+        assert _leaves_equal(piped, solo)
+
+    def test_pipelined_emit_spec_parity(self):
+        """Path filter + every-k subsample run on the stream thread;
+        bits and row selection must match the synchronous path."""
+        sub = {
+            "seed": 2, "horizon": 24.0,
+            "emit": {"paths": ["global"], "every": 4},
+        }
+        piped = _serve_one([sub], 2, pipeline="on")
+        sync = _serve_one([sub], 2, pipeline="off")
+        np.testing.assert_array_equal(
+            piped["__times__"], [4.0, 8.0, 12.0, 16.0, 20.0, 24.0]
+        )
+        assert _leaves_equal(piped, sync)
+
+    def test_pipelined_resubmit_chain_stays_bitwise(self):
+        """Deferred (device-side) hold_state capture: a pipelined
+        resubmit chain must equal one long request, and must also
+        equal the synchronous chain's bits."""
+
+        def chain(pipeline):
+            srv = SimServer.single_bucket(
+                "hybrid_cell", lanes=4, window=8, capacity=16,
+                pipeline=pipeline,
+            )
+            one_shot = srv.submit(ScenarioRequest(
+                composite="hybrid_cell", seed=3, horizon=24.0
+            ))
+            rid = srv.submit(ScenarioRequest(
+                composite="hybrid_cell", seed=3, horizon=8.0,
+                hold_state=True,
+            ))
+            srv.run_until_idle(max_ticks=300)
+            parts = [srv.result(rid)]
+            for _ in range(2):
+                rid = srv.resubmit(rid, extra_horizon=8.0)
+                srv.run_until_idle(max_ticks=300)
+                parts.append(srv.result(rid))
+            stitched = jax.tree.map(
+                lambda *xs: np.concatenate(
+                    [np.asarray(x) for x in xs]
+                ),
+                *parts,
+            )
+            ref = srv.result(one_shot)
+            srv.close()
+            return stitched, ref
+
+        piped, piped_ref = chain("on")
+        sync, _ = chain("off")
+        assert _leaves_equal(piped, piped_ref)
+        assert _leaves_equal(piped, sync)
+
+    def test_pipelined_log_sink_equals_sync_log_sink(self, tmp_path):
+        """The full disk path: segments written by the stream thread
+        through the batched-flush emitter decode to the same records."""
+
+        def run(pipeline, sub):
+            out = str(tmp_path / pipeline)
+            srv = SimServer.single_bucket(
+                "toggle_colony", lanes=2, window=4, capacity=16,
+                out_dir=out, sink="log", pipeline=pipeline,
+            )
+            rid = srv.submit(ScenarioRequest(
+                composite="toggle_colony", **sub
+            ))
+            srv.run_until_idle(max_ticks=100)
+            path = srv.status(rid)["result_path"]
+            srv.close()
+            return read_experiment(path)
+
+        sub = {"seed": 5, "horizon": 16.0}
+        header_p, recs_p = run("on", sub)
+        header_s, recs_s = run("off", sub)
+        assert header_p["config"]["seed"] == header_s["config"]["seed"]
+        assert len(recs_p) == len(recs_s) == 16
+        for rp, rs in zip(recs_p, recs_s):
+            assert _leaves_equal(rp, rs)
+
+
+class TestBatchedFlushWriter:
+    def _record(self, i):
+        return {"x": np.arange(4) + i, "i": np.asarray(i)}
+
+    def test_reader_while_writer_sees_only_whole_frames(self, tmp_path):
+        """A tailing reader racing the background batched-flush writer
+        must only ever observe complete frames, in order, and end with
+        all of them."""
+        p = str(tmp_path / "log.lens")
+        w = FramedWriter(p, flush_every=3)
+        n = 50
+        seen = []
+        offset = 0
+        stop = threading.Event()
+
+        def tail_loop():
+            nonlocal offset
+            while not stop.is_set():
+                recs, offset = tail_records(p, offset)
+                seen.extend(recs)
+
+        reader = threading.Thread(target=tail_loop)
+        reader.start()
+        for i in range(n):
+            w.write(encode_record(self._record(i)))
+        w.close()
+        stop.set()
+        reader.join()
+        recs, offset = tail_records(p, offset)
+        seen.extend(recs)
+        assert [int(r["i"]) for r in seen] == list(range(n))
+
+    def test_tail_resumes_across_torn_trailing_frame(self, tmp_path):
+        """Batched flush can leave a torn tail on crash; the reader
+        stops at the last whole frame and resumes once the tail
+        completes — never a duplicate, never a skip."""
+        p = str(tmp_path / "log.lens")
+        w = FramedWriter(p, flush_every=2)
+        for i in range(3):
+            w.write(encode_record(self._record(i)))
+        w.close()
+        torn = frame(encode_record(self._record(3)))
+        with open(p, "ab") as f:
+            f.write(torn[: len(torn) // 2])
+        recs, off = tail_records(p, 0)
+        assert [int(r["i"]) for r in recs] == [0, 1, 2]
+        with open(p, "ab") as f:
+            f.write(torn[len(torn) // 2:])
+        recs, off = tail_records(p, off)
+        assert [int(r["i"]) for r in recs] == [3]
+
+    def test_log_emitter_flush_every_visibility(self, tmp_path):
+        """LogEmitter(flush_every=k): after k records land, a reader
+        sees them without any explicit flush call."""
+        p = str(tmp_path / "e.lens")
+        em = LogEmitter(
+            experiment_id="x", path=p, native=False, flush_every=2
+        )
+        em.emit({"v": np.asarray(1)})  # header + 1 record = 2 frames
+        deadline = time.time() + 5.0
+        recs = []
+        while time.time() < deadline and len(recs) < 2:
+            recs, _ = tail_records(p, 0)
+        assert len(recs) == 2  # header + the record, whole frames only
+        em.close()
+
+    def test_byte_capped_queue_backpressure_still_writes_all(
+        self, tmp_path
+    ):
+        """A cap smaller than one frame forces the producer through
+        the backpressure wait on every write (serialized to the
+        writer thread); every frame must still land, in order — and a
+        frame larger than the cap must not deadlock."""
+        p = str(tmp_path / "cap.lens")
+        w = FramedWriter(p, flush_every=1, max_queue_bytes=64)
+        n = 20
+        for i in range(n):
+            w.write(encode_record(self._record(i)))
+        w.close()
+        recs, _ = tail_records(p, 0)
+        assert [int(r["i"]) for r in recs] == list(range(n))
+
+    def test_framed_writer_validates(self, tmp_path):
+        with pytest.raises(ValueError, match="flush_every"):
+            FramedWriter(str(tmp_path / "x.lens"), flush_every=0)
+        with pytest.raises(ValueError, match="max_queue_bytes"):
+            FramedWriter(str(tmp_path / "z.lens"), max_queue_bytes=0)
+        with pytest.raises(ValueError, match="flush_every"):
+            LogEmitter(path=str(tmp_path / "y.lens"), native=False,
+                       flush_every=0)
+
+
+class _SlowSink:
+    def __init__(self, delay=0.05):
+        self.delay = delay
+        self.appended = 0
+        self.closed = False
+
+    def append(self, tree, times):
+        time.sleep(self.delay)
+        self.appended += 1
+
+    def close(self):
+        self.closed = True
+
+
+class _BoomSink(_SlowSink):
+    def append(self, tree, times):
+        raise IOError("disk on fire")
+
+
+class TestStreamerMechanics:
+    def _item(self, sink, close_after=False):
+        return WindowItem(
+            traj={"x": np.zeros((2, 1, 1))},
+            slices=[LaneSlice(
+                "r", sink, lane=0, idx=np.arange(2),
+                times=np.arange(2.0), close_after=close_after,
+            )],
+            dispatched_at=time.perf_counter(),
+        )
+
+    def test_backpressure_stalls_submit(self):
+        s = Streamer(max_inflight=1)
+        sink = _SlowSink(delay=0.15)
+        assert s.submit(self._item(sink)) == 0.0
+        stalled = s.submit(self._item(sink))  # queue full: must wait
+        assert stalled > 0.0
+        s.drain()
+        assert sink.appended == 2
+        s.close()
+
+    def test_error_propagates_and_streamer_stops(self):
+        s = Streamer(max_inflight=2)
+        s.submit(self._item(_BoomSink()))
+        with pytest.raises(IOError, match="disk on fire"):
+            s.drain()
+        with pytest.raises(IOError):
+            s.submit(self._item(_SlowSink()))
+        with pytest.raises(IOError):
+            s.close()
+
+    def test_close_order_appends_before_close(self):
+        s = Streamer(max_inflight=2)
+        sink = _SlowSink(delay=0.02)
+        s.submit(self._item(sink))
+        s.submit_close(sink)
+        s.drain()
+        assert sink.appended == 1 and sink.closed
+        s.close()
+
+    def test_streamer_validates(self):
+        with pytest.raises(ValueError, match="max_inflight"):
+            Streamer(max_inflight=0)
+
+
+class TestServerPipelineLifecycle:
+    def test_sink_error_surfaces_in_tick(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=1, window=4, capacity=16
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0
+        ))
+        srv.tick()  # admit + window 1 handed to the streamer
+        if srv._streamer is not None:
+            srv._streamer.drain()
+
+        def boom(tree, times):
+            raise IOError("sink exploded")
+
+        srv._results[rid].append = boom
+        with pytest.raises(IOError, match="sink exploded"):
+            # window 2 streams on the background thread; the failure
+            # must surface in the scheduler loop, not vanish
+            for _ in range(50):
+                srv.tick()
+                time.sleep(0.01)
+        with pytest.raises(IOError, match="sink exploded"):
+            srv.close()
+
+    def test_close_writes_meta_on_exception_path(self, tmp_path):
+        """A driver unwinding an exception mid-serve must still get
+        drained sinks + server_meta.json from the context manager."""
+        out = str(tmp_path / "serve")
+        with pytest.raises(RuntimeError, match="driver crashed"):
+            with SimServer.single_bucket(
+                "toggle_colony", lanes=2, window=4, capacity=16,
+                out_dir=out, sink="log",
+            ) as srv:
+                rid = srv.submit(ScenarioRequest(
+                    composite="toggle_colony", seed=1, horizon=8.0
+                ))
+                srv.tick()
+                raise RuntimeError("driver crashed")
+        meta_path = os.path.join(out, "server_meta.json")
+        assert os.path.exists(meta_path)
+        with open(meta_path) as f:
+            meta = json.load(f)
+        assert meta["counters"]["submitted"] == 1
+        # the request's log is complete and closed: whole frames only
+        path = os.path.join(out, f"{rid}.lens")
+        header, _ = read_experiment(path)
+        assert header["config"]["seed"] == 1
+
+    def test_close_is_idempotent_and_joins(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=1, window=4, capacity=16
+        )
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        srv.run_until_idle(max_ticks=50)
+        thread = srv._streamer._thread
+        srv.close()
+        srv.close()
+        assert not thread.is_alive()
+
+    def test_result_midflight_is_complete_per_request(self):
+        """result() of a DONE request must return ALL its records even
+        while another request is still running/streaming — the
+        per-request completion wait, not a whole-pipe drain."""
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=2, window=4, capacity=16
+        )
+        short = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        long = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=64.0
+        ))
+        for _ in range(200):
+            srv.tick()
+            if srv.status(short)["status"] == "done":
+                break
+        ts = srv.result(short)  # long may still be mid-flight
+        assert len(ts["__times__"]) == 8
+        srv.run_until_idle(max_ticks=200)
+        assert len(srv.result(long)["__times__"]) == 64
+        srv.close()
+
+    def test_tick_after_close_fails_fast(self):
+        """Driving a closed server must raise, not deadlock on the
+        joined streamer thread's full queue."""
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=1, window=4, capacity=16
+        )
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        srv.run_until_idle(max_ticks=50)
+        srv.close()
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=8.0
+        ))
+        with pytest.raises(RuntimeError, match="closed"):
+            for _ in range(10):
+                srv.tick()
+
+    def test_pipeline_metrics_gauges_populate(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=2, window=4, capacity=16
+        )
+        for s in range(4):
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=16.0
+            ))
+        srv.run_until_idle(max_ticks=100)
+        snap = srv.metrics()
+        assert 0.0 < snap["device_busy_fraction"] <= 1.0
+        assert snap["stream_lag_seconds"]["p50"] is not None
+        assert snap["host_gap_seconds"]["p50"] is not None
+        assert snap["stream_stall_seconds"] >= 0.0
+        assert snap["retraces"] == 0
+        srv.close()
+
+    def test_pipeline_off_has_no_streamer_thread(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", lanes=1, window=4, capacity=16,
+            pipeline="off",
+        )
+        assert srv._streamer is None
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        srv.run_until_idle(max_ticks=50)
+        assert srv.status(rid)["status"] == "done"
+        # sync mode still feeds the stream gauges (same accounting)
+        assert srv.metrics()["device_busy_fraction"] is not None
+        srv.close()
+
+    def test_server_validates_pipeline_knobs(self):
+        with pytest.raises(ValueError, match="pipeline"):
+            SimServer.single_bucket(
+                "toggle_colony", capacity=16, pipeline="maybe"
+            )
+        with pytest.raises(ValueError, match="flush_every"):
+            SimServer.single_bucket(
+                "toggle_colony", capacity=16, flush_every=0
+            )
