@@ -21,6 +21,15 @@ wraps. Three kinds of record, written to ``BENCH_SERVE_CPU_r10.json``
    p50/p95/p99 request latency + queue wait per load, plus reject
    counts at the bounded queue — the latency-under-load curve a
    capacity planner reads.
+3. **Prefix-fork A/B** (``--prefix`` mode, round 11, written to
+   ``BENCH_FORK_CPU_r11.json``): N requests sharing a
+   ``prefix_frac``-of-horizon scenario prefix, served cached (one
+   coalesced prefix run + N forked suffixes through the snapshot
+   store) vs uncached (every request re-simulates from t=0) —
+   interleaved min-of-reps, a fresh prefix seed per rep so every
+   cached round pays exactly one prefix run. Plus a warmup-sharing
+   sweep A/B: the same trial list through ``lens_tpu.sweep`` with and
+   without the spec's ``warmup`` block.
 
 Composite: ``toggle_colony`` (config-1 cell; deterministic, light
 biology) — the point is to measure the SERVING machinery, not the
@@ -230,6 +239,255 @@ def offered_load(
     }
 
 
+def _prefix_counters(snap, base=None):
+    """Prefix counters as deltas over a post-warmup ``base`` snapshot —
+    counters survive ``reset_samples()``, so without the baseline the
+    warmup fork's miss would contradict the recorded protocol."""
+    c = snap["counters"]
+    b = base["counters"] if base else {}
+    return {
+        "hits": c["prefix_hits"] - b.get("prefix_hits", 0),
+        "misses": c["prefix_misses"] - b.get("prefix_misses", 0),
+        "coalesced": (
+            c["prefix_coalesced"] - b.get("prefix_coalesced", 0)
+        ),
+        "forks": c["prefix_forks"] - b.get("prefix_forks", 0),
+        "evictions": (
+            c["snapshot_evictions"] - b.get("snapshot_evictions", 0)
+        ),
+    }
+
+
+def fork_ab(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, prefix_steps: int, n: int,
+    reps: int,
+):
+    """Interleaved cached-vs-uncached fork A/B at one lane count.
+
+    Cached round: ``n`` requests share one (seed, prefix) — exactly one
+    prefix run (miss + n-1 coalesced) plus ``n`` forked suffixes, a
+    fresh seed per rep so no rep inherits an earlier rep's snapshot.
+    Uncached round: the same ``n`` requests without the prefix
+    declaration — every one simulates its full horizon from t=0. Both
+    rounds run on ONE warmed server (same compiled programs), walls are
+    min-of-reps, and the floor ratio (prefix windows + suffix rounds,
+    over full rounds) is reported beside the measurement.
+    """
+    srv = _make_server(
+        composite, capacity, lanes, window, emit_every,
+        queue_depth=max(4 * n, 16), pipeline="on",
+    )
+    _warm(srv, composite, lanes, window)
+    # warm the fork path too: the fork-admit program (per override
+    # structure) and the prefix machinery compile outside timing
+    warm_rid = srv.submit(ScenarioRequest(
+        composite=composite, seed=90_000,
+        horizon=float(2 * window),
+        prefix={"horizon": float(window)},
+        overrides={"global": {"volume": 1.01}},
+    ))
+    srv.run_until_idle(max_ticks=1000)
+    assert srv.status(warm_rid)["status"] == "done"
+    srv.reset_samples()
+    base = srv.metrics()
+
+    def round_requests(seed0, with_prefix):
+        return [
+            ScenarioRequest(
+                composite=composite,
+                seed=seed0,
+                horizon=float(horizon_steps),
+                prefix=(
+                    {"horizon": float(prefix_steps)}
+                    if with_prefix else None
+                ),
+                overrides={"global": {"volume": 1.0 + 0.001 * i}},
+            )
+            for i in range(n)
+        ]
+
+    def run_round(requests):
+        t0 = time.perf_counter()
+        ids = [srv.submit(r) for r in requests]
+        srv.run_until_idle(max_ticks=100_000)
+        wall = time.perf_counter() - t0
+        assert all(srv.status(r)["status"] == "done" for r in ids)
+        return wall
+
+    cached = uncached = float("inf")
+    for rep in range(reps):
+        seed0 = 50_000 + rep  # fresh prefix per rep: 1 miss, n-1 coalesced
+        uncached = min(uncached, run_round(round_requests(seed0, False)))
+        cached = min(cached, run_round(round_requests(seed0, True)))
+    snap = srv.metrics()
+    srv.close()
+    suffix_steps = horizon_steps - prefix_steps
+    rounds = -(-n // lanes)  # requests per lane-round, ceil
+    floor = (
+        (prefix_steps + rounds * suffix_steps)
+        / (rounds * horizon_steps)
+    )
+    return {
+        "lanes": lanes,
+        "n_requests": n,
+        "horizon_steps": horizon_steps,
+        "prefix_steps": prefix_steps,
+        "prefix_frac": round(prefix_steps / horizon_steps, 4),
+        "uncached_wall_s": round(uncached, 4),
+        "cached_wall_s": round(cached, 4),
+        "cached_over_uncached": round(cached / uncached, 4),
+        "floor_ratio": round(floor, 4),
+        "counters": _prefix_counters(snap, base),
+        "retraces": snap["retraces"],
+    }
+
+
+def warmup_sweep_ab(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, warmup_steps: int,
+    n_trials: int, reps: int,
+):
+    """The sweep-layer claim: trials/s with the spec ``warmup`` block
+    (every trial forks one warmed snapshot) vs the r09 path (every
+    trial simulates its full horizon). Interleaved min-of-reps on one
+    warmed server; a fresh warmup seed per rep keeps each warm rep
+    honest (exactly one prefix run per sweep)."""
+    from lens_tpu.sweep import run_sweep
+
+    def spec(warm_seed=None):
+        out = {
+            "composite": composite,
+            "space": {
+                "kind": "random",
+                "n_trials": n_trials,
+                "params": {
+                    "global/volume": {"low": 0.8, "high": 1.3},
+                },
+            },
+            "seed": 0,
+            "horizon": float(horizon_steps),
+            "emit_every": emit_every,
+            "capacity": capacity,
+            "objective": {
+                "path": "global/volume",
+                "reduction": "final_live_sum",
+                "mode": "max",
+            },
+            "backend": {"kind": "server"},
+        }
+        if warm_seed is not None:
+            out["warmup"] = {
+                "horizon": float(warmup_steps), "seed": warm_seed,
+            }
+        return out
+
+    srv = SimServer.single_bucket(
+        composite, capacity=capacity, lanes=lanes, window=window,
+        emit_every=emit_every,
+        queue_depth=max(4 * lanes, 2 * n_trials),
+    )
+    _warm(srv, composite, lanes, window)
+    # compile the warm path (solo builder for the override structure,
+    # fork admit, prefix run) outside every timed phase — on the SAME
+    # server the timed reps use: the compiled programs live per
+    # LanePool, so a throwaway server would warm nothing
+    run_sweep(spec(warm_seed=1), server=srv)
+    run_sweep(spec(), server=srv)
+    srv.reset_samples()
+    base0 = srv.metrics()["counters"]["prefix_misses"]
+
+    def timed(s):
+        t0 = time.perf_counter()
+        result = run_sweep(s, server=srv)
+        wall = time.perf_counter() - t0
+        assert all(r["status"] == "done" for r in result.table)
+        return wall
+
+    nowarm = warm = float("inf")
+    for rep in range(reps):
+        nowarm = min(nowarm, timed(spec()))
+        warm = min(warm, timed(spec(warm_seed=7_000 + rep)))
+    snap = srv.metrics()
+    srv.close()
+    return {
+        "n_trials": n_trials,
+        "lanes": lanes,
+        "horizon_steps": horizon_steps,
+        "warmup_steps": warmup_steps,
+        "nowarm_wall_s": round(nowarm, 4),
+        "warm_wall_s": round(warm, 4),
+        "nowarm_trials_per_s": round(n_trials / nowarm, 3),
+        "warm_trials_per_s": round(n_trials / warm, 3),
+        "speedup": round(nowarm / warm, 3),
+        "prefix_misses_measured": (
+            snap["counters"]["prefix_misses"] - base0
+        ),
+        "retraces": snap["retraces"],
+    }
+
+
+def run_prefix_bench(args) -> int:
+    horizon_steps = args.horizon_windows * args.window
+    prefix_windows = int(round(args.prefix_frac * args.horizon_windows))
+    if not 0 < prefix_windows < args.horizon_windows:
+        raise SystemExit(
+            f"--prefix-frac {args.prefix_frac} snaps to "
+            f"{prefix_windows} of {args.horizon_windows} windows; the "
+            f"prefix needs at least one window and the suffix at "
+            f"least one"
+        )
+    prefix_steps = prefix_windows * args.window
+    record = {
+        "bench": "serve_prefix_fork",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "reps": args.reps,
+        "protocol": "interleaved cached-vs-uncached min-of-reps on one "
+        "warmed server; fresh prefix seed per rep (each cached round "
+        "pays exactly one prefix run)",
+        "fork_ab": [],
+        "warmup_sweep": [],
+    }
+    for lanes in args.lanes:
+        # above one lane, keep several fill rounds of forks so the
+        # suffix phase still exercises full occupancy (n == lanes
+        # would make the floor 1.0: one round either way)
+        n = max(args.fork_requests, 4 * lanes)
+        row = fork_ab(
+            args.composite, args.capacity, lanes, args.window,
+            args.emit_every, horizon_steps, prefix_steps,
+            n=n, reps=args.reps,
+        )
+        record["fork_ab"].append(row)
+        print(json.dumps(row), flush=True)
+
+    # the sweep A/B runs in the sweep's home regime (bench_sweep.py):
+    # many small trials, objective-sized emission
+    for n_trials in args.sweep_sizes:
+        row = warmup_sweep_ab(
+            args.composite, capacity=8, lanes=8, window=32,
+            emit_every=32, horizon_steps=384, warmup_steps=288,
+            n_trials=n_trials, reps=args.reps,
+        )
+        record["warmup_sweep"].append(row)
+        print(json.dumps(row), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    best = min(e["cached_over_uncached"] for e in record["fork_ab"])
+    worst = max(e["cached_over_uncached"] for e in record["fork_ab"])
+    print(f"fork A/B cached/uncached: best {best:.3f}, worst {worst:.3f}")
+    if record["warmup_sweep"]:
+        s = min(e["speedup"] for e in record["warmup_sweep"])
+        print(f"worst warmup-sharing sweep speedup: {s:.2f}x")
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--composite", default="toggle_colony")
@@ -241,16 +499,53 @@ def main() -> int:
     p.add_argument("--window", type=int, default=64)
     p.add_argument("--emit-every", type=int, default=8)
     p.add_argument(
-        "--lanes", type=int, nargs="+", default=[2, 4, 8]
+        "--lanes", type=int, nargs="+", default=None,
+        help="lane counts (default: 2 4 8; --prefix mode: 1 8)",
     )
     p.add_argument(
-        "--horizon-windows", type=int, default=6,
-        help="request horizon in windows",
+        "--horizon-windows", type=int, default=None,
+        help="request horizon in windows (default: 6; --prefix "
+        "mode: 8)",
     )
     p.add_argument("--fill-rounds", type=int, default=4)
     p.add_argument("--sweep-n", type=int, default=48)
-    p.add_argument("--out", default="BENCH_SERVE_CPU_r10.json")
+    p.add_argument(
+        "--out", default=None,
+        help="output JSON (default: BENCH_SERVE_CPU_r10.json; "
+        "--prefix mode: BENCH_FORK_CPU_r11.json)",
+    )
+    p.add_argument(
+        "--prefix", action="store_true",
+        help="run the round-11 prefix-fork A/B instead of the "
+        "saturation/offered-load bench (writes BENCH_FORK_CPU_r11.json "
+        "unless --out is given)",
+    )
+    p.add_argument(
+        "--prefix-frac", type=float, default=0.75,
+        help="shared-prefix fraction of the horizon (fork A/B), "
+        "snapped to whole windows",
+    )
+    p.add_argument(
+        "--fork-requests", type=int, default=8,
+        help="requests sharing one prefix per fork A/B round (raised "
+        "to 4 per lane so the suffix phase keeps full occupancy)",
+    )
+    p.add_argument(
+        "--sweep-sizes", type=int, nargs="+", default=[32],
+        help="trial counts for the warmup-sharing sweep A/B",
+    )
+    p.add_argument("--reps", type=int, default=5)
     args = p.parse_args()
+
+    # per-mode defaults (None = not explicitly passed)
+    if args.prefix:
+        args.out = args.out or "BENCH_FORK_CPU_r11.json"
+        args.lanes = args.lanes or [1, 8]
+        args.horizon_windows = args.horizon_windows or 8
+        return run_prefix_bench(args)
+    args.out = args.out or "BENCH_SERVE_CPU_r10.json"
+    args.lanes = args.lanes or [2, 4, 8]
+    args.horizon_windows = args.horizon_windows or 6
 
     horizon_steps = args.horizon_windows * args.window
     record = {

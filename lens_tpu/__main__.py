@@ -219,6 +219,13 @@ def _build_parser() -> argparse.ArgumentParser:
         "append (batched flush; 1 = tightest tailing-reader "
         "visibility)",
     )
+    serve.add_argument(
+        "--snapshot-budget-mb", type=float, default=256.0,
+        help="byte budget (MiB) for the content-addressed snapshot "
+        "store behind request prefix caching and hold_state "
+        "(unpinned prefix snapshots are evicted LRU-first past it; "
+        "see docs/serving.md, 'Prefix caching & forking')",
+    )
 
     sweep = sub.add_parser(
         "sweep",
@@ -387,6 +394,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         pipeline=args.pipeline,
         stream_queue=args.stream_queue,
         flush_every=args.flush_every,
+        snapshot_budget_mb=args.snapshot_budget_mb,
     )
     with server:
         ids = []
@@ -430,6 +438,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"pipeline {args.pipeline}: device_busy={busy:.2f} "
                 f"stream_lag p50={lag['p50']:.4f}s "
                 f"stalls={snap['stream_stalls']}"
+            )
+        c = snap["counters"]
+        if c["prefix_hits"] + c["prefix_misses"]:
+            print(
+                f"prefix cache: hits={c['prefix_hits']} "
+                f"misses={c['prefix_misses']} "
+                f"coalesced={c['prefix_coalesced']} "
+                f"forks={c['prefix_forks']} "
+                f"evictions={c['snapshot_evictions']} "
+                f"resident={snap['snapshots_resident']} "
+                f"({snap['snapshot_bytes'] / 2**20:.1f} MiB)"
             )
         print(f"results: {args.out_dir}/<request-id>.lens")
         print(f"meta:    {args.out_dir}/server_meta.json")

@@ -144,22 +144,7 @@ class Colony:
             lambda x: jnp.broadcast_to(x, (self.capacity,) + x.shape).copy(), single
         )
         if overrides:
-            for path, value in flatten_paths(overrides):
-                if path not in self.compartment.updaters:
-                    raise KeyError(f"override {path} is not a schema variable")
-                value = jnp.asarray(value)
-                base = get_path(agents, path)
-                if value.ndim == base.ndim:  # per-agent array
-                    if value.shape[0] != self.capacity:
-                        raise ValueError(
-                            f"per-agent override {path} has leading dim "
-                            f"{value.shape[0]}, expected capacity={self.capacity}"
-                        )
-                    agents = set_path(agents, path, value.astype(base.dtype))
-                else:
-                    agents = set_path(
-                        agents, path, jnp.broadcast_to(value, base.shape).astype(base.dtype)
-                    )
+            agents = self._override_agents(agents, overrides)
         alive = jnp.arange(self.capacity) < n_alive
         if self.division_trigger is not None:
             # Lineage bookkeeping (framework-level, not schema-declared):
@@ -185,6 +170,46 @@ class Colony:
             key = jax.random.PRNGKey(0)
         return ColonyState(
             agents=agents, alive=alive, key=key, step=jnp.int32(0)
+        )
+
+    def _override_agents(self, agents: Mapping, overrides: Mapping):
+        """Set schema variables into an agents tree: scalars broadcast
+        to every row, per-agent arrays must match the row count. Shared
+        by ``initial_state`` (fresh rows) and ``apply_overrides`` (an
+        existing state — the serve layer's fork point). Row-count
+        polymorphic like ``step_biology``."""
+        for path, value in flatten_paths(overrides):
+            if path not in self.compartment.updaters:
+                raise KeyError(f"override {path} is not a schema variable")
+            value = jnp.asarray(value)
+            base = get_path(agents, path)
+            if value.ndim == base.ndim:  # per-agent array
+                if value.shape[0] != base.shape[0]:
+                    raise ValueError(
+                        f"per-agent override {path} has leading dim "
+                        f"{value.shape[0]}, expected capacity={base.shape[0]}"
+                    )
+                agents = set_path(agents, path, value.astype(base.dtype))
+            else:
+                agents = set_path(
+                    agents, path, jnp.broadcast_to(value, base.shape).astype(base.dtype)
+                )
+        return agents
+
+    def apply_overrides(
+        self, cs: ColonyState, overrides: Mapping | None
+    ) -> ColonyState:
+        """Set schema variables on an EXISTING colony state — the serve
+        layer's fork point (docs/serving.md, "Prefix caching &
+        forking"): a snapshot of a shared scenario prefix gets each
+        fork's divergent parameters applied before the suffix runs.
+        Same validation and scalar→rows broadcast as ``initial_state``'s
+        ``overrides=``; everything not named is left exactly as the
+        evolved state holds it."""
+        if not overrides:
+            return cs
+        return cs._replace(
+            agents=self._override_agents(cs.agents, overrides)
         )
 
     # -- stepping ------------------------------------------------------------

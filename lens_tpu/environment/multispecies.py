@@ -130,6 +130,28 @@ class MultiSpeciesColony:
             species=states, fields=self.lattice.initial_fields()
         )
 
+    def apply_overrides(
+        self,
+        ms: MultiSpeciesState,
+        overrides: Mapping[str, Mapping] | None,
+    ) -> MultiSpeciesState:
+        """Set schema variables on an existing state (the serve fork
+        point; see :meth:`Colony.apply_overrides`). Keyed per species,
+        like ``initial_state``'s ``overrides=``."""
+        if not overrides:
+            return ms
+        states = dict(ms.species)
+        for name, ovr in overrides.items():
+            if name not in self.species:
+                raise KeyError(
+                    f"override species {name!r} is not one of "
+                    f"{sorted(self.species)}"
+                )
+            states[name] = self.species[name].colony.apply_overrides(
+                states[name], ovr
+            )
+        return ms._replace(species=states)
+
     # -- stepping ------------------------------------------------------------
 
     def _row_slices(self, ms: MultiSpeciesState) -> Dict[str, slice]:

@@ -394,6 +394,18 @@ class SpatialColony:
         cs = cs._replace(agents=agents)
         return SpatialState(colony=cs, fields=self.lattice.initial_fields())
 
+    def apply_overrides(
+        self, ss: SpatialState, overrides: Mapping | None
+    ) -> SpatialState:
+        """Set schema variables on an existing state (the serve fork
+        point; see :meth:`Colony.apply_overrides`). Agent rows only —
+        the lattice fields are evolved state, not schema variables."""
+        if not overrides:
+            return ss
+        return ss._replace(
+            colony=self.colony.apply_overrides(ss.colony, overrides)
+        )
+
     # -- stepping ------------------------------------------------------------
 
     def step(self, ss: SpatialState, timestep: float) -> SpatialState:

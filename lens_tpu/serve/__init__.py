@@ -21,6 +21,7 @@ from lens_tpu.serve.batcher import (
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
 from lens_tpu.serve.server import SimServer
+from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
 from lens_tpu.serve.streamer import Streamer
 
 __all__ = [
@@ -35,6 +36,8 @@ __all__ = [
     "ScenarioRequest",
     "ServerMetrics",
     "SimServer",
+    "SnapshotStore",
     "Streamer",
+    "snapshot_key",
     "write_server_meta",
 ]

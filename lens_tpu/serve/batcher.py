@@ -79,15 +79,28 @@ class ScenarioRequest:
         mid-run) retire as TIMEOUT at the next tick, keeping whatever
         records they already streamed.
     hold_state:
-        Retain the lane's final simulation state (host-side) when the
-        request retires DONE, so ``SimServer.resubmit`` can EXTEND the
-        scenario past its horizon later — the continuation is admitted
-        from the held state and is bitwise what a longer original
-        horizon would have produced. Costs one lane-slice device->host
-        transfer at retirement plus host RAM until the state is
-        consumed by ``resubmit`` or dropped by ``release_state``. The
+        Retain the lane's final simulation state when the request
+        retires DONE — registered (pinned) in the server's
+        content-addressed ``SnapshotStore`` — so ``SimServer.resubmit``
+        can EXTEND the scenario past its horizon later, as many times
+        as the client likes: each continuation is admitted from the
+        held bits and is bitwise what a longer original horizon would
+        have produced. Costs one on-device lane-slice at retirement
+        plus device memory until ``release_state`` drops the hold. The
         sweep driver's successive-halving rungs are the intended
         client (survivors extend, losers never rerun).
+    prefix:
+        Declare that the request's first ``prefix["horizon"]`` sim
+        seconds are a SHARED prefix: the scenario built from
+        ``(seed, prefix["overrides"])`` and run for that horizon, with
+        this request's own ``overrides`` applied only afterwards, at
+        the fork point. The server runs each distinct prefix ONCE
+        (content-addressed snapshot store + request coalescing) and
+        forks the cached device-resident state into every requester's
+        lane; only suffix rows are emitted (times continue from the
+        prefix horizon). Must be shorter than ``horizon`` and on the
+        bucket's step/emit grid. See docs/serving.md, "Prefix caching
+        & forking".
     """
 
     composite: str
@@ -98,6 +111,7 @@ class ScenarioRequest:
     emit: Optional[Mapping[str, Any]] = None
     deadline: Optional[float] = None
     hold_state: bool = False
+    prefix: Optional[Mapping[str, Any]] = None
 
 
 @dataclass
@@ -117,15 +131,29 @@ class Ticket:
     cancel_requested: bool = False
     emit_count: int = 0  # emitted records streamed so far (pre-filter)
     result_path: Optional[str] = None
-    # -- continuation plumbing (hold_state / resubmit) --
-    # carry_state: a host state pytree to scatter at admission instead of
-    # building one from seed+overrides (set on continuation tickets;
-    # cleared once scattered). final_state: the lane's state captured at
-    # DONE retirement when the request asked hold_state (consumed by
-    # resubmit, dropped by release_state). parent: the request id this
-    # ticket continues, for provenance.
+    # -- continuation / fork plumbing (hold_state, resubmit, prefix) --
+    # carry_state: a state pytree to scatter at admission instead of
+    # building one from seed+overrides (set when a coalesced prefix
+    # lands for a waiting fork; cleared once scattered). carry_key: a
+    # SnapshotStore address this ticket holds ONE acquired ref on —
+    # its scatter source (prefix hits, resubmit continuations);
+    # released at scatter or on any terminal path. prefix_key: the
+    # snapshot address a prefix-declaring request forks from.
+    # content_key: this request's own content address (set when its
+    # final state is a pure function of (seed, overrides, horizon) —
+    # what hold_state pins and prefix runs publish). held_key: the
+    # store entry this DONE ticket pins for resubmit (released by
+    # release_state/close). waiting: queued but not yet admissible
+    # (its prefix is still being computed). internal: a
+    # server-generated prefix ticket (no client, no sink, no result).
+    # parent: the request id this ticket continues, for provenance.
     carry_state: Any = None
-    final_state: Any = None
+    carry_key: Any = None
+    prefix_key: Any = None
+    content_key: Any = None
+    held_key: Any = None
+    waiting: bool = False
+    internal: bool = False
     parent: Optional[str] = None
 
     def expired(self, now: float) -> bool:
@@ -153,8 +181,15 @@ class RequestQueue:
     def __len__(self) -> int:
         return len(self._queue)
 
-    def push(self, ticket: Ticket, retry_after: float) -> None:
-        if len(self._queue) >= self.max_depth:
+    def push(
+        self, ticket: Ticket, retry_after: float, force: bool = False
+    ) -> None:
+        """``force=True`` bypasses the depth bound — reserved for
+        server-GENERATED tickets (coalesced prefix runs), which are
+        bounded by the distinct prefixes of already-admitted client
+        tickets, not by client behavior; rejecting one would deadlock
+        the forks already queued behind it."""
+        if not force and len(self._queue) >= self.max_depth:
             raise QueueFull(retry_after, len(self._queue))
         self._queue.append(ticket)
 
@@ -177,16 +212,19 @@ class RequestQueue:
         return expired
 
     def take(
-        self, bucket_of, free_lanes: Dict[str, int]
+        self, bucket_of, free_lanes: Dict[str, int], ready=None
     ) -> List[Ticket]:
         """FIFO admission pass: tickets whose bucket still has a free
         lane, decrementing ``free_lanes`` as it goes. ``bucket_of`` maps
-        a ticket to its bucket name."""
+        a ticket to its bucket name. ``ready`` (optional predicate)
+        skips tickets that cannot be admitted yet — forks waiting on an
+        in-flight prefix — without losing their queue position, the
+        same non-blocking discipline as the per-bucket skip."""
         taken: List[Ticket] = []
         rest: List[Ticket] = []
         for t in self._queue:
             b = bucket_of(t)
-            if free_lanes.get(b, 0) > 0:
+            if (ready is None or ready(t)) and free_lanes.get(b, 0) > 0:
                 free_lanes[b] -= 1
                 taken.append(t)
             else:

@@ -68,6 +68,22 @@ def _solo_initial_state(
     return sim.initial_state(n_agents, key, overrides=overrides or None)
 
 
+def _override_leaves(overrides: Mapping | None):
+    """Canonical (path-sorted) override leaves plus the hashable
+    STRUCTURE key — ``(path, shape, dtype)`` per leaf — that addresses
+    one compiled program. Shared by the solo-builder and fork-admit
+    caches so override canonicalization can never diverge between them.
+    """
+    leaves = sorted(
+        (path, jnp.asarray(value))
+        for path, value in flatten_paths(overrides or {})
+    )
+    structure = tuple(
+        (path, v.shape, str(v.dtype)) for path, v in leaves
+    )
+    return leaves, structure
+
+
 class LanePool:
     """``n_lanes`` independent scenario slots over one resident program.
 
@@ -199,23 +215,21 @@ class LanePool:
         # request — reuse ONE compile; seed and override VALUES ride as
         # traced data, so the built bits are the eager build's bits.
         self._solo_builders: Dict[Any, Any] = {}
+        # Jitted fork-admit programs, one per divergent-override
+        # STRUCTURE: apply each fork's overrides to a cached prefix
+        # snapshot and scatter it into a lane in ONE dispatch (values
+        # ride as traced data — every fork of a sweep reuses one
+        # compile). See admit_state(overrides=...).
+        self._fork_admits: Dict[Any, Any] = {}
 
     def _build_solo(self, n_agents, seed: int, overrides: Mapping | None):
-        leaves = sorted(
-            (path, jnp.asarray(value))
-            for path, value in flatten_paths(overrides or {})
-        )
+        leaves, structure = _override_leaves(overrides)
         na_key = (
             tuple(sorted(n_agents.items()))
             if isinstance(n_agents, Mapping)
             else int(n_agents)
         )
-        key = (
-            na_key,
-            tuple(
-                (path, v.shape, str(v.dtype)) for path, v in leaves
-            ),
-        )
+        key = (na_key, structure)
         builder = self._solo_builders.get(key)
         if builder is None:
             paths = [path for path, _ in leaves]
@@ -288,26 +302,75 @@ class LanePool:
         )
         self.remaining_host[lane] = int(horizon_steps)
 
-    def admit_state(self, lane: int, state, steps: int) -> None:
+    def admit_state(
+        self, lane: int, state, steps: int, overrides: Mapping | None = None
+    ) -> None:
         """Scatter an EXPLICIT solo state into ``lane`` and arm ``steps``.
 
-        The continuation path (``SimServer.resubmit``): ``state`` is a
-        lane slice previously captured by :meth:`lane_state`, so
-        re-scattering it and stepping ``steps`` more is bitwise what a
-        longer original horizon would have produced (``step_where``
-        froze nothing but time in between). Reuses the one compiled
-        admit program — the state rides as data, same shapes.
+        The continuation path (``SimServer.resubmit``) and the fork
+        path (prefix caching): ``state`` is a lane slice previously
+        captured by :meth:`lane_state` / :meth:`lane_state_device` or a
+        ``SnapshotStore`` entry, so re-scattering it and stepping
+        ``steps`` more is bitwise what a longer original horizon would
+        have produced (``step_where`` froze nothing but time in
+        between). Reuses the one compiled admit program — the state
+        rides as data, same shapes.
+
+        ``overrides`` is the fork point's divergence: schema-variable
+        values applied to the snapshot (``sim.apply_overrides`` — same
+        validation/broadcast as initial-state overrides) before the
+        scatter, fused with it in one jitted program cached per
+        override structure. The snapshot argument is never donated —
+        the same cached prefix seeds many forks.
         """
         if not 0 <= lane < self.n_lanes:
             raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
         if steps < 1:
             raise ValueError(f"steps={steps} must be >= 1")
+        if overrides:
+            self._fork_admit(lane, state, steps, overrides)
+            return
         self.states, self.remaining = self._admit(
             self.states,
             self.remaining,
             jnp.int32(lane),
             state,
             jnp.int32(steps),
+        )
+        self.remaining_host[lane] = int(steps)
+
+    def _fork_admit(
+        self, lane: int, state, steps: int, overrides: Mapping
+    ) -> None:
+        """Apply divergent overrides to a snapshot and scatter it, one
+        cached compile per override structure (values are traced)."""
+        leaves, key = _override_leaves(overrides)
+        program = self._fork_admits.get(key)
+        if program is None:
+            paths = [path for path, _ in leaves]
+            donate = jax.default_backend() != "cpu"
+
+            def fork(states, remaining, lane, solo, steps, values):
+                tree: Dict = {}
+                for path, value in zip(paths, values):
+                    tree = set_path(tree, path, value)
+                solo = self.sim.apply_overrides(solo, tree)
+                states = jax.tree.map(
+                    lambda pool, s: pool.at[lane].set(s), states, solo
+                )
+                return states, remaining.at[lane].set(steps)
+
+            program = jax.jit(
+                fork, donate_argnums=(0, 1) if donate else ()
+            )
+            self._fork_admits[key] = program
+        self.states, self.remaining = program(
+            self.states,
+            self.remaining,
+            jnp.int32(lane),
+            state,
+            jnp.int32(steps),
+            [v for _, v in leaves],
         )
         self.remaining_host[lane] = int(steps)
 

@@ -64,6 +64,17 @@ class ServerMetrics:
     - ``retraces``: compiled-program count of the window executable
       beyond the expected single trace; anything nonzero means a shape
       leaked into the hot loop.
+    - prefix cache (round 11, docs/serving.md "Prefix caching &
+      forking"): ``prefix_hits``/``prefix_misses`` — resolution of each
+      prefix-declaring submit against the snapshot store (a miss
+      launches one internal prefix run); ``prefix_coalesced`` —
+      submits that attached to an ALREADY in-flight prefix run instead
+      of launching their own; ``prefix_forks`` — lanes seeded by
+      scattering a cached/shared snapshot (every prefixed admission);
+      ``snapshot_evictions`` — store entries dropped to the byte
+      budget. ``admitted``/``retired`` include the internal prefix
+      tickets (they really occupy lanes); ``submitted`` counts client
+      submits only.
     """
 
     _COUNTERS = (
@@ -79,6 +90,11 @@ class ServerMetrics:
         "windows",
         "lane_windows_busy",
         "lane_windows_total",
+        "prefix_hits",
+        "prefix_misses",
+        "prefix_coalesced",
+        "prefix_forks",
+        "snapshot_evictions",
     )
 
     def __init__(self) -> None:
@@ -87,6 +103,10 @@ class ServerMetrics:
         self.lanes_busy = 0
         self.lanes_total = 0
         self.retraces = 0
+        # snapshot-store gauges (refreshed by the server alongside
+        # queue depth / busy lanes)
+        self.snapshots_resident = 0
+        self.snapshot_bytes = 0
         self._t0 = time.perf_counter()
         # per finished request: wall seconds submit->admit and submit->done
         self.wait_seconds: List[float] = []
@@ -183,6 +203,8 @@ class ServerMetrics:
             "lanes_total": self.lanes_total,
             "occupancy": self.occupancy(),
             "retraces": self.retraces,
+            "snapshots_resident": self.snapshots_resident,
+            "snapshot_bytes": self.snapshot_bytes,
             "uptime_seconds": time.perf_counter() - self._t0,
             "avg_window_seconds": (
                 self.avg_window_seconds() if self.window_seconds else None

@@ -24,7 +24,17 @@ environments, LLM continuous batching):
   window k+1 while a background streamer thread (streamer.Streamer)
   slices/filters/appends window k — bookkeeping reads only host
   mirrors, hold_state snapshots stay on-device, and ``pipeline="off"``
-  preserves the synchronous path (bitwise-identical results).
+  preserves the synchronous path (bitwise-identical results);
+- shared scenario prefixes run ONCE (round 11): a request may declare
+  a ``prefix`` (warmup horizon + shared overrides); a content-addressed
+  snapshot store (snapshots.SnapshotStore — refcounted, byte-budgeted,
+  LRU) caches the device-resident state at the fork point, concurrent
+  submitters of one prefix coalesce onto a single in-flight prefix
+  run, and each fork's lane is seeded by scattering the cached tree
+  with its divergent overrides applied — N what-if branches cost one
+  prefix plus N suffixes. ``hold_state`` final states live in the same
+  store (pinned), so ``resubmit`` extends/forks a parent any number of
+  times.
 
 Determinism contract (pinned in tests/test_serve.py): a request's
 emitted trajectory is BITWISE identical served solo or co-batched with
@@ -70,6 +80,7 @@ from lens_tpu.serve.batcher import (
 )
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
+from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
 from lens_tpu.serve.streamer import (
     LaneSlice,
     Streamer,
@@ -231,6 +242,12 @@ class SimServer:
         Pipeline depth bound: at most this many windows may be queued
         or in processing on the streamer; the scheduler stalls past it
         (backpressure — bounded memory, bounded reader staleness).
+    snapshot_budget_mb:
+        Byte budget (MiB) for the content-addressed snapshot store
+        backing prefix caching and ``hold_state`` (docs/serving.md,
+        "Prefix caching & forking"). Unpinned prefix snapshots are
+        evicted LRU-first past the budget; pinned held states are the
+        client's working set and always land. ``None`` = unbounded.
     """
 
     def __init__(
@@ -243,6 +260,7 @@ class SimServer:
         flush_every: int = 1,
         pipeline: str = "on",
         stream_queue: int = 2,
+        snapshot_budget_mb: Optional[float] = None,
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -276,6 +294,14 @@ class SimServer:
             if pipeline == "on"
             else None
         )
+        self.snapshots = SnapshotStore(
+            budget_bytes=None
+            if snapshot_budget_mb is None
+            else int(float(snapshot_budget_mb) * 2**20)
+        )
+        # in-flight prefix coalescing: snapshot key -> fork tickets
+        # waiting for the (single) internal prefix run computing it
+        self._pending_prefix: Dict[Any, List[Ticket]] = {}
         self.tickets: Dict[str, Ticket] = {}
         self._results: Dict[str, Any] = {}
         # per-request stream-completion events (pipelined): set once
@@ -293,6 +319,7 @@ class SimServer:
         server_keys = (
             "queue_depth", "out_dir", "sink", "stream_flush",
             "flush_every", "pipeline", "stream_queue",
+            "snapshot_budget_mb",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -321,10 +348,29 @@ class SimServer:
         every = int((request.emit or {}).get("every", 1))
         if every < 1:
             raise ValueError(f"emit every={every} must be >= 1")
+        prefix_steps, prefix_key = self._validate_prefix(
+            bucket, request, steps
+        )
         ticket = Ticket(
             request_id=self.queue.next_id(),
             request=request,
             horizon_steps=steps,
+            # a fork's prefix counts as already-done work: only the
+            # suffix arms, and its emit grid continues the prefix's so
+            # the suffix rows land exactly where a solo full run's
+            # would (times AND every-k subsample phase)
+            steps_done=prefix_steps,
+            emit_count=prefix_steps // bucket.pool.emit_every,
+            prefix_key=prefix_key,
+            # the content address is only read when the final state is
+            # retained (hold_state retirement, resubmit advancement) —
+            # hashing override bytes for every throwaway trial would
+            # tax the admission hot path for nothing
+            content_key=(
+                self._content_key(bucket, request, steps)
+                if request.hold_state
+                else None
+            ),
         )
         try:
             self.queue.push(ticket, retry_after=self._retry_after())
@@ -333,9 +379,128 @@ class SimServer:
             self._metrics.queue_depth = len(self.queue)
             raise
         self._metrics.inc("submitted")
-        self._metrics.queue_depth = len(self.queue)
         self.tickets[ticket.request_id] = ticket
+        if prefix_key is not None:
+            self._resolve_prefix(ticket, bucket)
+        self._metrics.queue_depth = len(self.queue)
         return ticket.request_id
+
+    def _validate_prefix(
+        self, bucket: _Bucket, request: ScenarioRequest, steps: int
+    ):
+        """Validate a request's ``prefix`` block; returns
+        ``(prefix_steps, snapshot_key)`` (``(0, None)`` without one)."""
+        if request.prefix is None:
+            return 0, None
+        prefix = dict(request.prefix)
+        unknown = set(prefix) - {"horizon", "overrides"}
+        if unknown:
+            raise ValueError(
+                f"unknown prefix keys {sorted(unknown)}; known: "
+                f"horizon, overrides"
+            )
+        if "horizon" not in prefix:
+            raise ValueError("prefix needs a 'horizon'")
+        prefix_steps = self._horizon_steps(bucket, prefix["horizon"])
+        if prefix_steps >= steps:
+            raise ValueError(
+                f"prefix horizon ({prefix['horizon']}) must be shorter "
+                f"than the request horizon ({request.horizon}) — the "
+                f"suffix needs at least one step"
+            )
+        key = snapshot_key(
+            request.composite,
+            int(request.seed),
+            self._request_agents(bucket, request),
+            prefix.get("overrides") or {},
+            prefix_steps,
+        )
+        return prefix_steps, key
+
+    def _content_key(
+        self, bucket: _Bucket, request: ScenarioRequest, steps: int
+    ):
+        """The request's OWN content address, when its final state is a
+        pure function of (seed, initial overrides, n_agents, horizon):
+        plain requests always are; forks are only when their divergent
+        overrides are empty (then the whole run equals a solo run under
+        the prefix's overrides). Impure forks hold state under a
+        per-request key instead (resubmit still works; the entry just
+        cannot serve content-addressed prefix hits)."""
+        if request.prefix is None:
+            eff = request.overrides or {}
+        elif not request.overrides:
+            eff = dict(request.prefix).get("overrides") or {}
+        else:
+            return None
+        return snapshot_key(
+            request.composite,
+            int(request.seed),
+            self._request_agents(bucket, request),
+            eff,
+            steps,
+        )
+
+    def _request_agents(self, bucket: _Bucket, request: ScenarioRequest):
+        """The normalized n_agents a request admits with (shared by
+        admission and the snapshot content address)."""
+        return bucket.pool.default_agents(
+            request.n_agents
+            if request.n_agents is not None
+            else bucket.cfg["n_agents"]
+        )
+
+    def _resolve_prefix(self, t: Ticket, bucket: _Bucket) -> None:
+        """Route a prefix-declaring ticket through the snapshot store:
+        hit -> pin the entry and fork at admission; miss with the same
+        prefix already in flight -> attach as a coalesced waiter; cold
+        miss -> launch ONE internal prefix ticket all later submitters
+        coalesce onto. Runs after the ticket is queued (a QueueFull
+        submit leaves no store/pending side effects)."""
+        key = t.prefix_key
+        if key in self.snapshots:
+            self.snapshots.acquire(key)
+            t.carry_key = key
+            self._metrics.inc("prefix_hits")
+            return
+        waiters = self._pending_prefix.get(key)
+        if waiters is not None:
+            waiters.append(t)
+            t.waiting = True
+            self._metrics.inc("prefix_coalesced")
+            return
+        self._metrics.inc("prefix_misses")
+        t.waiting = True
+        req = t.request
+        warm = ScenarioRequest(
+            composite=req.composite,
+            seed=int(req.seed),
+            horizon=t.steps_done * bucket.pool.timestep,
+            overrides=dict(req.prefix).get("overrides") or {},
+            n_agents=req.n_agents,
+        )
+        warm_ticket = Ticket(
+            request_id=self.queue.next_id(),
+            request=warm,
+            horizon_steps=t.steps_done,
+            content_key=key,
+            internal=True,
+        )
+        # force: a rejected prefix run would deadlock the fork already
+        # queued behind it; internal tickets are bounded by the
+        # distinct prefixes of admitted client tickets, not by clients
+        self.queue.push(warm_ticket, retry_after=0.0, force=True)
+        self.tickets[warm_ticket.request_id] = warm_ticket
+        self._pending_prefix[key] = [t]
+
+    def _resolve_waiters(self, key, state) -> None:
+        """A prefix run landed: hand its state to every still-queued
+        coalesced fork (they scatter the same device tree — admission
+        copies it into each lane, the source is never donated)."""
+        for w in self._pending_prefix.pop(key, []):
+            if w.status == QUEUED:
+                w.carry_state = state
+                w.waiting = False
 
     @staticmethod
     def _horizon_steps(bucket: _Bucket, horizon: float) -> int:
@@ -371,10 +536,17 @@ class SimServer:
         consumers stitch segments by ``parent`` linkage (the sweep
         driver does).
 
+        The held state lives in the server's refcounted snapshot store
+        and is NOT consumed: a parent can be extended/forked any number
+        of times (N branching continuations from one hold), until the
+        client drops the hold with ``release_state``. A rejected
+        (``QueueFull``) resubmit leaves the hold untouched and the
+        parent re-extendable.
+
         Raises ``ValueError`` if the parent is not DONE, was not
-        submitted with ``hold_state=True``, or its held state was
-        already consumed/released; ``QueueFull`` for backpressure, like
-        ``submit``.
+        submitted with ``hold_state=True``, or its hold was already
+        dropped by ``release_state``; ``QueueFull`` for backpressure,
+        like ``submit``.
         """
         parent = self._ticket(request_id)
         if parent.status != DONE:
@@ -382,10 +554,10 @@ class SimServer:
                 f"request {request_id} is {parent.status}; only DONE "
                 f"requests can be extended"
             )
-        if parent.final_state is None:
+        if parent.held_key is None:
             raise ValueError(
                 f"request {request_id} holds no final state (submit "
-                f"with hold_state=True, and resubmit at most once)"
+                f"with hold_state=True; release_state drops the hold)"
             )
         bucket = self.buckets[parent.request.composite]
         extra_steps = self._horizon_steps(bucket, extra_horizon)
@@ -393,13 +565,20 @@ class SimServer:
             parent.request,
             horizon=float(parent.request.horizon) + float(extra_horizon),
         )
+        total_steps = parent.horizon_steps + extra_steps
         ticket = Ticket(
             request_id=self.queue.next_id(),
             request=request,
-            horizon_steps=parent.horizon_steps + extra_steps,
+            horizon_steps=total_steps,
             steps_done=parent.steps_done,
             emit_count=parent.emit_count,
-            carry_state=parent.final_state,
+            # a pure parent's continuation is pure at the longer
+            # horizon: same address, step coordinate advanced
+            content_key=(
+                parent.content_key[:-1] + (total_steps,)
+                if parent.content_key is not None
+                else None
+            ),
             parent=parent.request_id,
         )
         try:
@@ -408,17 +587,37 @@ class SimServer:
             self._metrics.inc("rejected")
             self._metrics.queue_depth = len(self.queue)
             raise
-        parent.final_state = None  # consumed: exactly-once continuation
+        # pin the held snapshot for the continuation only once the push
+        # can no longer fail — QueueFull must leave no dangling ref
+        ticket.carry_key = parent.held_key
+        self.snapshots.acquire(parent.held_key)
         self._metrics.inc("resubmitted")
         self._metrics.queue_depth = len(self.queue)
         self.tickets[ticket.request_id] = ticket
         return ticket.request_id
 
     def release_state(self, request_id: str) -> None:
-        """Drop a DONE request's held final state (a halving loser that
-        will never be extended) so its host RAM is reclaimed now rather
-        than at server close."""
-        self._ticket(request_id).final_state = None
+        """Drop a DONE request's hold on its final state (a halving
+        loser that will never be extended): further ``resubmit`` calls
+        are refused. A content-addressed hold becomes ordinary
+        evictable cache content (it can still serve prefix hits) —
+        memory is reclaimed by the store's budget/LRU (or at close). A
+        per-request hold (impure parent) is unreachable by any future
+        lookup, so it is dropped — and its memory freed — immediately.
+        In-flight continuations keep their own pins."""
+        t = self._ticket(request_id)
+        if t.held_key is None:
+            return
+        key, t.held_key = t.held_key, None
+        self._metrics.inc(
+            "snapshot_evictions", self.snapshots.release(key)
+        )
+        if (
+            len(key) == 2  # ("held", rid): never content-addressable
+            and key in self.snapshots
+            and self.snapshots.refs(key) == 0
+        ):
+            self.snapshots.drop(key)
 
     def status(self, request_id: str) -> Dict[str, Any]:
         t = self._ticket(request_id)
@@ -445,12 +644,22 @@ class SimServer:
     def _gauges(self) -> Dict[str, Any]:
         """The small live-health dict embedded in ``status()``."""
         self._refresh_gauges()
+        c = self._metrics.counters
         return {
             "occupancy": self._metrics.occupancy(),
             "queue_depth": self._metrics.queue_depth,
             "lanes_busy": self._metrics.lanes_busy,
             "lanes_total": self._metrics.lanes_total,
             "retraces": self._metrics.retraces,
+            "snapshots": {
+                "resident": self._metrics.snapshots_resident,
+                "resident_bytes": self._metrics.snapshot_bytes,
+                "hits": c["prefix_hits"],
+                "misses": c["prefix_misses"],
+                "coalesced": c["prefix_coalesced"],
+                "forks": c["prefix_forks"],
+                "evictions": c["snapshot_evictions"],
+            },
         }
 
     def reset_samples(self) -> None:
@@ -474,6 +683,8 @@ class SimServer:
         self._metrics.retraces = sum(
             b.pool.retraces() for b in self.buckets.values()
         )
+        self._metrics.snapshots_resident = len(self.snapshots)
+        self._metrics.snapshot_bytes = self.snapshots.resident_bytes()
 
     def result(self, request_id: str):
         """The request's streamed trajectory: a stacked timeseries tree
@@ -570,12 +781,14 @@ class SimServer:
                         self._metrics.inc("timeouts")
                     did_work = True
 
-        # 3. admission: FIFO over the queue, per-bucket free lanes
+        # 3. admission: FIFO over the queue, per-bucket free lanes;
+        #    forks waiting on an in-flight prefix are skipped in place
         free = {
             name: b.free_lanes() for name, b in self.buckets.items()
         }
         for t in self.queue.take(
-            lambda t: t.request.composite, free
+            lambda t: t.request.composite, free,
+            ready=lambda t: not t.waiting,
         ):
             did_work = True
             self._admit(t, now)
@@ -634,24 +847,44 @@ class SimServer:
     def _admit(self, t: Ticket, now: float) -> None:
         bucket = self.buckets[t.request.composite]
         lane = bucket.next_free_lane()
-        # a continuation ticket arms only its REMAINING steps (its
-        # steps_done already counts the parent's run); fresh tickets
-        # have steps_done == 0 so this is their full horizon
+        # a continuation/fork ticket arms only its REMAINING steps (its
+        # steps_done already counts the parent's run / the shared
+        # prefix); fresh tickets have steps_done == 0 so this is their
+        # full horizon
         arm_steps = t.horizon_steps - t.steps_done
+        # a fork applies its divergent overrides AT the fork point (a
+        # resubmit continuation does not: its request overrides were
+        # the chain root's t=0 initial conditions, long since evolved)
+        fork_overrides = (
+            (t.request.overrides or None)
+            if t.prefix_key is not None
+            else None
+        )
         try:
-            if t.carry_state is not None:
-                bucket.pool.admit_state(lane, t.carry_state, arm_steps)
-                t.carry_state = None  # scattered; free the host copy
+            if t.carry_key is not None:
+                bucket.pool.admit_state(
+                    lane,
+                    self.snapshots.state(t.carry_key),
+                    arm_steps,
+                    overrides=fork_overrides,
+                )
+                self._metrics.inc(
+                    "snapshot_evictions",
+                    self.snapshots.release(t.carry_key),
+                )
+                t.carry_key = None
+            elif t.carry_state is not None:
+                bucket.pool.admit_state(
+                    lane, t.carry_state, arm_steps,
+                    overrides=fork_overrides,
+                )
+                t.carry_state = None  # scattered; drop the shared ref
             else:
                 bucket.pool.admit(
                     lane,
                     seed=int(t.request.seed),
                     horizon_steps=arm_steps,
-                    n_agents=bucket.pool.default_agents(
-                        t.request.n_agents
-                        if t.request.n_agents is not None
-                        else bucket.cfg["n_agents"]
-                    ),
+                    n_agents=self._request_agents(bucket, t.request),
                     overrides=t.request.overrides or None,
                 )
         except Exception as e:  # bad overrides/counts: fail the REQUEST
@@ -659,13 +892,16 @@ class SimServer:
             self._finish(t, FAILED)
             self._metrics.inc("failed")
             return
+        if t.prefix_key is not None:
+            self._metrics.inc("prefix_forks")
         t.status = RUNNING
         t.lane = lane
         t.admitted_at = now
         bucket.assignments[lane] = t
-        self._results[t.request_id] = self._make_sink(t)
-        if self._streamer is not None:
-            self._stream_done[t.request_id] = threading.Event()
+        if not t.internal:
+            self._results[t.request_id] = self._make_sink(t)
+            if self._streamer is not None:
+                self._stream_done[t.request_id] = threading.Event()
         self._metrics.inc("admitted")
 
     def _make_sink(self, t: Ticket):
@@ -687,6 +923,23 @@ class SimServer:
                     for p, v in flatten_paths(req.overrides or {})
                 },
                 "emit": dict(req.emit or {}),
+                # a forked run's rows are SUFFIX-only with divergent
+                # overrides applied at the fork point — without the
+                # prefix declaration the file would misdescribe itself
+                # as a full t=0 run
+                "prefix": (
+                    {
+                        "horizon": float(req.prefix["horizon"]),
+                        "overrides": {
+                            SEP.join(map(str, p)): np.asarray(v).tolist()
+                            for p, v in flatten_paths(
+                                req.prefix.get("overrides") or {}
+                            )
+                        },
+                    }
+                    if req.prefix
+                    else None
+                ),
             },
             flush_every=self.flush_every if self.stream_flush else None,
         )
@@ -729,8 +982,16 @@ class SimServer:
         retiring = []
         for lane, t in list(bucket.assignments.items()):
             before = int(remaining_before[lane])
-            job = self._lane_slice(pool, t, lane, before)
             retire = before <= pool.window_steps  # horizon elapsed
+            if t.internal:
+                # a prefix run emits nothing (its product is the
+                # snapshot, captured at retirement below) — advance
+                # the step counter and skip all sink routing
+                t.steps_done += min(before, pool.window_steps)
+                if retire:
+                    retiring.append((lane, t))
+                continue
+            job = self._lane_slice(pool, t, lane, before)
             if job is not None:
                 slices.append(job)
             elif retire and pipelined:
@@ -760,16 +1021,38 @@ class SimServer:
             self._metrics.observe_stream(t0, ready, done)
 
         for lane, t in retiring:
-            if t.request.hold_state:
+            if t.internal or t.request.hold_state:
                 # capture the lane's exact final bits BEFORE the lane
-                # can be reassigned, so a later resubmit continues the
-                # scenario bitwise; pipelined capture stays on-device
-                # (no sync) — admit_state takes the device tree as-is,
-                # host bytes only if a client inspects them
-                t.final_state = (
-                    pool.lane_state_device(lane) if pipelined
-                    else pool.lane_state(lane)
-                )
+                # can be reassigned, so a later fork/resubmit continues
+                # the scenario bitwise; the capture stays on-device (a
+                # jitted lane slice, no sync) — admit_state scatters
+                # the device tree as-is, host bytes only if a client
+                # inspects them
+                snap = pool.lane_state_device(lane)
+                if t.internal:
+                    # a finished prefix run: publish the snapshot
+                    # (unpinned cache content) and release every
+                    # coalesced fork waiting on it
+                    self._metrics.inc(
+                        "snapshot_evictions",
+                        self.snapshots.put(t.content_key, snap),
+                    )
+                    self._resolve_waiters(t.content_key, snap)
+                else:
+                    # hold_state: pin the snapshot for resubmit —
+                    # content-addressed when the run is pure (so it
+                    # doubles as a prefix-cache entry), per-request
+                    # otherwise
+                    held = (
+                        t.content_key
+                        if t.content_key is not None
+                        else ("held", t.request_id)
+                    )
+                    self._metrics.inc(
+                        "snapshot_evictions",
+                        self.snapshots.put(held, snap, pin=True),
+                    )
+                    t.held_key = held
             del bucket.assignments[lane]
             self._finish(t, DONE)
             self._metrics.inc("retired")
@@ -838,6 +1121,28 @@ class SimServer:
     def _finish(self, t: Ticket, status: str) -> None:
         t.status = status
         t.finished_at = time.perf_counter()
+        if t.carry_key is not None:
+            # terminal before the scatter consumed it (failed
+            # admission, cancelled/expired while queued): drop the
+            # ticket's pin so the snapshot is evictable again
+            self._metrics.inc(
+                "snapshot_evictions",
+                self.snapshots.release(t.carry_key),
+            )
+            t.carry_key = None
+        # a coalesced waiter's unscattered seed is device memory the
+        # store never accounted for — a terminal ticket must not keep
+        # the tree alive for the server's lifetime
+        t.carry_state = None
+        if t.internal and status != DONE:
+            # a failed/killed prefix run: every coalesced fork waiting
+            # on it can never be seeded — fail them with the cause
+            # rather than leaving them queued forever
+            for w in self._pending_prefix.pop(t.content_key, []):
+                if w.status == QUEUED and self.queue.drop(w):
+                    w.error = t.error or f"prefix run {status}"
+                    self._finish(w, FAILED)
+                    self._metrics.inc("failed")
         sink = self._results.get(t.request_id)
         pipelined_done = self._streamer is not None and status == DONE
         if sink is not None:
@@ -853,9 +1158,11 @@ class SimServer:
                 )
             # pipelined DONE: the retiring window's LaneSlice carries
             # close_after, keeping append->close order per request
-        if t.admitted_at is not None and not pipelined_done:
+        if t.admitted_at is not None and not pipelined_done \
+                and not t.internal:
             # pipelined DONE latency is observed by _completion_cb at
-            # stream completion instead
+            # stream completion instead; internal prefix runs are not
+            # client requests and never enter the latency percentiles
             self._metrics.observe_request(
                 t.admitted_at - t.submitted_at,
                 t.finished_at - t.submitted_at,
@@ -884,6 +1191,26 @@ class SimServer:
                 sink.close()
             except BaseException as e:
                 first_error = first_error or e
+        # drop every ticket's snapshot pin (held states, unscattered
+        # carries) — every acquire pairs with a release even on the
+        # close path, so a refcount imbalance surfaces HERE as an
+        # error instead of leaking silently
+        try:
+            for t in self.tickets.values():
+                if t.carry_key is not None:
+                    self._metrics.inc(
+                        "snapshot_evictions",
+                        self.snapshots.release(t.carry_key),
+                    )
+                    t.carry_key = None
+                if t.held_key is not None:
+                    self._metrics.inc(
+                        "snapshot_evictions",
+                        self.snapshots.release(t.held_key),
+                    )
+                    t.held_key = None
+        except BaseException as e:
+            first_error = first_error or e
         if self.out_dir:
             try:
                 self._refresh_gauges()
@@ -895,6 +1222,7 @@ class SimServer:
             except BaseException as e:
                 # never let a failed meta write mask the root cause
                 first_error = first_error or e
+        self.snapshots.clear()  # free the resident device trees
         if first_error is not None:
             raise first_error
 

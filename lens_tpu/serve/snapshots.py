@@ -1,0 +1,275 @@
+"""Content-addressed snapshot store: run shared scenario prefixes once.
+
+Sweeps, ASHA rungs, counterfactual "what-if-at-t" queries, and
+branching ensembles all share a scenario *prefix* — same composite,
+seed, warmup overrides, and warmup horizon — and before round 11 each
+request re-simulated that prefix from t=0. This module is LLM-server
+prefix caching applied to simulation *time*: a device-resident state
+tree captured at a known sim-time is addressed by the CONTENT that
+deterministically produced it, so any later request declaring the same
+prefix can fork from the cached bits and run only its suffix.
+
+The address (:func:`snapshot_key`) is the serving determinism contract
+turned into a cache key: a lane's state at step ``s`` is a pure
+function of (bucket program, seed, initial-state overrides, n_agents,
+``s``) — pinned bitwise by ``tests/test_serve.py`` — so two requests
+agreeing on those five coordinates would compute identical prefixes,
+and the store lets the second one not compute it at all.
+
+The store itself is deliberately dumb and single-threaded (only the
+scheduler thread touches it; the stream thread never does):
+
+- **refcounting** — an entry is *pinned* while anyone still needs its
+  exact buffers: a queued fork that will scatter it, or a ``hold_state``
+  parent whose client may extend it again. Pinned entries are never
+  evicted; ``release`` below zero raises (a double-free is a scheduler
+  bug, never silently absorbed).
+- **byte budget + LRU** — unpinned entries are evicted
+  least-recently-used when ``put`` would exceed ``budget_bytes``. An
+  unpinned entry that cannot fit even after evicting everything
+  evictable is simply not retained (the caller already holds the state
+  tree in hand for its waiters — the cache misses later, it never
+  blocks). Pinned inserts always land: an explicit hold is the
+  client's promise to ``release`` it, so the budget governs the
+  *cache*, not the client's working set.
+- **request coalescing** lives in the server, not here: the store only
+  answers "cached or not"; ``SimServer`` keeps the in-flight-prefix
+  ticket map so concurrent submitters of one prefix never duplicate
+  work.
+
+See docs/serving.md, "Prefix caching & forking".
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from lens_tpu.emit.log import SEP
+from lens_tpu.utils.dicts import flatten_paths
+
+#: A snapshot address: (bucket, seed, n_agents fp, overrides fp, steps).
+#: ``steps`` is LAST so a continuation's key is its parent's key with
+#: the step coordinate advanced (``key[:-1] + (steps,)``).
+SnapshotKey = Tuple[Any, ...]
+
+
+def overrides_fingerprint(overrides: Mapping | None) -> str:
+    """Content digest of an override tree: every leaf's path, dtype,
+    shape, and exact bytes, in sorted path order. Two trees that build
+    the same initial state hash the same; any value/shape/dtype change
+    hashes differently."""
+    h = hashlib.sha256()
+    leaves = sorted(
+        (SEP.join(map(str, path)), np.asarray(value))
+        for path, value in flatten_paths(overrides or {})
+    )
+    for path, value in leaves:
+        h.update(path.encode())
+        h.update(str(value.dtype).encode())
+        h.update(repr(value.shape).encode())
+        h.update(np.ascontiguousarray(value).tobytes())
+    return h.hexdigest()
+
+
+def agents_fingerprint(n_agents: Any) -> Any:
+    """A hashable form of the (possibly per-species) n_agents value."""
+    if isinstance(n_agents, Mapping):
+        return tuple(sorted((str(k), int(v)) for k, v in n_agents.items()))
+    return int(n_agents) if n_agents is not None else None
+
+
+def snapshot_key(
+    bucket: str,
+    seed: int,
+    n_agents: Any,
+    overrides: Mapping | None,
+    steps: int,
+) -> SnapshotKey:
+    """The content address of "bucket ``bucket``'s state after running
+    ``steps`` steps from ``initial_state(n_agents, PRNGKey(seed),
+    overrides)``". The bucket name pins composite, config, capacity,
+    timestep, and emit cadence (one bucket = one resident program)."""
+    return (
+        str(bucket),
+        int(seed),
+        agents_fingerprint(n_agents),
+        overrides_fingerprint(overrides),
+        int(steps),
+    )
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total leaf bytes of a state tree (device or host arrays — both
+    expose ``nbytes`` without forcing a transfer)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        total += int(nb) if nb is not None else np.asarray(leaf).nbytes
+    return total
+
+
+@dataclass
+class _Entry:
+    state: Any
+    nbytes: int
+    refs: int = 0
+    used: int = 0  # LRU stamp (monotonic per store)
+
+
+class SnapshotStore:
+    """Refcounted, byte-budgeted, LRU content-addressed snapshot cache.
+
+    ``budget_bytes=None`` means unbounded (in-process tests, small
+    servers); a budget makes ``put`` — and ``release``, when a pin
+    drops to zero — evict unpinned entries LRU-first and report how
+    many were evicted, so the server's metrics can count them. All
+    methods are O(entries log entries) at worst and touch no device
+    program — the store only holds references to already-materialized
+    state trees.
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is not None and budget_bytes < 0:
+            raise ValueError(f"budget_bytes={budget_bytes} must be >= 0")
+        self.budget_bytes = budget_bytes
+        self._entries: Dict[SnapshotKey, _Entry] = {}
+        self._clock = 0
+
+    # -- reads ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: SnapshotKey) -> bool:
+        return key in self._entries
+
+    def resident_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def refs_total(self) -> int:
+        """Outstanding pins across all entries — 0 when every acquire
+        has been released (the no-leak invariant ``SimServer.close``
+        restores and tests assert)."""
+        return sum(e.refs for e in self._entries.values())
+
+    def state(self, key: SnapshotKey) -> Any:
+        """The cached state tree (LRU touch). KeyError if absent —
+        callers holding a ref can never see that (pinned entries are
+        not evictable)."""
+        entry = self._entries[key]
+        self._clock += 1
+        entry.used = self._clock
+        return entry.state
+
+    # -- refcounting ---------------------------------------------------------
+
+    def acquire(self, key: SnapshotKey) -> Any:
+        """Pin an entry (evicting it becomes impossible) and return its
+        state. Every ``acquire`` must be paired with exactly one
+        ``release``."""
+        entry = self._entries[key]
+        entry.refs += 1
+        self._clock += 1
+        entry.used = self._clock
+        return entry.state
+
+    def release(self, key: SnapshotKey) -> int:
+        """Drop one pin. The entry STAYS cached (evictable once refs
+        hit zero) — release means "I no longer need these exact
+        buffers", not "forget the snapshot". A pin dropping to zero
+        re-enforces the byte budget (pinned inserts may legitimately
+        overshoot it; the overshoot must not outlive the pins), so
+        like ``put`` this returns how many entries were evicted.
+        Releasing an absent or unpinned entry raises: a double-free is
+        a bug upstream."""
+        entry = self._entries.get(key)
+        if entry is None:
+            raise KeyError(f"release of unknown snapshot {key!r}")
+        if entry.refs <= 0:
+            raise RuntimeError(
+                f"double release of snapshot {key!r} (refs already 0)"
+            )
+        entry.refs -= 1
+        return self._evict_to_budget() if entry.refs == 0 else 0
+
+    def refs(self, key: SnapshotKey) -> int:
+        """Outstanding pins on one entry (0 for an absent key)."""
+        entry = self._entries.get(key)
+        return entry.refs if entry is not None else 0
+
+    # -- writes --------------------------------------------------------------
+
+    def put(
+        self, key: SnapshotKey, state: Any, pin: bool = False
+    ) -> int:
+        """Insert (or re-touch) a snapshot; returns how many entries
+        were evicted to make room. ``pin=True`` adds one ref (the
+        ``hold_state`` path — the caller promises a ``release``).
+
+        Inserting an existing key never replaces the state: by the
+        content-address contract the bits are identical, so the
+        incumbent (possibly pinned, possibly older-LRU) entry simply
+        absorbs the pin/touch.
+        """
+        self._clock += 1
+        entry = self._entries.get(key)
+        if entry is not None:
+            entry.used = self._clock
+            if pin:
+                entry.refs += 1
+            return 0
+        entry = _Entry(
+            state=state,
+            nbytes=tree_nbytes(state),
+            refs=1 if pin else 0,
+            used=self._clock,
+        )
+        self._entries[key] = entry
+        # LRU eviction may consume the new entry itself (it is the
+        # newest, so only after every older evictable is gone): an
+        # unpinned snapshot that cannot fit is simply not retained —
+        # the caller still holds the tree for its immediate consumers.
+        return self._evict_to_budget()
+
+    def drop(self, key: SnapshotKey) -> None:
+        """Forget an unpinned entry now (explicit invalidation)."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return
+        if entry.refs > 0:
+            raise RuntimeError(
+                f"drop of pinned snapshot {key!r} (refs={entry.refs})"
+            )
+        del self._entries[key]
+
+    def _evict_to_budget(self) -> int:
+        if self.budget_bytes is None:
+            return 0
+        excess = self.resident_bytes() - self.budget_bytes
+        if excess <= 0:
+            return 0
+        victims: List[Tuple[int, SnapshotKey]] = sorted(
+            (e.used, k)
+            for k, e in self._entries.items()
+            if e.refs == 0
+        )
+        evicted = 0
+        for _, key in victims:  # LRU-first until the budget holds
+            if excess <= 0:
+                break
+            excess -= self._entries[key].nbytes
+            del self._entries[key]
+            evicted += 1
+        # excess > 0 here means everything left is pinned: the budget
+        # cannot bind (pinned inserts always land)
+        return evicted
+
+    def clear(self) -> None:
+        """Drop every entry regardless of pins (server close: the
+        tickets' pins are being torn down with the server)."""
+        self._entries.clear()

@@ -68,8 +68,11 @@ DONE_S, STOPPED_S, FAILED_S, PENDING_S = "done", "stopped", "failed", "pending"
 _SPEC_KEYS = {
     "composite", "config", "space", "seed", "horizon", "objective",
     "backend", "asha", "n_agents", "capacity", "timestep", "emit_every",
-    "save_trajectories",
+    "save_trajectories", "warmup",
 }
+
+#: keys a spec's ``warmup`` block may carry
+_WARMUP_KEYS = {"horizon", "overrides", "seed"}
 
 
 @dataclass
@@ -81,6 +84,17 @@ class SweepSpec:
     ``batch`` for the ensemble backend); everything that shapes the
     simulation or the trial set is a top-level field and part of the
     resume fingerprint.
+
+    ``warmup`` (server backend only) declares a SHARED scenario prefix
+    for every trial: ``{"horizon": h, "overrides": {...}, "seed": s}``.
+    The warmup scenario — seed ``s`` (default: the sweep seed) plus the
+    shared overrides — is simulated ONCE per server via serve's
+    content-addressed snapshot store, and every trial (and every ASHA
+    first-rung request) forks the warmed device-resident state, running
+    only ``horizon - h`` suffix seconds with its own divergent
+    parameters applied at the fork point (docs/sweeps.md, "Shared
+    warmup"). Trials therefore share the warmup's PRNG stream — the
+    counterfactual what-if-at-t semantics, not independent replicates.
     """
 
     composite: str
@@ -96,6 +110,7 @@ class SweepSpec:
     timestep: float = 1.0
     emit_every: int = 1
     save_trajectories: bool = False
+    warmup: Optional[Mapping[str, Any]] = None
 
     @classmethod
     def from_mapping(cls, spec: Mapping[str, Any] | "SweepSpec") -> "SweepSpec":
@@ -154,6 +169,10 @@ class SweepSpec:
             "asha": dict(self.asha) if self.asha else None,
             "backend_kind": self.kind,
         }
+        if self.warmup:
+            # only present when set: a warmup-less spec must keep the
+            # fingerprint its pre-round-11 ledger was begun with
+            out["warmup"] = dict(self.warmup)
         if self.kind == "ensemble":
             out["batch"] = (self.backend or {}).get("batch")
         return out
@@ -234,6 +253,12 @@ class _ServerSweep:
         self.max_in_flight = backend.pop("max_in_flight", None)
         self.owns_server = server is None
         if server is None:
+            # a driver-owned store needs a finite budget: released
+            # halving losers become evictable cache, and unbounded
+            # they would stay device-resident until close (~n_trials x
+            # state bytes). LRU keeps the hot warmup snapshot; an
+            # evicted one falls back to a bitwise-equal prefix re-run.
+            backend.setdefault("snapshot_budget_mb", 256)
             server = SimServer.single_bucket(
                 spec.composite,
                 config=dict(spec.config or {}),
@@ -257,6 +282,31 @@ class _ServerSweep:
             self.max_in_flight = 2 * pool.n_lanes
         emit_paths = objective.emit_paths()
         self.emit_spec = {"paths": emit_paths} if emit_paths else None
+        self.warmup = (
+            dict(spec.warmup) if spec.warmup is not None else None
+        )
+        if self.warmup is not None:
+            unknown = set(self.warmup) - _WARMUP_KEYS
+            if unknown:
+                raise ValueError(
+                    f"unknown warmup keys {sorted(unknown)}; known: "
+                    f"{sorted(_WARMUP_KEYS)}"
+                )
+            if "horizon" not in self.warmup:
+                raise ValueError("warmup needs a 'horizon'")
+            warm_h = float(self.warmup["horizon"])
+            if warm_h >= float(spec.horizon):
+                raise ValueError(
+                    f"warmup horizon ({warm_h}) must be shorter than "
+                    f"the sweep horizon ({spec.horizon})"
+                )
+            min_h = (spec.asha or {}).get("min_horizon")
+            if min_h is not None and warm_h >= float(min_h):
+                raise ValueError(
+                    f"warmup horizon ({warm_h}) must be shorter than "
+                    f"the first asha rung (min_horizon={min_h}) — the "
+                    f"rung's suffix needs at least one step"
+                )
 
     # -- plumbing ------------------------------------------------------------
 
@@ -281,13 +331,34 @@ class _ServerSweep:
     def _request(self, trial: Trial, horizon: float, hold: bool):
         from lens_tpu.serve import ScenarioRequest
 
+        if self.warmup is None:
+            return ScenarioRequest(
+                composite=self.spec.composite,
+                seed=trial.seed,
+                horizon=horizon,
+                overrides=trial.overrides(),
+                emit=self.emit_spec,
+                hold_state=hold,
+            )
+        # shared-warmup trial: every trial declares the SAME prefix —
+        # warmup seed + shared overrides to the warmup horizon — so the
+        # server simulates it once and forks it per trial, applying the
+        # trial's divergent params at the fork point. The trial's own
+        # per-index seed is deliberately unused: the suffix continues
+        # the warmed state's PRNG stream (what-if-at-t semantics).
+        prefix: Dict[str, Any] = {
+            "horizon": float(self.warmup["horizon"])
+        }
+        if self.warmup.get("overrides"):
+            prefix["overrides"] = self.warmup["overrides"]
         return ScenarioRequest(
             composite=self.spec.composite,
-            seed=trial.seed,
+            seed=int(self.warmup.get("seed", self.spec.seed)),
             horizon=horizon,
             overrides=trial.overrides(),
             emit=self.emit_spec,
             hold_state=hold,
+            prefix=prefix,
         )
 
     def _record_done(self, index, objective, status, steps, on_trial):
@@ -522,6 +593,11 @@ class _EnsembleSweep:
             raise ValueError(
                 "the ensemble backend has no early stopping; use "
                 "backend kind 'server' for asha sweeps"
+            )
+        if spec.warmup is not None:
+            raise ValueError(
+                "the ensemble backend has no snapshot store; use "
+                "backend kind 'server' for shared-warmup sweeps"
             )
         batch = (spec.backend or {}).get("batch")
         self.batch = int(batch) if batch else min(len(trials), 64)

@@ -85,6 +85,17 @@ class TestParsing:
                 ["serve", "--requests", "r.json", "--pipeline", "maybe"]
             )
 
+    def test_serve_snapshot_budget_arg(self):
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json"]
+        )
+        assert args.snapshot_budget_mb == 256.0
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json",
+             "--snapshot-budget-mb", "16.5"]
+        )
+        assert args.snapshot_budget_mb == 16.5
+
     def test_sweep_args(self):
         args = _build_parser().parse_args(
             ["sweep", "--spec", "sweep.json", "--out-dir", "out/s",
@@ -146,6 +157,33 @@ class TestServeCommand:
         # the pipelined default surfaces its gauges in the summary
         assert "device_busy=" in printed
 
+    def test_serve_smoke_prefix_requests(self, tmp_path, capsys):
+        """Requests declaring a shared prefix fork one cached snapshot
+        (round 11); the summary line surfaces the cache counters."""
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps([
+            {"seed": 1, "horizon": 8.0, "prefix": {"horizon": 4.0}},
+            {"seed": 1, "horizon": 8.0, "prefix": {"horizon": 4.0},
+             "overrides": {"cell": {"glucose_internal": 0.2}}},
+        ]))
+        out = str(tmp_path / "served_prefix")
+        rc = main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4",
+            "--snapshot-budget-mb", "32",
+            "--requests", str(reqs), "--out-dir", out,
+        ])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "served 2 requests" in printed
+        assert "done=2" in printed
+        assert "prefix cache:" in printed
+        assert "misses=1" in printed
+        with open(os.path.join(out, "server_meta.json")) as f:
+            meta = json.load(f)
+        assert meta["counters"]["prefix_misses"] == 1
+        assert meta["counters"]["prefix_forks"] == 2
+
     def test_serve_smoke_pipeline_off(self, tmp_path, capsys):
         """The synchronous knob serves the same request list and writes
         the same artifacts (the debugging path stays usable end to
@@ -201,6 +239,33 @@ class TestSweepCommand:
         ])
         assert rc == 0
         assert "done=3" in capsys.readouterr().out
+
+    def test_sweep_warmup_spec_through_cli(self, tmp_path, capsys):
+        """A spec-level warmup block rides the CLI unchanged: trials
+        share one warmed snapshot (docs/sweeps.md, 'Shared warmup')."""
+        spec = {
+            "composite": "minimal_ode",
+            "space": {"kind": "grid", "params": {
+                "environment/glucose_external": {"grid": [0.5, 1.0, 2.0]},
+            }},
+            "horizon": 8.0,
+            "warmup": {"horizon": 4.0},
+            "objective": {"path": "cell/glucose_internal",
+                          "reduction": "final_live_sum", "mode": "max"},
+            "capacity": 4,
+            "backend": {"kind": "server", "lanes": 2, "window": 4},
+        }
+        path = tmp_path / "warm.json"
+        path.write_text(json.dumps(spec))
+        out = str(tmp_path / "warm_sweep")
+        rc = main(["sweep", "--spec", str(path), "--out-dir", out])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "sweep: 3 trials (done=3)" in printed
+        assert "best: trial 2" in printed
+        with open(os.path.join(out, "sweep_result.json")) as f:
+            table = json.load(f)
+        assert table["spec"]["warmup"] == {"horizon": 4.0}
 
     def test_sweep_save_trajectories_needs_out_dir(self, tmp_path):
         with pytest.raises(SystemExit, match="out-dir"):

@@ -324,7 +324,10 @@ class TestHoldStateResubmit:
         assert _leaves_equal(chained, ref)
         srv.close()
 
-    def test_resubmit_validates_and_is_exactly_once(self):
+    def test_resubmit_validates_and_is_n_forkable(self):
+        """Round 11 retired the exactly-once restriction: the held
+        state lives refcounted in the snapshot store, so one parent can
+        be extended/forked any number of times until release_state."""
         srv = _toggle_server(lanes=2)
         plain = srv.submit(ScenarioRequest(
             composite="toggle_colony", seed=1, horizon=8.0
@@ -340,12 +343,19 @@ class TestHoldStateResubmit:
             srv.resubmit(plain, 8.0)  # not submitted with hold_state
         with pytest.raises(ValueError, match="not a positive multiple"):
             srv.resubmit(held, 0.25)  # off the step grid
-        cont = srv.resubmit(held, 8.0)
-        with pytest.raises(ValueError, match="no final state"):
-            srv.resubmit(held, 8.0)  # held state consumed exactly once
+        # N continuations from ONE parent, all bitwise-identical twins
+        conts = [srv.resubmit(held, 8.0) for _ in range(3)]
         srv.run_until_idle(max_ticks=100)
-        assert srv.status(cont)["status"] == DONE
-        assert srv.status(cont)["steps_done"] == 16
+        for cont in conts:
+            assert srv.status(cont)["status"] == DONE
+            assert srv.status(cont)["steps_done"] == 16
+        results = [srv.result(c) for c in conts]
+        for other in results[1:]:
+            assert _leaves_equal(results[0], other)
+        # dropping the hold ends the parent's extendability
+        srv.release_state(held)
+        with pytest.raises(ValueError, match="no final state"):
+            srv.resubmit(held, 8.0)
         srv.close()
 
     def test_release_state_drops_held_state(self):

@@ -1,0 +1,618 @@
+"""Prefix-cached scenario forking: the snapshot store and its serving
+semantics.
+
+Round 11's determinism contract, in this repo's bitwise culture:
+
+- a forked suffix is BITWISE what the corresponding tail of a solo
+  full run from t=0 produces — including the stochastic hybrid_cell
+  composite, across admission orders, with the pipeline on;
+- cache hit, cache miss, and post-eviction fallback all produce the
+  same bits (the cache changes WORK, never results);
+- refcounts are exact: no double-free, no leak at ``close()``; LRU
+  eviction respects the byte budget and never touches pinned entries.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lens_tpu.serve import (
+    DONE,
+    QUEUED,
+    QueueFull,
+    ScenarioRequest,
+    SimServer,
+    SnapshotStore,
+    snapshot_key,
+)
+from lens_tpu.serve.snapshots import (
+    overrides_fingerprint,
+    tree_nbytes,
+)
+
+
+def _toggle_server(**kw):
+    kw.setdefault("lanes", 4)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def _tail(ts, n):
+    """The last n rows of every leaf of a timeseries tree."""
+    return jax.tree.map(lambda x: np.asarray(x)[-n:], ts)
+
+
+class TestSnapshotKey:
+    """The content address: same content -> same key, any change ->
+    a different key."""
+
+    def test_key_is_stable_and_order_insensitive(self):
+        a = snapshot_key("b", 3, 1, {"g": {"x": 1.0, "y": 2.0}}, 10)
+        b = snapshot_key("b", 3, 1, {"g": {"y": 2.0, "x": 1.0}}, 10)
+        assert a == b
+        assert snapshot_key("b", 3, 1, {}, 10) == snapshot_key(
+            "b", 3, 1, None, 10
+        )
+
+    def test_key_distinguishes_every_coordinate(self):
+        base = snapshot_key("b", 3, 1, {"g": {"x": 1.0}}, 10)
+        assert snapshot_key("c", 3, 1, {"g": {"x": 1.0}}, 10) != base
+        assert snapshot_key("b", 4, 1, {"g": {"x": 1.0}}, 10) != base
+        assert snapshot_key("b", 3, 2, {"g": {"x": 1.0}}, 10) != base
+        assert snapshot_key("b", 3, 1, {"g": {"x": 1.5}}, 10) != base
+        assert snapshot_key("b", 3, 1, {"g": {"x": 1.0}}, 11) != base
+
+    def test_value_fingerprint_sees_dtype_shape_bytes(self):
+        f32 = overrides_fingerprint({"x": np.float32(1.0)})
+        f64 = overrides_fingerprint({"x": np.float64(1.0)})
+        assert f32 != f64
+        flat = overrides_fingerprint({"x": np.zeros(4)})
+        grid = overrides_fingerprint({"x": np.zeros((2, 2))})
+        assert flat != grid
+
+    def test_per_species_n_agents(self):
+        a = snapshot_key("b", 0, {"e": 2, "s": 1}, {}, 4)
+        b = snapshot_key("b", 0, {"s": 1, "e": 2}, {}, 4)
+        assert a == b
+        assert snapshot_key("b", 0, {"e": 1, "s": 1}, {}, 4) != a
+
+
+class TestSnapshotStore:
+    """Refcounting, byte budget, LRU — pure host-side unit tests."""
+
+    def _state(self, nbytes=800, fill=0.0):
+        return {"x": np.full(nbytes // 8, fill, np.float64)}
+
+    def test_put_get_and_accounting(self):
+        store = SnapshotStore()
+        st = self._state()
+        assert store.put(("k", 1), st) == 0
+        assert ("k", 1) in store and len(store) == 1
+        assert store.resident_bytes() == tree_nbytes(st) == 800
+        assert store.state(("k", 1)) is st
+        with pytest.raises(KeyError):
+            store.state(("k", 2))
+
+    def test_lru_eviction_respects_budget_and_order(self):
+        store = SnapshotStore(budget_bytes=2000)
+        for i in range(3):  # 800 each: third insert must evict ONE
+            store.put(("k", i), self._state())
+        assert len(store) == 2 and store.resident_bytes() <= 2000
+        assert ("k", 0) not in store  # least recently used went first
+        store.state(("k", 1))  # touch 1: now 2 is the LRU victim
+        store.put(("k", 3), self._state())
+        assert ("k", 1) in store and ("k", 2) not in store
+
+    def test_pinned_entries_are_never_evicted(self):
+        store = SnapshotStore(budget_bytes=2000)
+        store.put(("pin", 0), self._state(), pin=True)
+        store.put(("pin", 1), self._state(), pin=True)
+        evicted = store.put(("cache", 0), self._state())
+        # the unpinned newcomer is the only evictable entry: it is the
+        # one not retained; the pinned working set stays whole
+        assert evicted == 1
+        assert ("pin", 0) in store and ("pin", 1) in store
+        assert ("cache", 0) not in store
+        store.release(("pin", 0))
+        store.put(("cache", 1), self._state())  # now 0 can make room
+        assert ("pin", 0) not in store and ("cache", 1) in store
+
+    def test_oversized_unpinned_entry_is_not_retained(self):
+        store = SnapshotStore(budget_bytes=100)
+        assert store.put(("big", 0), self._state(800)) == 1
+        assert len(store) == 0
+        # pinned inserts always land: the budget governs the cache,
+        # not the client's explicit working set
+        store.put(("big", 1), self._state(800), pin=True)
+        assert ("big", 1) in store
+
+    def test_refcounts_exact_no_double_free(self):
+        store = SnapshotStore()
+        store.put(("k",), self._state())
+        store.acquire(("k",))
+        store.acquire(("k",))
+        assert store.refs_total() == 2
+        store.release(("k",))
+        store.release(("k",))
+        assert store.refs_total() == 0
+        with pytest.raises(RuntimeError, match="double release"):
+            store.release(("k",))
+        with pytest.raises(KeyError):
+            store.release(("nope",))
+
+    def test_put_existing_key_keeps_incumbent_state(self):
+        store = SnapshotStore()
+        first = self._state(fill=1.0)
+        store.put(("k",), first)
+        store.put(("k",), self._state(fill=2.0), pin=True)
+        # content-addressed: same key = same bits by contract, so the
+        # incumbent stays and simply absorbs the pin
+        assert store.state(("k",)) is first
+        assert store.refs_total() == 1
+
+    def test_drop_and_clear(self):
+        store = SnapshotStore()
+        store.put(("a",), self._state())
+        store.put(("b",), self._state(), pin=True)
+        store.drop(("a",))
+        assert ("a",) not in store
+        with pytest.raises(RuntimeError, match="pinned"):
+            store.drop(("b",))
+        store.drop(("missing",))  # no-op
+        store.clear()
+        assert len(store) == 0 and store.resident_bytes() == 0
+
+
+class TestForkDeterminism:
+    """Forked-suffix bitwise == solo full run from t=0."""
+
+    def _solo(self, srv, seed, horizon, composite):
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=seed, horizon=horizon
+        ))
+        srv.run_until_idle(max_ticks=400)
+        return srv.result(rid)
+
+    def test_fork_suffix_bitwise_equals_solo_tail_stochastic(self):
+        """hybrid_cell (tau-leap Gillespie), pipeline on, forks
+        co-batched with unrelated traffic in shuffled orders: the
+        cached-prefix fork must reproduce the solo run's suffix rows
+        exactly — times AND bits."""
+        composite = "hybrid_cell"
+        srv = SimServer.single_bucket(
+            composite, lanes=4, window=8, capacity=16
+        )
+        ref = self._solo(srv, 3, 32.0, composite)
+        srv.close()
+
+        fork = {
+            "seed": 3, "horizon": 32.0, "prefix": {"horizon": 24.0}
+        }
+        noise = [
+            {"seed": 7, "horizon": 16.0},
+            {"seed": 11, "horizon": 8.0},
+        ]
+        for order in ([fork] + noise, noise + [fork]):
+            srv = SimServer.single_bucket(
+                composite, lanes=4, window=8, capacity=16
+            )
+            target = None
+            for sub in order:
+                rid = srv.submit(
+                    ScenarioRequest(composite=composite, **sub)
+                )
+                if "prefix" in sub:
+                    target = rid
+            srv.run_until_idle(max_ticks=400)
+            out = srv.result(target)
+            np.testing.assert_array_equal(
+                out["__times__"], np.asarray(ref["__times__"])[-8:]
+            )
+            assert _leaves_equal(out, _tail(ref, 8))
+            srv.close()
+
+    def test_hit_miss_and_post_eviction_fallback_bitwise_equal(self):
+        fork = dict(
+            composite="toggle_colony", seed=5, horizon=16.0,
+            prefix={"horizon": 8.0},
+        )
+        srv = _toggle_server()
+        a = srv.submit(ScenarioRequest(**fork))  # cold: miss
+        srv.run_until_idle(max_ticks=100)
+        b = srv.submit(ScenarioRequest(**fork))  # warm: hit
+        srv.run_until_idle(max_ticks=100)
+        ra, rb = srv.result(a), srv.result(b)
+        c = srv.metrics()["counters"]
+        assert c["prefix_misses"] == 1 and c["prefix_hits"] == 1
+        assert c["prefix_forks"] == 2
+        assert _leaves_equal(ra, rb)
+        srv.close()
+
+        # budget 0: every prefix snapshot is evicted on arrival, so
+        # EVERY fork takes the miss/fallback path — bits must not care
+        srv0 = _toggle_server(snapshot_budget_mb=0)
+        x = srv0.submit(ScenarioRequest(**fork))
+        srv0.run_until_idle(max_ticks=100)
+        y = srv0.submit(ScenarioRequest(**fork))
+        srv0.run_until_idle(max_ticks=100)
+        c = srv0.metrics()["counters"]
+        assert c["prefix_misses"] == 2 and c["prefix_hits"] == 0
+        assert c["snapshot_evictions"] >= 2
+        assert srv0.metrics()["snapshots_resident"] == 0
+        assert _leaves_equal(srv0.result(x), ra)
+        assert _leaves_equal(srv0.result(y), ra)
+        srv0.close()
+
+    def test_coalesced_forks_share_one_prefix_run(self):
+        """N concurrent submitters of one prefix: exactly one miss,
+        N-1 coalesced waiters, N forks — and identical bits."""
+        srv = _toggle_server(lanes=4)
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=9, horizon=16.0,
+                prefix={"horizon": 8.0},
+            ))
+            for _ in range(4)
+        ]
+        srv.run_until_idle(max_ticks=200)
+        c = srv.metrics()["counters"]
+        assert c["prefix_misses"] == 1
+        assert c["prefix_coalesced"] == 3
+        assert c["prefix_forks"] == 4
+        assert c["prefix_hits"] == 0
+        results = [srv.result(r) for r in rids]
+        for other in results[1:]:
+            assert _leaves_equal(results[0], other)
+        assert srv.metrics()["retraces"] == 0
+        srv.close()
+
+    def test_divergent_overrides_fork_at_the_fork_point(self):
+        """Two forks of one prefix with different interventions: the
+        override lands at the fork (not t=0), both runs share the
+        prefix, and each fork's bits are reproducible from a cold
+        cache (the miss path re-derives them)."""
+
+        def run(server):
+            subs = [
+                dict(
+                    composite="toggle_colony", seed=2, horizon=16.0,
+                    prefix={"horizon": 8.0},
+                    # stay under toggle's division trigger (volume 2.0
+                    # divides the cell right back on the first step)
+                    overrides={"global": {"volume": v}},
+                )
+                for v in (1.6, 0.5)
+            ]
+            rids = [server.submit(ScenarioRequest(**s)) for s in subs]
+            server.run_until_idle(max_ticks=200)
+            return [server.result(r) for r in rids]
+
+        srv = _toggle_server()
+        hi, lo = run(srv)
+        # the intervention took hold AT the fork: first suffix row
+        # reflects one step of dynamics from the overridden value
+        v_hi = np.asarray(hi["global"]["volume"])[0, 0]
+        v_lo = np.asarray(lo["global"]["volume"])[0, 0]
+        assert v_hi > 1.5 and v_lo < 0.75
+        assert srv.metrics()["counters"]["prefix_misses"] == 1
+        srv.close()
+
+        cold = _toggle_server()  # fresh store: both re-derive via miss
+        hi2, lo2 = run(cold)
+        assert _leaves_equal(hi, hi2) and _leaves_equal(lo, lo2)
+        cold.close()
+
+    def test_fork_parity_with_pipeline_off(self):
+        fork = dict(
+            composite="toggle_colony", seed=4, horizon=16.0,
+            prefix={"horizon": 8.0},
+            overrides={"global": {"volume": 1.4}},
+        )
+        out = {}
+        for mode in ("on", "off"):
+            srv = _toggle_server(pipeline=mode)
+            rid = srv.submit(ScenarioRequest(**fork))
+            srv.run_until_idle(max_ticks=100)
+            out[mode] = srv.result(rid)
+            srv.close()
+        assert _leaves_equal(out["on"], out["off"])
+
+    def test_fork_on_lattice_and_multispecies_buckets(self):
+        """apply_overrides at the fork point covers all three colony
+        forms: the lattice (SpatialState) and per-species
+        (MultiSpeciesState) wrappers fork bitwise like the bare one."""
+        cases = [
+            ("ecoli_lattice", {"capacity": 8, "shape": (8, 8)}, {}),
+            (
+                "mixed_species_lattice",
+                {
+                    "capacity": {"ecoli": 4, "scavenger": 4},
+                    "shape": (8, 8),
+                },
+                {"ecoli": {"cell": {"glucose_internal": 1.5}}},
+            ),
+        ]
+        for composite, config, overrides in cases:
+            srv = SimServer.single_bucket(
+                composite, config=config, lanes=2, window=4
+            )
+            solo = srv.submit(ScenarioRequest(
+                composite=composite, seed=1, horizon=8.0
+            ))
+            fork = srv.submit(ScenarioRequest(
+                composite=composite, seed=1, horizon=8.0,
+                prefix={"horizon": 4.0},
+            ))
+            srv.run_until_idle(max_ticks=100)
+            assert srv.status(fork)["status"] == DONE, (
+                composite, srv.status(fork)["error"]
+            )
+            assert _leaves_equal(
+                srv.result(fork), _tail(srv.result(solo), 4)
+            )
+            if overrides:
+                div = srv.submit(ScenarioRequest(
+                    composite=composite, seed=1, horizon=8.0,
+                    prefix={"horizon": 4.0}, overrides=overrides,
+                ))
+                srv.run_until_idle(max_ticks=100)
+                assert srv.status(div)["status"] == DONE, \
+                    srv.status(div)["error"]
+                assert not _leaves_equal(
+                    srv.result(div), srv.result(fork)
+                )
+            srv.close()
+
+    def test_emit_every_subsample_grid_continues_the_prefix(self):
+        """A fork's every-k emit phase counts from t=0 (the prefix's
+        rows), exactly like the solo run it must match."""
+        srv = _toggle_server(window=8)
+        solo = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=6, horizon=24.0,
+            emit={"every": 4},
+        ))
+        fork = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=6, horizon=24.0,
+            prefix={"horizon": 8.0}, emit={"every": 4},
+        ))
+        srv.run_until_idle(max_ticks=100)
+        ref, out = srv.result(solo), srv.result(fork)
+        np.testing.assert_array_equal(
+            out["__times__"], [12.0, 16.0, 20.0, 24.0]
+        )
+        assert _leaves_equal(out, _tail(ref, 4))
+        srv.close()
+
+
+class TestHeldStateStore:
+    """hold_state through the content-addressed store: N-forkable
+    parents, content reuse, exact refcounts."""
+
+    def test_pure_held_state_serves_prefix_hits(self):
+        """A hold_state run's final state IS a content-addressed
+        snapshot: a later request declaring that run as its prefix
+        hits the cache — zero extra prefix simulation."""
+        srv = _toggle_server()
+        parent = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=8, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        cont = srv.resubmit(parent, 8.0)
+        fork = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=8, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        srv.run_until_idle(max_ticks=100)
+        c = srv.metrics()["counters"]
+        assert c["prefix_hits"] == 1 and c["prefix_misses"] == 0
+        # the fork and the resubmit continuation are the same suffix
+        assert _leaves_equal(srv.result(cont), srv.result(fork))
+        srv.close()
+
+    def test_refcounts_exact_and_no_leak_at_close(self):
+        srv = _toggle_server(lanes=2)
+        parent = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.snapshots.refs_total() == 1  # the parent's pin
+        c1 = srv.resubmit(parent, 8.0)
+        assert srv.snapshots.refs_total() == 2  # + queued carry pin
+        srv.run_until_idle(max_ticks=100)
+        # carry released at scatter; the continuation (hold_state
+        # inherited from the parent request) now pins its OWN snapshot
+        assert srv.status(c1)["status"] == DONE
+        assert srv.snapshots.refs_total() == 2
+        srv.release_state(parent)
+        srv.release_state(c1)
+        assert srv.snapshots.refs_total() == 0
+        srv.release_state(parent)  # idempotent: hold already dropped
+        srv.close()
+        assert len(srv.snapshots) == 0
+
+    def test_close_releases_outstanding_holds(self):
+        srv = _toggle_server(lanes=2)
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.snapshots.refs_total() == 1
+        srv.close()  # must not raise: the pin is released, store cleared
+        assert srv.snapshots.refs_total() == 0
+
+    def test_resubmit_rejected_by_queue_full_leaves_parent_extendable(self):
+        """Regression pin (round 11): a QueueFull continuation must
+        leave the parent's held state intact and re-extendable, with
+        no dangling snapshot ref."""
+        srv = _toggle_server(lanes=1, queue_depth=1)
+        parent = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=3, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        refs_before = srv.snapshots.refs_total()
+        blocker = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=4, horizon=8.0,
+        ))
+        with pytest.raises(QueueFull):
+            srv.resubmit(parent, 8.0)
+        assert srv.snapshots.refs_total() == refs_before  # no leak
+        assert srv.metrics()["counters"]["rejected"] == 1
+        srv.run_until_idle(max_ticks=100)  # drain the blocker
+        cont = srv.resubmit(parent, 8.0)  # still extendable
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(cont)["status"] == DONE
+        assert srv.status(cont)["steps_done"] == 16
+        assert srv.status(blocker)["status"] == DONE
+        srv.close()
+
+
+class TestPrefixValidationAndFailure:
+    def test_prefix_validation(self):
+        srv = _toggle_server()
+        base = dict(composite="toggle_colony", seed=0, horizon=16.0)
+        with pytest.raises(ValueError, match="shorter"):
+            srv.submit(ScenarioRequest(**base, prefix={"horizon": 16.0}))
+        with pytest.raises(ValueError, match="not a positive multiple"):
+            srv.submit(ScenarioRequest(**base, prefix={"horizon": 8.5}))
+        with pytest.raises(ValueError, match="needs a 'horizon'"):
+            srv.submit(ScenarioRequest(**base, prefix={}))
+        with pytest.raises(ValueError, match="unknown prefix keys"):
+            srv.submit(ScenarioRequest(
+                **base, prefix={"horizon": 8.0, "nope": 1}
+            ))
+        srv.close()
+
+    def test_failed_prefix_run_fails_every_coalesced_fork(self):
+        srv = _toggle_server()
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=0, horizon=16.0,
+                prefix={
+                    "horizon": 8.0,
+                    "overrides": {"global": {"not_a_variable": 1.0}},
+                },
+            ))
+            for _ in range(2)
+        ]
+        ok = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0
+        ))
+        srv.run_until_idle(max_ticks=100)
+        for rid in rids:
+            st = srv.status(rid)
+            assert st["status"] == "failed"
+            assert "not_a_variable" in st["error"]
+        assert srv.status(ok)["status"] == DONE  # pool unharmed
+        srv.close()
+
+    def test_bad_divergent_overrides_fail_fork_not_snapshot(self):
+        srv = _toggle_server()
+        bad = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=16.0,
+            prefix={"horizon": 8.0},
+            overrides={"global": {"not_a_variable": 1.0}},
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(bad)["status"] == "failed"
+        assert "not_a_variable" in srv.status(bad)["error"]
+        # the prefix snapshot itself was computed and cached: a good
+        # fork of the same prefix now hits
+        good = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=16.0,
+            prefix={"horizon": 8.0},
+            overrides={"global": {"volume": 1.2}},
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(good)["status"] == DONE
+        c = srv.metrics()["counters"]
+        assert c["prefix_hits"] == 1 and c["prefix_misses"] == 1
+        assert srv.snapshots.refs_total() == 0
+        srv.close()
+
+    def test_cancelled_waiting_fork_leaves_the_rest_healthy(self):
+        """Cancel a fork while it waits on an in-flight prefix: it
+        retires CANCELLED, the prefix still lands, the surviving fork
+        forks it, and no snapshot ref leaks."""
+        srv = _toggle_server(lanes=1)  # the prefix occupies the lane
+        keep = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=5, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        doomed = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=5, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        assert srv.cancel(doomed) == "cancelled"
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(keep)["status"] == DONE
+        assert srv.status(doomed)["status"] == "cancelled"
+        c = srv.metrics()["counters"]
+        assert c["prefix_misses"] == 1 and c["prefix_forks"] == 1
+        assert srv.snapshots.refs_total() == 0
+        srv.close()
+
+    def test_cancel_after_prefix_lands_drops_the_waiters_seed(self):
+        """Cancel a fork AFTER the prefix run resolved it (it holds an
+        unscattered carry_state seed while queued for a lane): the
+        terminal ticket must not keep the device tree alive — that
+        memory is invisible to the store's byte accounting."""
+        srv = _toggle_server(lanes=1)
+        keep = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=5, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        doomed = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=5, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        t = srv.tickets[doomed]
+        for _ in range(100):
+            if t.carry_state is not None or t.status != QUEUED:
+                break
+            srv.tick()
+        assert t.carry_state is not None and t.status == QUEUED, (
+            "test needs the resolved-but-unadmitted window; widen "
+            "max ticks or shrink the lane count if this trips"
+        )
+        assert srv.cancel(doomed) == "cancelled"
+        assert t.carry_state is None
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(keep)["status"] == DONE
+        assert srv.snapshots.refs_total() == 0
+        srv.close()
+
+    def test_status_and_meta_surface_snapshot_gauges(self, tmp_path):
+        import json
+        import os
+
+        out = str(tmp_path / "serve")
+        srv = _toggle_server(out_dir=out, sink="log")
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=0, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        srv.run_until_idle(max_ticks=100)
+        gauges = srv.status(rid)["server"]["snapshots"]
+        assert gauges["misses"] == 1 and gauges["forks"] == 1
+        assert gauges["resident"] == 1
+        assert gauges["resident_bytes"] > 0
+        srv.close()
+        with open(os.path.join(out, "server_meta.json")) as f:
+            meta = json.load(f)
+        assert meta["counters"]["prefix_misses"] == 1
+        assert meta["counters"]["prefix_forks"] == 1
+        assert "snapshot_bytes" in meta

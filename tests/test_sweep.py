@@ -644,3 +644,122 @@ class TestSaveAndLoadMany:
 
         with pytest.raises(NotADirectoryError):
             load_many(str(tmp_path / "nope"))
+
+
+class TestSharedWarmup:
+    """Round 11: a spec-level ``warmup`` block makes every trial (and
+    every ASHA first rung) fork ONE warmed snapshot through serve's
+    prefix cache instead of re-simulating the warmup per trial."""
+
+    def _warm_spec(self, **kw):
+        spec = _spec(warmup={"horizon": 8.0})
+        spec.update(kw)
+        return spec
+
+    def test_warmup_sweep_runs_one_prefix_for_all_trials(self):
+        res = run_sweep(self._warm_spec())
+        assert [r["status"] for r in res.table] == ["done"] * len(DOSES)
+        c = res.metrics["server"]["counters"]
+        assert c["prefix_misses"] == 1          # the warmup ran ONCE
+        assert c["prefix_coalesced"] + c["prefix_hits"] == len(DOSES) - 1
+        assert c["prefix_forks"] == len(DOSES)  # every trial forked it
+        assert res.metrics["server"]["retraces"] == 0
+        # the divergent dose still lands per trial: monotone response
+        objs = [r["objective"] for r in res.table]
+        assert all(np.diff(objs) > 0), objs
+        # emitted trajectories cover ONLY the suffix
+        times = np.asarray(res.timeseries[0]["__times__"])
+        assert times[0] > 8.0 and times[-1] == 16.0
+
+    def test_warmup_trial_bitwise_equals_solo_fork(self):
+        """A warmed trial is bitwise the solo prefixed request — the
+        serve fork contract carried through the sweep layer."""
+        from lens_tpu.serve import ScenarioRequest, SimServer
+
+        spec = self._warm_spec()
+        server = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4
+        )
+        res = run_sweep(spec, server=server)
+        target = space_from_spec(spec["space"]).trials(0)[2]
+        rid = server.submit(ScenarioRequest(
+            composite="minimal_ode",
+            seed=0,  # the warmup seed (spec seed), not the trial's
+            horizon=16.0,
+            overrides=target.overrides(),
+            prefix={"horizon": 8.0},
+            emit={"paths": ["cell/glucose_internal", "alive"]},
+        ))
+        server.run_until_idle(max_ticks=200)
+        solo = server.result(rid)
+        swept = res.timeseries[2]
+        np.testing.assert_array_equal(
+            solo["__times__"], swept["__times__"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(solo["cell"]["glucose_internal"]),
+            np.asarray(swept["cell"]["glucose_internal"]),
+        )
+        server.close()
+
+    def test_warmup_with_asha_forks_the_first_rung(self):
+        res = run_sweep(self._warm_spec(
+            asha={"min_horizon": 12.0, "eta": 2}
+        ))
+        statuses = {r["status"] for r in res.table}
+        assert statuses <= {"done", "stopped"}
+        c = res.metrics["server"]["counters"]
+        assert c["prefix_misses"] == 1
+        assert c["prefix_forks"] == len(DOSES)
+        # survivors extended via resubmit as before, never re-warmed
+        assert c["resubmitted"] >= 1
+
+    def test_warmup_kill_and_resume_bitwise(self, tmp_path):
+        full = run_sweep(self._warm_spec(),
+                         out_dir=str(tmp_path / "full"))
+        kill_dir = str(tmp_path / "killed")
+        with pytest.raises(_Kill):
+            run_sweep(self._warm_spec(), out_dir=kill_dir,
+                      on_trial=_killer_after(2))
+        resumed = run_sweep(self._warm_spec(), out_dir=kill_dir,
+                            resume=True)
+        for a, b in zip(full.table, resumed.table):
+            assert a["status"] == b["status"]
+            assert a["objective"] == b["objective"]  # bitwise
+
+    def test_warmup_changes_the_resume_fingerprint(self, tmp_path):
+        out = str(tmp_path / "s")
+        run_sweep(_spec(), out_dir=out)
+        with pytest.raises(ValueError, match="fingerprint"):
+            run_sweep(self._warm_spec(), out_dir=out, resume=True)
+
+    def test_warmupless_canonical_has_no_warmup_key(self):
+        """Compat pin: a spec without ``warmup`` must canonicalize to
+        the same fields as before round 11, or every pre-existing
+        ledger's fingerprint guard would refuse a legitimate resume."""
+        from lens_tpu.sweep.driver import SweepSpec
+
+        assert "warmup" not in SweepSpec.from_mapping(
+            _spec()
+        ).canonical()
+        assert SweepSpec.from_mapping(
+            self._warm_spec()
+        ).canonical()["warmup"] == {"horizon": 8.0}
+
+    def test_warmup_validation(self):
+        with pytest.raises(ValueError, match="shorter than"):
+            run_sweep(self._warm_spec(warmup={"horizon": 16.0}))
+        with pytest.raises(ValueError, match="needs a 'horizon'"):
+            run_sweep(self._warm_spec(warmup={}))
+        with pytest.raises(ValueError, match="unknown warmup keys"):
+            run_sweep(self._warm_spec(
+                warmup={"horizon": 8.0, "nope": 1}
+            ))
+        with pytest.raises(ValueError, match="first asha rung"):
+            run_sweep(self._warm_spec(
+                asha={"min_horizon": 8.0, "eta": 2}
+            ))
+        with pytest.raises(ValueError, match="server"):
+            run_sweep(self._warm_spec(
+                backend={"kind": "ensemble", "batch": 4}
+            ))
