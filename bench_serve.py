@@ -427,6 +427,127 @@ def warmup_sweep_ab(
     }
 
 
+def faults_ab(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, fill_rounds: int, reps: int,
+    tmp_root: str,
+):
+    """Interleaved A/B of the round-12 robustness knobs at one lane
+    count: the same saturated round (N = fill_rounds * lanes
+    equal-horizon requests) through four warmed servers — ``off``
+    (round-11 behavior), ``check`` (``check_finite="window"``),
+    ``wal`` (``recover_dir`` write-ahead logging + group-commit
+    fsync), and ``both``. Each mode's wall is min-of-reps with the
+    modes alternating per rep (this host's clock wanders ±20%);
+    overheads are quoted against ``off``. The acceptance bar
+    (ISSUE 10): ``both`` <= 5% at 8 lanes."""
+    import os
+
+    modes = {
+        "off": {},
+        "check": {"check_finite": "window"},
+        "wal": {"recover_dir": os.path.join(tmp_root, f"wal_{lanes}")},
+        "both": {
+            "check_finite": "window",
+            "recover_dir": os.path.join(tmp_root, f"both_{lanes}"),
+        },
+    }
+    n = fill_rounds * lanes
+    servers = {}
+    for mode, extra in modes.items():
+        out_dir = None
+        sink = "ram"
+        if "recover_dir" in extra:
+            # the WAL path requires on-disk results (sink="log"), so
+            # the wal rows also pay the result-log writes; the honest
+            # comparison for THEM is the log-sink off row below
+            out_dir = os.path.join(tmp_root, f"out_{mode}_{lanes}")
+            sink = "log"
+        servers[mode] = SimServer.single_bucket(
+            composite, capacity=capacity, lanes=lanes, window=window,
+            emit_every=emit_every, queue_depth=max(2 * n, 16),
+            out_dir=out_dir, sink=sink, **extra,
+        )
+    # log-sink baseline so WAL overhead is measured against the same
+    # sink (ram-vs-log would mis-bill the result-log writes to the WAL)
+    servers["off_log"] = SimServer.single_bucket(
+        composite, capacity=capacity, lanes=lanes, window=window,
+        emit_every=emit_every, queue_depth=max(2 * n, 16),
+        out_dir=os.path.join(tmp_root, f"out_off_log_{lanes}"),
+        sink="log",
+    )
+    for srv in servers.values():
+        _warm(srv, composite, lanes, window)
+
+    walls = {mode: float("inf") for mode in servers}
+    for rep in range(reps):
+        for mode, srv in servers.items():
+            wall = _serve_round(
+                srv, composite, n, horizon_steps,
+                seed0=100 + rep * len(servers) * n,
+            )
+            walls[mode] = min(walls[mode], wall)
+    row = {
+        "lanes": lanes,
+        "n_requests": n,
+        "horizon_steps": horizon_steps,
+        "walls_s": {m: round(w, 4) for m, w in walls.items()},
+        "served_row_steps_s": {
+            m: round(n * horizon_steps * capacity / w)
+            for m, w in walls.items()
+        },
+        # ram-sink knob cost (the in-process/bench serving shape)
+        "check_overhead": round(walls["check"] / walls["off"] - 1, 4),
+        # log-sink knob costs (the CLI/recovery serving shape)
+        "wal_overhead": round(walls["wal"] / walls["off_log"] - 1, 4),
+        "both_overhead": round(walls["both"] / walls["off_log"] - 1, 4),
+        "diverged": servers["both"].metrics()["counters"]["diverged"],
+        "retraces": max(
+            s.metrics()["retraces"] for s in servers.values()
+        ),
+    }
+    for srv in servers.values():
+        srv.close()
+    return row
+
+
+def run_faults_bench(args) -> int:
+    import tempfile
+
+    horizon_steps = args.horizon_windows * args.window
+    record = {
+        "bench": "serve_faults",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "reps": args.reps,
+        "protocol": "interleaved min-of-reps across warmed servers "
+        "(off / check_finite=window / recover_dir WAL / both); "
+        "check_overhead vs the ram-sink off server, wal/both vs a "
+        "log-sink off server so result-log writes are not billed to "
+        "the WAL",
+        "faults_ab": [],
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        for lanes in args.lanes:
+            row = faults_ab(
+                args.composite, args.capacity, lanes, args.window,
+                args.emit_every, horizon_steps, args.fill_rounds,
+                args.reps, tmp,
+            )
+            record["faults_ab"].append(row)
+            print(json.dumps(row), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    worst = max(e["both_overhead"] for e in record["faults_ab"])
+    print(f"worst check+WAL overhead: {worst * 100:.1f}%")
+    return 0
+
+
 def run_prefix_bench(args) -> int:
     horizon_steps = args.horizon_windows * args.window
     prefix_windows = int(round(args.prefix_frac * args.horizon_windows))
@@ -521,6 +642,12 @@ def main() -> int:
         "unless --out is given)",
     )
     p.add_argument(
+        "--faults", action="store_true",
+        help="run the round-12 robustness-knob A/B (check_finite + "
+        "WAL overhead, on vs off, per lane count; writes "
+        "BENCH_FAULTS_CPU_r12.json unless --out is given)",
+    )
+    p.add_argument(
         "--prefix-frac", type=float, default=0.75,
         help="shared-prefix fraction of the horizon (fork A/B), "
         "snapped to whole windows",
@@ -538,6 +665,13 @@ def main() -> int:
     args = p.parse_args()
 
     # per-mode defaults (None = not explicitly passed)
+    if args.prefix and args.faults:
+        raise SystemExit("--prefix and --faults are separate modes")
+    if args.faults:
+        args.out = args.out or "BENCH_FAULTS_CPU_r12.json"
+        args.lanes = args.lanes or [2, 4, 8]
+        args.horizon_windows = args.horizon_windows or 6
+        return run_faults_bench(args)
     if args.prefix:
         args.out = args.out or "BENCH_FORK_CPU_r11.json"
         args.lanes = args.lanes or [1, 8]

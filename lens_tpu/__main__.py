@@ -226,6 +226,35 @@ def _build_parser() -> argparse.ArgumentParser:
         "(unpinned prefix snapshots are evicted LRU-first past it; "
         "see docs/serving.md, 'Prefix caching & forking')",
     )
+    serve.add_argument(
+        "--check-finite", choices=["off", "window"], default="off",
+        help="lane quarantine: per-window finite check over every "
+        "lane's state; a lane that goes NaN/Inf fails ONLY its "
+        "request (SimulationDiverged) and is reclaimed, co-batched "
+        "lanes untouched (docs/serving.md, 'Fault tolerance & "
+        "recovery'). off = the bitwise round-11 path",
+    )
+    serve.add_argument(
+        "--watchdog", type=float, default=None, metavar="SECONDS",
+        help="expire a hung device-window/streamer handoff after this "
+        "many stalled seconds (WatchdogTimeout) instead of wedging "
+        "the scheduler forever; default: wait indefinitely",
+    )
+    serve.add_argument(
+        "--recover-dir", default=None, metavar="DIR",
+        help="serve write-ahead log + held-snapshot spills live here; "
+        "if DIR already holds a WAL the server RECOVERS first "
+        "(finished requests keep their logs, unfinished ones re-run "
+        "bitwise) and the request list resumes past the requests "
+        "already recorded",
+    )
+    serve.add_argument(
+        "--faults", default=None, metavar="JSON",
+        help="fault-injection plan (a JSON file, or '-' for stdin): "
+        '{"seed": 0, "faults": [{"kind": "nan", "request": '
+        '"req-000001", "after_steps": 16}, ...]} — deterministic '
+        "chaos for tests/CI (docs/serving.md, 'Fault injection')",
+    )
 
     sweep = sub.add_parser(
         "sweep",
@@ -367,7 +396,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     ``lens_tpu.emit.log.tail_records``."""
     import time
 
-    from lens_tpu.serve import QueueFull, ScenarioRequest, SimServer
+    from lens_tpu.serve import (
+        FaultPlan,
+        QueueFull,
+        ScenarioRequest,
+        SimServer,
+    )
 
     if args.requests == "-":
         raw = json.load(sys.stdin)
@@ -379,6 +413,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             f"--requests must be a JSON list of request objects, got "
             f"{type(raw).__name__}"
         )
+    faults = None
+    if args.faults is not None:
+        if args.faults == "-" and args.requests == "-":
+            raise SystemExit(
+                "--requests - and --faults - cannot both read stdin; "
+                "put at least one in a file"
+            )
+        try:
+            faults = FaultPlan.from_spec(
+                json.load(sys.stdin) if args.faults == "-"
+                else args.faults
+            )
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            raise SystemExit(f"--faults: {e}")
 
     server = SimServer.single_bucket(
         args.composite,
@@ -395,21 +443,52 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         stream_queue=args.stream_queue,
         flush_every=args.flush_every,
         snapshot_budget_mb=args.snapshot_budget_mb,
+        check_finite=args.check_finite,
+        watchdog_s=args.watchdog,
+        recover_dir=args.recover_dir,
+        faults=faults,
     )
     with server:
+        if server.recovered or any(
+            not t.internal for t in server.tickets.values()
+        ):
+            # recovery replayed part of a previous invocation's list:
+            # resume submitting past what the WAL already knows (the
+            # CLI submits serially, so WAL submit order == list order)
+            done_already = sum(
+                1 for t in server.tickets.values() if not t.internal
+            )
+            print(
+                f"recovered {done_already} request(s) from "
+                f"{args.recover_dir} ({server.recovered} re-queued); "
+                f"resuming at request #{done_already}"
+            )
+            raw = raw[done_already:]
         ids = []
         for req in raw:
             req = dict(req)
             req.setdefault("composite", args.composite)
+            try:
+                request = ScenarioRequest.from_mapping(req)
+            except (ValueError, TypeError) as e:
+                raise SystemExit(f"bad request {req!r}: {e}")
             while True:
                 try:
-                    ids.append(server.submit(ScenarioRequest(**req)))
+                    ids.append(server.submit(request))
                     break
                 except QueueFull as e:
                     # the CLI is its own client: drain by ticking (a
                     # remote client would sleep e.retry_after instead)
                     server.tick()
                     time.sleep(min(e.retry_after, 0.05))
+                except ValueError as e:
+                    raise SystemExit(f"bad request {req!r}: {e}")
+        # recovered re-queued requests report alongside fresh ones
+        ids = [
+            t.request_id
+            for t in server.tickets.values()
+            if not t.internal and t.request_id not in ids
+        ] + ids
         server.run_until_idle()
         snap = server.metrics()
         by_status: dict = {}
@@ -450,8 +529,15 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"resident={snap['snapshots_resident']} "
                 f"({snap['snapshot_bytes'] / 2**20:.1f} MiB)"
             )
+        if c["diverged"] or c["recovered"]:
+            print(
+                f"fault tolerance: diverged={c['diverged']} "
+                f"recovered={c['recovered']}"
+            )
         print(f"results: {args.out_dir}/<request-id>.lens")
         print(f"meta:    {args.out_dir}/server_meta.json")
+        if args.recover_dir:
+            print(f"wal:     {args.recover_dir}/serve.wal")
     return 0
 
 

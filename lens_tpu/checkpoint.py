@@ -94,6 +94,32 @@ def _from_plain(plain: Any) -> Any:
     raise ValueError(f"unrecognized checkpoint key set {sorted(keys)}")
 
 
+def save_tree(path: str, state: Any) -> str:
+    """Crash-safe orbax save of ONE state tree at an arbitrary path
+    (no step indexing): write ``<path>.tmp-save``, rename into place —
+    the same protocol as :meth:`Checkpointer.save`, factored out for
+    trees that are not steps of a run. The serve layer's held-snapshot
+    spill (``lens_tpu.serve.wal``) is the client: a ``hold_state``
+    request's pinned final state lands here at retirement, so a killed
+    server's ``resubmit`` chain can continue from the exact bits after
+    recovery. Single-process only (the serve layer is single-host; the
+    multi-host promotion barrier lives in ``Checkpointer.save``)."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp-save"
+    ocp.PyTreeCheckpointer().save(tmp, _to_plain(state), force=True)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+    return path
+
+
+def restore_tree(path: str) -> Any:
+    """Inverse of :func:`save_tree` (typed states rebuilt)."""
+    plain = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+    return _from_plain(jax.tree.map(jax.numpy.asarray, plain))
+
+
 class Checkpointer:
     """Save/restore simulation states under one directory."""
 

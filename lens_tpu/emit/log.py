@@ -228,6 +228,84 @@ class FramedWriter:
             raise close_error
 
 
+class JsonFrameLog:
+    """Append-only framed-JSON event file: the one crash-safe
+    event-log discipline every durable scheduler in the repo shares.
+
+    The sweep trial ledger (``lens_tpu.sweep.ledger``) and the serve
+    write-ahead log (``lens_tpu.serve.wal``) both need the same thing:
+    a sequence of small JSON events framed with the emit-log record
+    frame (magic + CRC + length, so a kill mid-append loses at most the
+    torn tail frame), replayed at open, appended durably afterwards.
+    This class is that shared layer; the callers own the event
+    vocabulary and the replayed state.
+
+    Open semantics: every complete frame is decoded into ``events`` (a
+    complete frame with undecodable JSON raises — the file is not an
+    event log); a torn tail frame is TRUNCATED before reopening for
+    append, so this run's events can never land after torn bytes and
+    turn a cleanly-lost tail into corruption on the next replay.
+
+    ``append(event)`` frames + writes + flushes to the OS (a SIGKILL'd
+    process loses nothing already appended); ``fsync_every=True``
+    (the ledger's policy) additionally fsyncs per append, while
+    ``False`` defers the fsync to explicit :meth:`sync` calls (the
+    serve WAL's group-commit policy — one fsync per scheduler tick
+    covers every append since the last, and because appends are
+    sequential a sync always makes a clean PREFIX durable).
+    """
+
+    def __init__(self, path: str, fsync_every: bool = True):
+        self.path = path
+        self.fsync_every = bool(fsync_every)
+        self.events: List[Dict[str, Any]] = []
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            good = 0  # offset past the last COMPLETE frame
+            for payload, end in iter_frames(path, with_offsets=True):
+                try:
+                    event = json.loads(payload.decode())
+                except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                    raise ValueError(
+                        f"{path}: complete frame with undecodable JSON "
+                        f"payload ({e}) — not an event log?"
+                    )
+                self.events.append(event)
+                good = end
+            if os.path.getsize(path) > good:
+                # kill mid-append left a torn tail frame: drop it NOW,
+                # before reopening for append
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+        self._file = open(path, "ab")
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def append(self, event: Mapping[str, Any]) -> Dict[str, Any]:
+        """Frame + write + flush one event (fsync per the policy);
+        returns the plain-dict form appended to ``events``."""
+        event = dict(event)
+        payload = json.dumps(event, sort_keys=True, default=float).encode()
+        self._file.write(frame(payload))
+        self._file.flush()
+        if self.fsync_every:
+            os.fsync(self._file.fileno())
+        self.events.append(event)
+        return event
+
+    def sync(self) -> None:
+        """Group commit: fsync everything appended so far."""
+        if self._file is not None:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
 def iter_frames(
     path: str, with_offsets: bool = False
 ) -> Iterator[bytes] | Iterator[Tuple[bytes, int]]:

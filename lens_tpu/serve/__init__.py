@@ -15,29 +15,36 @@ from lens_tpu.serve.batcher import (
     QUEUED,
     QueueFull,
     RUNNING,
+    SimulationDiverged,
     TIMEOUT,
     ScenarioRequest,
 )
+from lens_tpu.serve.faults import FaultPlan
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
 from lens_tpu.serve.server import SimServer
 from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
-from lens_tpu.serve.streamer import Streamer
+from lens_tpu.serve.streamer import Streamer, WatchdogTimeout
+from lens_tpu.serve.wal import ServeWal
 
 __all__ = [
     "CANCELLED",
     "DONE",
     "FAILED",
     "QUEUED",
+    "FaultPlan",
     "QueueFull",
     "RUNNING",
     "TIMEOUT",
     "LanePool",
     "ScenarioRequest",
+    "ServeWal",
     "ServerMetrics",
     "SimServer",
+    "SimulationDiverged",
     "SnapshotStore",
     "Streamer",
+    "WatchdogTimeout",
     "snapshot_key",
     "write_server_meta",
 ]

@@ -23,8 +23,8 @@ from __future__ import annotations
 
 import itertools
 import time
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Mapping, Optional
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Iterator, List, Mapping, Optional
 
 #: Request lifecycle states. Terminal: DONE, TIMEOUT, CANCELLED, FAILED.
 QUEUED = "queued"
@@ -49,6 +49,20 @@ class QueueFull(Exception):
             f"request queue full ({depth} deep); retry in "
             f"~{self.retry_after:.2f}s"
         )
+
+
+class SimulationDiverged(Exception):
+    """A request's lane produced non-finite state (NaN/Inf).
+
+    Raised by ``SimServer.result`` for a request the per-window finite
+    check (``check_finite="window"``) quarantined: its physics
+    diverged, the request retired FAILED, its lane was reclaimed, and
+    co-resident lanes are bitwise untouched (the serve path has no
+    cross-lane coupling). Records streamed before detection — up to
+    one window of which may be post-divergence garbage — stay in the
+    request's sink/log; this error is what keeps a caller from
+    mistaking them for a completed result.
+    """
 
 
 @dataclass
@@ -113,6 +127,23 @@ class ScenarioRequest:
     hold_state: bool = False
     prefix: Optional[Mapping[str, Any]] = None
 
+    @classmethod
+    def from_mapping(
+        cls, request: Mapping[str, Any]
+    ) -> "ScenarioRequest":
+        """Build from a JSON-shaped dict with a DESCRIPTIVE unknown-key
+        error (``cls(**request)`` would raise an opaque ``TypeError``
+        naming dataclass internals) — the CLI and ``SimServer.submit``
+        both route mapping submissions through here."""
+        known = {f.name for f in fields(cls)}
+        unknown = set(request) - known
+        if unknown:
+            raise ValueError(
+                f"unknown request keys {sorted(unknown)}; known: "
+                f"{sorted(known)}"
+            )
+        return cls(**request)
+
 
 @dataclass
 class Ticket:
@@ -155,6 +186,9 @@ class Ticket:
     waiting: bool = False
     internal: bool = False
     parent: Optional[str] = None
+    # quarantine (check_finite): the per-window finite check flagged
+    # this ticket's lane; result() raises SimulationDiverged
+    diverged: bool = False
 
     def expired(self, now: float) -> bool:
         return (
@@ -180,6 +214,19 @@ class RequestQueue:
 
     def __len__(self) -> int:
         return len(self._queue)
+
+    def __iter__(self) -> Iterator[Ticket]:
+        """Queued tickets in FIFO order (read-only: the occupancy-
+        derived ``retry_after`` hint sums the backlog's remaining
+        windows)."""
+        return iter(list(self._queue))
+
+    def skip_ids(self, first: int) -> None:
+        """Advance the id mint so the next id is ``req-<first>`` — WAL
+        recovery reserves every id the previous incarnation handed out
+        (re-queued tickets keep their original ids; fresh submissions
+        must never collide with them)."""
+        self._ids = itertools.count(int(first))
 
     def push(
         self, ticket: Ticket, retry_after: float, force: bool = False

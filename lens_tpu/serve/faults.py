@@ -1,0 +1,249 @@
+"""Deterministic fault injection for the serve stack.
+
+A production server meets three failure classes the happy path never
+exercises: physics that diverges to NaN/Inf inside a lane, I/O that
+fails or hangs under the scheduler (a full disk, a wedged sink), and
+the process dying outright (OOM killer, preemption, deploy). Testing
+the recovery machinery against them requires the faults to be
+REPRODUCIBLE — a chaos test that only fails sometimes is worse than no
+test — so this module is a declarative, seeded fault schedule threaded
+through the server's named seams, not a monkeypatching grab-bag.
+
+A :class:`FaultPlan` holds a list of faults; each names the seam it
+arms, an optional request filter, and an occurrence index (the N-th
+time the seam fires with a matching context), so a given plan replays
+identically against a given request schedule. The optional ``p``
+(with the plan seed) makes probabilistic chaos runs replayable too:
+same seed, same call sequence, same faults.
+
+Fault kinds and their seams:
+
+- ``nan`` (seam ``lane.state``): poison the matched request's lane
+  with a NaN before the next window dispatch
+  (``LanePool.poison_lane``) — the divergence injector the
+  ``check_finite`` quarantine is pinned against.
+- ``io_error`` (seam ``sink.append``): raise ``OSError`` from the
+  matched request's sink append on the stream path — exercises
+  stream-error propagation and close-on-exception.
+- ``stall`` (seam ``stream.window``): sleep ``seconds`` inside the
+  stream thread's window processing — exercises backpressure and the
+  scheduler watchdog.
+- ``kill`` (any seam in :data:`KILL_SEAMS`): ``SIGKILL`` the process
+  at a named scheduler/WAL seam — the crash-recovery pins
+  (tests/test_recovery.py) SIGKILL at every one of these and require
+  the recovered results bitwise equal to an uninterrupted run's.
+
+See docs/serving.md, "Fault tolerance & recovery".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+#: Seams at which a ``kill`` fault may SIGKILL the process. Each sits
+#: just AFTER a durability step, so the recovery contract is tested at
+#: the exact boundaries where a real crash is most informative.
+KILL_SEAMS = (
+    "submit.walled",     # submit WAL event written, rid about to return
+    "resubmit.walled",   # continuation WAL event written
+    "admitted",          # request scattered into a lane
+    "window.dispatched",  # device window program enqueued
+    "hold.spilled",      # held snapshot spilled + WAL hold event written
+    "retired.walled",    # terminal status WAL event written
+    "streamed.walled",   # stream-completion WAL event written (stream thread)
+)
+
+#: Default seam per fault kind (a fault may override ``at`` only for
+#: ``kill``, which must name one of KILL_SEAMS).
+_KIND_SEAMS = {
+    "nan": "lane.state",
+    "io_error": "sink.append",
+    "stall": "stream.window",
+}
+
+_FAULT_KEYS = {
+    "kind", "at", "request", "after_steps", "occurrence", "seconds", "p",
+}
+
+
+@dataclass
+class Fault:
+    """One armed fault. ``occurrence`` is 1-based over matching seam
+    firings (0 = every matching firing); ``after_steps`` (``nan`` only)
+    defers matching until the request's sim-step counter reaches it;
+    ``p`` arms the fault probabilistically per matching firing, drawn
+    from the plan's seeded stream."""
+
+    kind: str
+    at: str
+    request: Optional[str] = None
+    after_steps: int = 0
+    occurrence: int = 1
+    seconds: float = 0.0
+    p: Optional[float] = None
+    _count: int = field(default=0, repr=False)
+    _done: bool = field(default=False, repr=False)
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults.
+
+    Construct from a list of fault dicts (see module docstring) plus a
+    seed for the probabilistic stream, or :meth:`from_spec` for the
+    CLI/JSON form ``{"seed": 0, "faults": [...]}`` (a bare list is
+    accepted too). An empty plan is falsy and every hook is a no-op,
+    so production servers carry ``FaultPlan(None)`` at zero cost.
+    """
+
+    def __init__(
+        self,
+        faults: Optional[Sequence[Mapping[str, Any]]] = None,
+        seed: int = 0,
+    ):
+        import numpy as np
+
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed)
+        self._lock = threading.Lock()
+        self.faults: List[Fault] = []
+        for i, f in enumerate(faults or []):
+            f = dict(f)
+            unknown = set(f) - _FAULT_KEYS
+            if unknown:
+                raise ValueError(
+                    f"fault {i}: unknown keys {sorted(unknown)}; known: "
+                    f"{sorted(_FAULT_KEYS)}"
+                )
+            kind = f.get("kind")
+            if kind == "kill":
+                at = f.get("at")
+                if at not in KILL_SEAMS:
+                    raise ValueError(
+                        f"fault {i}: kill fault needs 'at' naming a "
+                        f"kill seam; known: {list(KILL_SEAMS)}"
+                    )
+                if f.get("request") is not None:
+                    # kill seams fire with no request context, so a
+                    # request filter would silently never match — the
+                    # exact no-op chaos this harness exists to prevent
+                    raise ValueError(
+                        f"fault {i}: kill faults cannot filter by "
+                        f"request (kill seams are scheduler-wide; "
+                        f"use 'occurrence' to target the N-th firing)"
+                    )
+            elif kind in _KIND_SEAMS:
+                at = f.get("at", _KIND_SEAMS[kind])
+                if at != _KIND_SEAMS[kind]:
+                    raise ValueError(
+                        f"fault {i}: kind {kind!r} fires at seam "
+                        f"{_KIND_SEAMS[kind]!r}, not {at!r}"
+                    )
+            else:
+                raise ValueError(
+                    f"fault {i}: unknown kind {kind!r}; known: "
+                    f"{sorted([*_KIND_SEAMS, 'kill'])}"
+                )
+            p = f.get("p")
+            if p is not None and not 0.0 < float(p) <= 1.0:
+                raise ValueError(f"fault {i}: p={p} must be in (0, 1]")
+            self.faults.append(Fault(
+                kind=str(kind),
+                at=str(at),
+                request=f.get("request"),
+                after_steps=int(f.get("after_steps", 0)),
+                occurrence=int(f.get("occurrence", 1)),
+                seconds=float(f.get("seconds", 0.0)),
+                p=None if p is None else float(p),
+            ))
+
+    @classmethod
+    def from_spec(cls, spec: Any) -> "FaultPlan":
+        """Build from the JSON form: a list of fault dicts, or
+        ``{"seed": s, "faults": [...]}``, or a path to a JSON file
+        holding either. ``None`` yields an empty (no-op) plan."""
+        if spec is None:
+            return cls(None)
+        if isinstance(spec, str):
+            with open(spec) as f:
+                spec = json.load(f)
+        if isinstance(spec, Mapping):
+            unknown = set(spec) - {"seed", "faults"}
+            if unknown:
+                raise ValueError(
+                    f"unknown fault-plan keys {sorted(unknown)}; known: "
+                    f"seed, faults"
+                )
+            return cls(spec.get("faults"), seed=spec.get("seed", 0))
+        return cls(spec)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    # -- the generic matcher -------------------------------------------------
+
+    def fire(
+        self,
+        seam: str,
+        request_id: Optional[str] = None,
+        steps: Optional[int] = None,
+    ) -> List[Fault]:
+        """Faults firing NOW at ``seam`` for this context. Occurrence
+        counters advance on every MATCH (seam + request + after_steps),
+        fired-or-not, so a plan's N-th-occurrence semantics are a pure
+        function of the call sequence — deterministic and replayable."""
+        if not self.faults:
+            return []
+        out: List[Fault] = []
+        with self._lock:
+            for f in self.faults:
+                if f._done or f.at != seam:
+                    continue
+                if f.request is not None and request_id != f.request:
+                    continue
+                if f.after_steps and (
+                    steps is None or steps < f.after_steps
+                ):
+                    continue
+                f._count += 1
+                if f.occurrence and f._count != f.occurrence:
+                    continue
+                if f.p is not None and self._rng.random() >= f.p:
+                    continue
+                if f.occurrence:
+                    f._done = True
+                out.append(f)
+        return out
+
+    # -- seam helpers (what the server/streamer actually call) ---------------
+
+    def kill(self, seam: str) -> None:
+        """SIGKILL the process if a kill fault fires at ``seam`` — the
+        real signal, not an exception: no handler, no cleanup, no
+        atexit, exactly what the recovery machinery must survive."""
+        if self.fire(seam):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def stall(self, seam: str) -> None:
+        """Sleep out any stall faults firing at ``seam``."""
+        for f in self.fire(seam):
+            time.sleep(f.seconds)
+
+    def io_error(self, seam: str, request_id: Optional[str]) -> None:
+        """Raise an injected OSError if an io_error fault fires."""
+        if self.fire(seam, request_id=request_id):
+            raise OSError(
+                f"injected sink I/O failure ({seam}, "
+                f"request {request_id})"
+            )
+
+    def poison(self, request_id: str, steps: int) -> bool:
+        """True when a nan fault fires for this request at this step
+        count (the server then poisons the lane before the next window
+        dispatch)."""
+        return bool(self.fire("lane.state", request_id, steps))

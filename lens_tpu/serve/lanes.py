@@ -39,6 +39,7 @@ import numpy as np
 
 from lens_tpu.colony.colony import Colony
 from lens_tpu.colony.ensemble import Ensemble
+from lens_tpu.emit.log import SEP
 from lens_tpu.utils.dicts import flatten_paths, set_path
 
 
@@ -222,6 +223,41 @@ class LanePool:
         # compile). See admit_state(overrides=...).
         self._fork_admits: Dict[Any, Any] = {}
 
+        # Per-lane finite check (the check_finite="window" quarantine):
+        # AND of isfinite over every inexact leaf's non-lane axes — a
+        # [L] bool the server reads one window late off the same
+        # device->host path the trajectory already rides, so the check
+        # never adds a sync of its own. Compiled lazily (jit) — a
+        # server with the check off never traces it.
+        def finite(states):
+            flags = jnp.ones((self.n_lanes,), bool)
+            for leaf in jax.tree.leaves(states):
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    flags &= jnp.isfinite(leaf).reshape(
+                        self.n_lanes, -1
+                    ).all(axis=1)
+            return flags
+
+        self._finite = jax.jit(finite)
+
+        # Divergence injector (FaultPlan "nan" faults + tests): set the
+        # FIRST inexact leaf's whole slice of one lane to NaN. Lane is
+        # a traced scalar — one compile serves every injection. Not
+        # donated: used only under fault injection, clarity wins.
+        def poison(states, lane):
+            leaves, treedef = jax.tree.flatten(states)
+            for i, leaf in enumerate(leaves):
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    leaves[i] = leaf.at[lane].set(jnp.nan)
+                    break
+            else:
+                raise ValueError(
+                    "no inexact state leaf to poison in this sim form"
+                )
+            return jax.tree.unflatten(treedef, leaves)
+
+        self._poison = jax.jit(poison)
+
     def _build_solo(self, n_agents, seed: int, overrides: Mapping | None):
         leaves, structure = _override_leaves(overrides)
         na_key = (
@@ -255,6 +291,95 @@ class LanePool:
         if isinstance(self.sim, MultiSpeciesColony):
             return {name: 0 for name in self.sim.species}
         return 0
+
+    # -- eager request validation (submit-time, pre-compile) -----------------
+
+    def _colonies(self) -> Dict[str, Any]:
+        """``{species_or_'': Colony}`` — the schema owners of this sim
+        form (the multi-species form routes overrides by species key;
+        the other two take bare paths)."""
+        from lens_tpu.environment.multispecies import MultiSpeciesColony
+
+        if isinstance(self.sim, MultiSpeciesColony):
+            return {
+                name: sp.colony for name, sp in self.sim.species.items()
+            }
+        if isinstance(self.sim, Colony):
+            return {"": self.sim}
+        return {"": self.sim.colony}
+
+    def validate_overrides(
+        self, overrides: Mapping | None, what: str = "overrides"
+    ) -> None:
+        """Submit-time path validation: every override path must name a
+        schema variable of this bucket's compartment (per species on
+        multi-species buckets). Catches the classic client typo — an
+        unknown path — at ``submit`` with a descriptive error instead
+        of deep inside the admission build. Value SHAPES are still
+        validated at admission (they need the built state)."""
+        if not overrides:
+            return
+        colonies = self._colonies()
+        multi = "" not in colonies
+        if multi:
+            unknown = set(overrides) - set(colonies)
+            if unknown:
+                raise ValueError(
+                    f"{what} name unknown species {sorted(unknown)}; "
+                    f"this bucket serves {sorted(colonies)}"
+                )
+            items = [
+                (f"{name}{SEP}", colonies[name], ovr)
+                for name, ovr in overrides.items()
+            ]
+        else:
+            items = [("", colonies[""], overrides)]
+        for prefix, colony, ovr in items:
+            known = colony.compartment.updaters
+            for path, value in flatten_paths(ovr or {}):
+                if path not in known:
+                    raise ValueError(
+                        f"{what} path "
+                        f"{prefix}{SEP.join(map(str, path))!r} is not "
+                        f"a schema variable of this bucket; known "
+                        f"paths include "
+                        f"{sorted(SEP.join(map(str, p)) for p in known)[:8]}"
+                    )
+                try:
+                    np.asarray(value)
+                except Exception as e:
+                    raise ValueError(
+                        f"{what} value at "
+                        f"{prefix}{SEP.join(map(str, path))} is not "
+                        f"array-like: {e}"
+                    )
+
+    def validate_agents(self, n_agents: Any) -> None:
+        """Submit-time n_agents validation against the bucket's
+        capacities (``n_agents`` already normalized by
+        :meth:`default_agents`)."""
+        colonies = self._colonies()
+        if "" in colonies:
+            cap = colonies[""].capacity
+            n = int(n_agents)
+            if not 0 <= n <= cap:
+                raise ValueError(
+                    f"n_agents={n} not in [0, {cap}] (bucket capacity)"
+                )
+            return
+        unknown = set(n_agents) - set(colonies)
+        if unknown:
+            raise ValueError(
+                f"n_agents names unknown species {sorted(unknown)}; "
+                f"this bucket serves {sorted(colonies)}"
+            )
+        for name, colony in colonies.items():
+            n = int(n_agents.get(name, 0))
+            if not 0 <= n <= colony.capacity:
+                raise ValueError(
+                    f"n_agents[{name!r}]={n} not in "
+                    f"[0, {colony.capacity}] (bucket capacity)"
+                )
 
     def default_agents(self, n: Any = None):
         """Normalize an n_agents default to this sim form: ints fan out
@@ -433,6 +558,27 @@ class LanePool:
             remaining_before - self.window_steps, 0
         )
         return remaining_before, traj
+
+    def finite_flags(self) -> Any:
+        """DEVICE [n_lanes] bool: lane state is all-finite (every
+        inexact leaf). Dispatched by the server right after a window
+        when ``check_finite="window"`` is on; the flags ride the same
+        async device->host copy as the trajectory, and the scheduler
+        reads them at the NEXT tick — one-window detection lag, zero
+        added syncs. Free/frozen lanes may legitimately be flagged
+        (stale state is never scrubbed) — the server consults flags
+        only for lanes occupied at dispatch time."""
+        return self._finite(self.states)
+
+    def poison_lane(self, lane: int) -> None:
+        """Inject NaN into one lane's state (the first inexact leaf,
+        whole lane slice) — the deterministic divergence injector
+        behind ``FaultPlan`` ``nan`` faults and the quarantine tests.
+        Co-resident lanes are untouched (elementwise lane update), so
+        the quarantine pin can require their bits unchanged."""
+        if not 0 <= lane < self.n_lanes:
+            raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
+        self.states = self._poison(self.states, jnp.int32(lane))
 
     def retraces(self) -> int:
         """Compiles of the window program beyond the expected one — the

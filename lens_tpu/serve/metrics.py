@@ -75,6 +75,11 @@ class ServerMetrics:
       budget. ``admitted``/``retired`` include the internal prefix
       tickets (they really occupy lanes); ``submitted`` counts client
       submits only.
+    - fault tolerance (round 12, docs/serving.md "Fault tolerance &
+      recovery"): ``diverged`` — lanes the per-window finite check
+      quarantined (each also counts under ``failed``); ``recovered`` —
+      unfinished requests re-admitted from the WAL at
+      ``recover_dir`` startup.
     """
 
     _COUNTERS = (
@@ -95,6 +100,8 @@ class ServerMetrics:
         "prefix_coalesced",
         "prefix_forks",
         "snapshot_evictions",
+        "diverged",
+        "recovered",
     )
 
     def __init__(self) -> None:

@@ -36,6 +36,19 @@ environments, LLM continuous batching):
   store (pinned), so ``resubmit`` extends/forks a parent any number of
   times.
 
+- the server is fault-tolerant (round 12, docs/serving.md "Fault
+  tolerance & recovery"): an opt-in per-window finite check
+  (``check_finite="window"``) quarantines a lane whose physics went
+  NaN/Inf — that request alone fails with ``SimulationDiverged``, its
+  lane is reclaimed, co-batched lanes are bitwise untouched; a
+  watchdog (``watchdog_s``) expires hung window/streamer handoffs
+  instead of wedging ``tick()``; and ``recover_dir`` arms a write-
+  ahead log + held-snapshot spills making the server crash-
+  recoverable — a SIGKILL'd server restarted over the same directory
+  reproduces an uninterrupted run's results byte for byte. A
+  deterministic ``FaultPlan`` (serve/faults.py) injects all three
+  failure classes at named seams for tests/CI.
+
 Determinism contract (pinned in tests/test_serve.py): a request's
 emitted trajectory is BITWISE identical served solo or co-batched with
 arbitrary other requests, across admission orders — per-request PRNG
@@ -73,20 +86,39 @@ from lens_tpu.serve.batcher import (
     QUEUED,
     QueueFull,
     RUNNING,
+    SimulationDiverged,
     TIMEOUT,
     RequestQueue,
     ScenarioRequest,
     Ticket,
 )
+from lens_tpu.serve.faults import FaultPlan
 from lens_tpu.serve.lanes import LanePool
 from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
 from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
 from lens_tpu.serve.streamer import (
     LaneSlice,
     Streamer,
+    WatchdogTimeout,
     WindowItem,
     process_window,
     subsample_rows,
+)
+from lens_tpu.serve.wal import (
+    BEGIN,
+    HOLD,
+    RELEASE,
+    RESUBMIT,
+    RETIRE,
+    SPILL_DIR,
+    STREAMED,
+    SUBMIT,
+    WAL_NAME,
+    ServeWal,
+    buckets_fingerprint,
+    key_from_json,
+    key_to_json,
+    spill_name,
 )
 from lens_tpu.utils.dicts import flatten_paths, get_path, set_path
 from lens_tpu.utils.hostio import copy_tree_to_host_async
@@ -103,6 +135,54 @@ BUCKET_DEFAULTS: Dict[str, Any] = {
     "timestep": 1.0,        # sim seconds per step
     "emit_every": 1,        # device emit cadence within the window
 }
+
+
+def _tree_to_json(tree: Mapping) -> Dict[str, Any]:
+    """A nested override tree with array leaves as plain JSON (lists /
+    scalars). The WAL's request serialization: lossless for the bits a
+    request admits with, because the admission build casts override
+    values to the schema leaf's dtype anyway (exact for every int and
+    for float32 values round-tripped through Python floats)."""
+    out: Dict[str, Any] = {}
+    for path, value in flatten_paths(tree or {}):
+        out = set_path(out, path, np.asarray(value).tolist())
+    return out
+
+
+def _request_to_json(request: ScenarioRequest) -> Dict[str, Any]:
+    """A ``ScenarioRequest`` as the JSON the WAL records — exactly the
+    mapping form ``submit`` accepts, so recovery re-queues with
+    ``ScenarioRequest.from_mapping`` and the re-run is the same
+    request."""
+    out: Dict[str, Any] = {
+        "composite": request.composite,
+        "seed": int(request.seed),
+        "horizon": float(request.horizon),
+    }
+    if request.overrides:
+        out["overrides"] = _tree_to_json(request.overrides)
+    if request.n_agents is not None:
+        out["n_agents"] = (
+            {str(k): int(v) for k, v in request.n_agents.items()}
+            if isinstance(request.n_agents, Mapping)
+            else int(request.n_agents)
+        )
+    if request.emit is not None:
+        emit = dict(request.emit)
+        if emit.get("paths"):
+            emit["paths"] = [str(p) for p in emit["paths"]]
+        out["emit"] = emit
+    if request.deadline is not None:
+        out["deadline"] = float(request.deadline)
+    if request.hold_state:
+        out["hold_state"] = True
+    if request.prefix is not None:
+        prefix = dict(request.prefix)
+        block: Dict[str, Any] = {"horizon": float(prefix["horizon"])}
+        if prefix.get("overrides"):
+            block["overrides"] = _tree_to_json(prefix["overrides"])
+        out["prefix"] = block
+    return out
 
 
 class _RamResult:
@@ -172,6 +252,11 @@ class _Bucket:
         from lens_tpu.utils.dicts import deep_merge
 
         self.name = name
+        # quarantine bookkeeping (check_finite="window"): the previous
+        # window's device finite flags plus the {lane: (ticket,
+        # step-after-window)} map frozen at dispatch — consumed at the
+        # next tick's sweep
+        self.pending_check = None
         self.cfg = cfg = deep_merge(BUCKET_DEFAULTS, cfg or {})
         composite = cfg["composite"] or name
         built = build_model(
@@ -248,6 +333,38 @@ class SimServer:
         "Prefix caching & forking"). Unpinned prefix snapshots are
         evicted LRU-first past the budget; pinned held states are the
         client's working set and always land. ``None`` = unbounded.
+    check_finite:
+        ``"window"`` arms the lane quarantine: after every window a
+        jitted per-lane finite check rides the trajectory's
+        device->host copy, and the NEXT tick fails any occupied lane
+        whose state went NaN/Inf — that request alone retires FAILED
+        (``result()`` raises ``SimulationDiverged``), its lane is
+        reclaimed, co-resident lanes are bitwise untouched. ``"off"``
+        (default) dispatches nothing extra — the round-11 path,
+        bitwise. See docs/serving.md, "Fault tolerance & recovery".
+    watchdog_s:
+        Arm the handoff watchdog: a scheduler wait on the stream pipe
+        (backpressure stall, drain, result) that makes no progress for
+        this many seconds raises ``WatchdogTimeout`` instead of
+        wedging ``tick()`` behind a hung sink or device window
+        forever. ``None`` (default) = wait indefinitely.
+    recover_dir:
+        Directory for the serve write-ahead log (``serve.wal``) and
+        held-snapshot spills (``snapshots/``). When given, every
+        client submit/resubmit/terminal is WAL'd (group-commit fsync
+        per tick), ``hold_state`` snapshots spill via the checkpoint
+        rename protocol — and if the directory already holds a WAL,
+        the constructor RECOVERS: finished requests materialize as
+        terminal tickets over their existing result logs, held
+        snapshots re-pin from their spills, and every unfinished
+        request is re-queued under its original id, producing results
+        bitwise equal to an uninterrupted run. Requires ``sink="log"``
+        (results must live on disk to survive a restart).
+    faults:
+        A :class:`~lens_tpu.serve.faults.FaultPlan` (tests/bench/CI
+        chaos only): deterministic injection of NaN lanes, sink I/O
+        errors, stream stalls, and SIGKILL kill-points at the named
+        seams. ``None`` = no seams armed.
     """
 
     def __init__(
@@ -261,6 +378,10 @@ class SimServer:
         pipeline: str = "on",
         stream_queue: int = 2,
         snapshot_budget_mb: Optional[float] = None,
+        check_finite: str = "off",
+        watchdog_s: Optional[float] = None,
+        recover_dir: Optional[str] = None,
+        faults: Optional[FaultPlan] = None,
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -274,6 +395,16 @@ class SimServer:
             )
         if flush_every < 1:
             raise ValueError(f"flush_every={flush_every} must be >= 1")
+        if check_finite not in ("off", "window"):
+            raise ValueError(
+                f"unknown check_finite {check_finite!r}; known: "
+                f"off, window"
+            )
+        if recover_dir and sink != "log":
+            raise ValueError(
+                "recover_dir needs sink='log': recovery can only hand "
+                "back results that live on disk"
+            )
         self.buckets = {
             name: _Bucket(name, dict(cfg or {}))
             for name, cfg in buckets.items()
@@ -288,9 +419,14 @@ class SimServer:
         self.stream_flush = stream_flush
         self.flush_every = int(flush_every)
         self.pipeline = pipeline
+        self.check_finite = check_finite
+        self.watchdog_s = watchdog_s
+        self.faults = faults if faults is not None else FaultPlan(None)
         self._streamer: Optional[Streamer] = (
             Streamer(max_inflight=int(stream_queue),
-                     metrics=self._metrics)
+                     metrics=self._metrics,
+                     watchdog_s=watchdog_s,
+                     faults=self.faults)
             if pipeline == "on"
             else None
         )
@@ -309,6 +445,24 @@ class SimServer:
         # can wait for ONE request instead of draining the whole pipe
         self._stream_done: Dict[str, threading.Event] = {}
         self._closed = False
+        # -- write-ahead log + recovery (docs/serving.md, "Fault
+        # tolerance & recovery") --
+        self.recover_dir = recover_dir
+        self._wal: Optional[ServeWal] = None
+        self.recovered = 0  # unfinished WAL requests re-queued
+        if recover_dir:
+            os.makedirs(recover_dir, exist_ok=True)
+            self._wal = ServeWal(os.path.join(recover_dir, WAL_NAME))
+            had_events = self._wal.replayed()
+            self._wal.begin(
+                buckets_fingerprint(
+                    {n: b.cfg for n, b in self.buckets.items()}
+                ),
+                {n: {"composite": b.cfg["composite"] or n}
+                 for n, b in self.buckets.items()},
+            )
+            if had_events:
+                self._recover()
 
     @classmethod
     def single_bucket(cls, composite: str, **kwargs) -> "SimServer":
@@ -319,7 +473,8 @@ class SimServer:
         server_keys = (
             "queue_depth", "out_dir", "sink", "stream_flush",
             "flush_every", "pipeline", "stream_queue",
-            "snapshot_budget_mb",
+            "snapshot_budget_mb", "check_finite", "watchdog_s",
+            "recover_dir", "faults",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -331,13 +486,41 @@ class SimServer:
     def submit(self, request: ScenarioRequest | Mapping[str, Any]) -> str:
         """Queue a request; returns its request id.
 
-        Raises ``ValueError`` for malformed requests (unknown bucket,
-        horizon not on the bucket's step/emit grid — caller bugs) and
-        ``QueueFull`` for backpressure (a healthy client retries after
-        ``.retry_after`` seconds).
+        Raises ``ValueError`` for malformed requests — unknown bucket
+        or request keys, horizon off the bucket's step/emit grid,
+        override paths that are not schema variables, malformed
+        ``emit``/``prefix`` blocks, out-of-range ``n_agents`` — all
+        validated EAGERLY here (descriptive errors at the submit call
+        site, not a FAILED ticket from deep inside the admission
+        build). Raises ``QueueFull`` for backpressure (a healthy
+        client retries after ``.retry_after`` seconds).
         """
         if isinstance(request, Mapping):
-            request = ScenarioRequest(**request)
+            request = ScenarioRequest.from_mapping(request)
+        ticket = self._build_ticket(request, self.queue.next_id())
+        try:
+            self.queue.push(ticket, retry_after=self._retry_after())
+        except QueueFull:
+            self._metrics.inc("rejected")
+            self._metrics.queue_depth = len(self.queue)
+            raise
+        self._register(ticket)
+        if self._wal is not None:
+            # durable intent: the WAL knows the request before the
+            # client holds its id (flushed to the OS now; fsynced by
+            # the next tick's group commit)
+            self._wal.append({
+                "event": SUBMIT,
+                "rid": ticket.request_id,
+                "request": _request_to_json(request),
+            })
+            self.faults.kill("submit.walled")
+        return ticket.request_id
+
+    def _build_ticket(self, request: ScenarioRequest, rid: str) -> Ticket:
+        """Validate a request and build its (unqueued) ticket — shared
+        by ``submit`` and WAL recovery's re-queue (which preserves the
+        original request id)."""
         bucket = self.buckets.get(request.composite)
         if bucket is None:
             raise ValueError(
@@ -345,14 +528,12 @@ class SimServer:
                 f"configured: {sorted(self.buckets)}"
             )
         steps = self._horizon_steps(bucket, request.horizon)
-        every = int((request.emit or {}).get("every", 1))
-        if every < 1:
-            raise ValueError(f"emit every={every} must be >= 1")
+        self._validate_request(bucket, request)
         prefix_steps, prefix_key = self._validate_prefix(
             bucket, request, steps
         )
-        ticket = Ticket(
-            request_id=self.queue.next_id(),
+        return Ticket(
+            request_id=rid,
             request=request,
             horizon_steps=steps,
             # a fork's prefix counts as already-done work: only the
@@ -372,18 +553,63 @@ class SimServer:
                 else None
             ),
         )
-        try:
-            self.queue.push(ticket, retry_after=self._retry_after())
-        except QueueFull:
-            self._metrics.inc("rejected")
-            self._metrics.queue_depth = len(self.queue)
-            raise
+
+    def _register(self, ticket: Ticket) -> None:
+        """Post-push bookkeeping shared by ``submit`` and recovery."""
         self._metrics.inc("submitted")
         self.tickets[ticket.request_id] = ticket
-        if prefix_key is not None:
-            self._resolve_prefix(ticket, bucket)
+        if ticket.prefix_key is not None:
+            self._resolve_prefix(
+                ticket, self.buckets[ticket.request.composite]
+            )
         self._metrics.queue_depth = len(self.queue)
-        return ticket.request_id
+
+    def _validate_request(
+        self, bucket: _Bucket, request: ScenarioRequest
+    ) -> None:
+        """Eager submit-time validation of the per-request data blocks
+        (the checks that need no compiled state): the emit spec's
+        shape, override PATHS against the bucket's schema, and
+        n_agents against its capacities. Value shapes still validate
+        at admission (they need the built state) and still fail only
+        the one request."""
+        emit = request.emit
+        if emit is not None:
+            if not isinstance(emit, Mapping):
+                raise ValueError(
+                    f"emit must be a mapping, got "
+                    f"{type(emit).__name__}"
+                )
+            unknown = set(emit) - {"paths", "every"}
+            if unknown:
+                raise ValueError(
+                    f"unknown emit keys {sorted(unknown)}; known: "
+                    f"every, paths"
+                )
+            every = int(emit.get("every", 1))
+            if every < 1:
+                raise ValueError(f"emit every={every} must be >= 1")
+            paths = emit.get("paths")
+            if paths is not None and (
+                isinstance(paths, (str, bytes))
+                or not all(isinstance(p, str) for p in paths)
+            ):
+                raise ValueError(
+                    "emit paths must be a list of path-prefix strings"
+                )
+        pool = bucket.pool
+        pool.validate_overrides(request.overrides, what="override")
+        if request.prefix is not None:
+            if not isinstance(request.prefix, Mapping):
+                raise ValueError(
+                    f"prefix must be a mapping, got "
+                    f"{type(request.prefix).__name__}"
+                )
+            pool.validate_overrides(
+                dict(request.prefix).get("overrides"),
+                what="prefix override",
+            )
+        pool.validate_agents(self._request_agents(bucket, request))
 
     def _validate_prefix(
         self, bucket: _Bucket, request: ScenarioRequest, steps: int
@@ -594,6 +820,14 @@ class SimServer:
         self._metrics.inc("resubmitted")
         self._metrics.queue_depth = len(self.queue)
         self.tickets[ticket.request_id] = ticket
+        if self._wal is not None:
+            self._wal.append({
+                "event": RESUBMIT,
+                "rid": ticket.request_id,
+                "parent": parent.request_id,
+                "extra_horizon": float(extra_horizon),
+            })
+            self.faults.kill("resubmit.walled")
         return ticket.request_id
 
     def release_state(self, request_id: str) -> None:
@@ -618,6 +852,12 @@ class SimServer:
             and self.snapshots.refs(key) == 0
         ):
             self.snapshots.drop(key)
+        if self._wal is not None:
+            # the spill directory is deliberately KEPT: an in-flight
+            # continuation admitted before this release may still need
+            # rehydration after a crash; stale spills are bounded by
+            # held requests and reclaimed with the recover_dir
+            self._wal.append({"event": RELEASE, "rid": request_id})
 
     def status(self, request_id: str) -> Dict[str, Any]:
         t = self._ticket(request_id)
@@ -702,21 +942,53 @@ class SimServer:
         barrier before returning its partial records.
         """
         t = self._ticket(request_id)
+        if t.diverged:
+            # quarantined physics: never hand back the (post-divergence
+            # garbage) records as if they were a completed trajectory
+            raise SimulationDiverged(t.error)
         sink = self._results.get(request_id)
         if sink is None:
+            if t.result_path is not None and t.status in (
+                DONE, TIMEOUT, CANCELLED
+            ):
+                # a WAL-recovered terminal request: its records live in
+                # the result log the previous incarnation wrote (the
+                # log-sink result form is the path either way)
+                return t.result_path
+            cause = f": {t.error}" if t.error else ""
             raise ValueError(
                 f"request {request_id} ({t.status}) has no result — it "
-                f"was never admitted to a lane"
+                f"was never admitted to a lane{cause}"
             )
         if self._streamer is not None:
             event = self._stream_done.get(request_id)
             if event is not None and t.status in (
                 DONE, TIMEOUT, CANCELLED, FAILED
             ):
+                waited = 0.0
+                token = self._streamer.progress_token()
                 while not event.wait(0.05):
                     # surface a parked stream error instead of
                     # waiting forever on an event it will never set
                     self._streamer.check()
+                    waited += 0.05
+                    if (
+                        self.watchdog_s is not None
+                        and waited > self.watchdog_s
+                    ):
+                        # no-progress semantics, like Streamer.drain:
+                        # a slow-but-moving pipe resets the clock, a
+                        # stuck one raises
+                        now_token = self._streamer.progress_token()
+                        if now_token == token:
+                            raise WatchdogTimeout(
+                                f"result({request_id}) made no "
+                                f"stream progress for "
+                                f"{self.watchdog_s}s waiting for its "
+                                f"completion"
+                            )
+                        token = now_token
+                        waited = 0.0
             else:
                 self._streamer.drain()
         return sink.timeseries()
@@ -756,9 +1028,23 @@ class SimServer:
         """
         if self._streamer is not None:
             self._streamer.check()
+        if self._wal is not None:
+            # group commit: every WAL append since the last tick is
+            # durable before the scheduler acts on any of it (one
+            # fsync per tick, not per event — appends were already
+            # flushed to the OS, so a SIGKILL loses nothing either way)
+            self._wal.sync()
         now = time.perf_counter()
         self._metrics.inc("ticks")
         did_work = False
+
+        # 0. quarantine sweep (check_finite="window"): consume the
+        #    previous window's per-lane finite flags BEFORE admission,
+        #    so a poisoned lane is reclaimed (and reusable) this tick
+        #    and never dispatches another window
+        if self.check_finite == "window":
+            for bucket in self.buckets.values():
+                self._sweep_quarantine(bucket)
 
         # 1. queued-side expiry (cancel of queued tickets is immediate
         #    in cancel(); only deadlines need the sweep)
@@ -835,14 +1121,37 @@ class SimServer:
     # -- internals -----------------------------------------------------------
 
     def _retry_after(self) -> float:
-        """Backpressure hint: how long the current backlog should take
-        to drain at the measured window rate. Deliberately rough — a
-        pacing signal, not a promise."""
+        """Backpressure hint: an HONEST estimate of when a retried
+        submit could land, derived from the actual occupancy — (a)
+        windows until the EARLIEST busy lane frees (the host-mirrored
+        remaining counters, zero if any lane is free now) plus (b) the
+        queued backlog's own remaining windows spread across every
+        lane — quoted at the measured window rate. Still a pacing
+        signal, not a promise (retirement order depends on horizons
+        admitted later), but it scales with the real backlog instead
+        of just the queue LENGTH: ten queued 4000-step requests now
+        hint a proportionally longer wait than ten 37-step ones."""
         total_lanes = sum(
             b.pool.n_lanes for b in self.buckets.values()
         )
-        backlog_windows = len(self.queue) / max(total_lanes, 1) + 1.0
-        return backlog_windows * self._metrics.avg_window_seconds()
+        to_free = 0.0
+        if not any(b.free_lanes() > 0 for b in self.buckets.values()):
+            to_free = min(
+                (
+                    -(-int(b.pool.remaining_host[lane])
+                      // b.pool.window_steps)
+                    for b in self.buckets.values()
+                    for lane in b.assignments
+                ),
+                default=0.0,
+            )
+        queued_windows = sum(
+            -(-(t.horizon_steps - t.steps_done)
+              // self.buckets[t.request.composite].pool.window_steps)
+            for t in self.queue
+        )
+        backlog = to_free + queued_windows / max(total_lanes, 1)
+        return max(backlog, 1.0) * self._metrics.avg_window_seconds()
 
     def _admit(self, t: Ticket, now: float) -> None:
         bucket = self.buckets[t.request.composite]
@@ -903,6 +1212,7 @@ class SimServer:
             if self._streamer is not None:
                 self._stream_done[t.request_id] = threading.Event()
         self._metrics.inc("admitted")
+        self.faults.kill("admitted")
 
     def _make_sink(self, t: Ticket):
         if self.sink == "ram":
@@ -944,6 +1254,89 @@ class SimServer:
             flush_every=self.flush_every if self.stream_flush else None,
         )
 
+    def _sweep_quarantine(self, bucket: _Bucket) -> None:
+        """Consume a bucket's pending finite flags (dispatched with the
+        previous window, host-copied alongside its trajectory) and
+        quarantine any occupied-at-dispatch lane that went non-finite.
+        Reading the flags waits only for the PREVIOUS window's compute
+        — work the device had to finish before the next dispatch
+        anyway — so the check adds a tiny transfer, not a sync point
+        the pipeline didn't already have."""
+        if bucket.pending_check is None:
+            return
+        flags_dev, watched = bucket.pending_check
+        bucket.pending_check = None
+        flags = np.asarray(jax.device_get(flags_dev))
+        for lane, (t, step_after) in watched.items():
+            if bool(flags[lane]):
+                continue
+            self._quarantine(bucket, lane, t, step_after)
+
+    def _quarantine(
+        self, bucket: _Bucket, lane: int, t: Ticket, step_after: int
+    ) -> None:
+        """Fail ONE diverged request: reclaim its lane (running) or
+        flip its just-retired DONE to FAILED (the one-window detection
+        lag can land after retirement). Co-resident lanes are bitwise
+        untouched — the serve path has no cross-lane coupling, so
+        quarantine is pure bookkeeping. The poisoned state stays
+        frozen in the lane until the next admission overwrites every
+        leaf of it."""
+        dt = bucket.pool.timestep
+        t.diverged = True
+        t.error = (
+            f"SimulationDiverged: non-finite state (NaN/Inf) in lane "
+            f"{lane} of bucket {bucket.name!r} within the window "
+            f"ending at step {step_after} (t={step_after * dt:g}); "
+            f"the request failed and its lane was reclaimed — "
+            f"co-batched requests are unaffected"
+        )
+        self._metrics.inc("diverged")
+        if t.status == RUNNING and bucket.assignments.get(lane) is t:
+            bucket.pool.release(lane)
+            del bucket.assignments[lane]
+            self._finish(t, FAILED)
+            self._metrics.inc("failed")
+        elif t.status == DONE:
+            # retired the same tick its poisoned window was dispatched
+            # (divergence in the final window): flip post-hoc — the
+            # streamed records end in garbage, and result() must raise
+            # rather than hand them back as a completed trajectory
+            t.status = FAILED
+            self._metrics.inc("failed")
+            if t.held_key is not None:
+                # never extend a poisoned snapshot
+                key, t.held_key = t.held_key, None
+                self._metrics.inc(
+                    "snapshot_evictions", self.snapshots.release(key)
+                )
+                if (
+                    key in self.snapshots
+                    and self.snapshots.refs(key) == 0
+                ):
+                    self.snapshots.drop(key)
+            if t.internal:
+                # a diverged PREFIX run that already published its
+                # snapshot and seeded waiters: drop the poisoned cache
+                # entry; already-seeded forks will diverge and be
+                # quarantined individually at their own windows
+                if (
+                    t.content_key in self.snapshots
+                    and self.snapshots.refs(t.content_key) == 0
+                ):
+                    self.snapshots.drop(t.content_key)
+            if self._wal is not None and not t.internal:
+                self._wal.append({
+                    "event": RETIRE,
+                    "rid": t.request_id,
+                    "status": FAILED,
+                    "error": t.error,
+                    "steps": t.steps_done,
+                })
+        # already terminal non-DONE (cancelled/expired raced the
+        # check): keep the terminal status, the diverged flag and
+        # error still mark the records as suspect
+
     def _run_bucket_window(self, bucket: _Bucket) -> None:
         """Dispatch one window and route its host work.
 
@@ -962,11 +1355,40 @@ class SimServer:
         """
         pool = bucket.pool
         pipelined = self._streamer is not None
+        if self.faults:
+            # fault seam "lane.state": poison a matched request's lane
+            # BEFORE the dispatch, so the NaN propagates through this
+            # window and the finite check sees it at the next tick
+            for lane, t in bucket.assignments.items():
+                if self.faults.poison(t.request_id, t.steps_done):
+                    pool.poison_lane(lane)
         t0 = time.perf_counter()
         remaining_before, traj = pool.run_window()
+        self.faults.kill("window.dispatched")
         self._metrics.inc("windows")
         self._metrics.inc("lane_windows_busy", len(bucket.assignments))
         self._metrics.inc("lane_windows_total", pool.n_lanes)
+
+        if self.check_finite == "window":
+            # per-lane finite flags over the post-window states, read
+            # at the NEXT tick's sweep; the map freezes lane->ticket at
+            # dispatch (lanes retire/reassign underneath the lag)
+            flags = pool.finite_flags()
+            bucket.pending_check = (
+                flags,
+                {
+                    lane: (
+                        t,
+                        t.steps_done + min(
+                            int(remaining_before[lane]),
+                            pool.window_steps,
+                        ),
+                    )
+                    for lane, t in bucket.assignments.items()
+                },
+            )
+            if pipelined:
+                copy_tree_to_host_async(flags)
 
         if pipelined:
             copy_tree_to_host_async(traj)
@@ -1015,7 +1437,7 @@ class SimServer:
         if not pipelined:
             # append BEFORE retiring: _finish closes sinks inline in
             # sync mode, and a request's final rows precede its close
-            process_window(host, slices)
+            process_window(host, slices, faults=self.faults)
             done = time.perf_counter()
             self._metrics.observe_window(done - t0)
             self._metrics.observe_stream(t0, ready, done)
@@ -1053,6 +1475,8 @@ class SimServer:
                         self.snapshots.put(held, snap, pin=True),
                     )
                     t.held_key = held
+                    if self._wal is not None:
+                        self._spill_hold(t, held, snap)
             del bucket.assignments[lane]
             self._finish(t, DONE)
             self._metrics.inc("retired")
@@ -1098,6 +1522,36 @@ class SimServer:
             paths=[str(p) for p in paths] if paths else None,
         )
 
+    def _spill_hold(self, t: Ticket, key, snap) -> None:
+        """Durably spill a held snapshot (checkpoint rename protocol)
+        and WAL the hold, so a killed server's ``resubmit`` chain can
+        rehydrate the exact bits. Runs on the scheduler thread at
+        retirement — a synchronous host fetch + orbax save, paid only
+        by ``hold_state`` requests under a ``recover_dir``. The spill
+        lands BEFORE the retire event (file order = replay order), so
+        a resubmit event in the WAL always implies a complete spill."""
+        from lens_tpu.checkpoint import save_tree
+
+        name = spill_name(key)
+        save_tree(os.path.join(self.recover_dir, SPILL_DIR, name), snap)
+        self._wal.append({
+            "event": HOLD,
+            "rid": t.request_id,
+            "key": key_to_json(key),
+            "name": name,
+        })
+        self.faults.kill("hold.spilled")
+
+    def _mark_streamed(self, t: Ticket) -> None:
+        """WAL the moment a request's records are durably down (sink
+        closed + flushed): the event that lets recovery trust a DONE
+        request's log instead of re-running it. Called from the stream
+        thread (pipelined) or the scheduler (sync) — the WAL is
+        thread-safe."""
+        if self._wal is not None and not t.internal:
+            self._wal.append({"event": STREAMED, "rid": t.request_id})
+            self.faults.kill("streamed.walled")
+
     def _completion_cb(self, t: Ticket):
         """Completion bookkeeping for a pipelined DONE request, run by
         the stream thread after the final append + sink close: stamps
@@ -1112,6 +1566,7 @@ class SimServer:
                     t.admitted_at - t.submitted_at,
                     t.finished_at - t.submitted_at,
                 )
+            self._mark_streamed(t)
             ev = self._stream_done.get(t.request_id)
             if ev is not None:
                 ev.set()
@@ -1121,6 +1576,18 @@ class SimServer:
     def _finish(self, t: Ticket, status: str) -> None:
         t.status = status
         t.finished_at = time.perf_counter()
+        if self._wal is not None and not t.internal:
+            # terminal fact first (a kill right after must see the
+            # status); DONE completeness is attested separately by the
+            # streamed event once the records are durably down
+            self._wal.append({
+                "event": RETIRE,
+                "rid": t.request_id,
+                "status": status,
+                "error": t.error,
+                "steps": t.steps_done,
+            })
+            self.faults.kill("retired.walled")
         if t.carry_key is not None:
             # terminal before the scatter consumed it (failed
             # admission, cancelled/expired while queued): drop the
@@ -1148,14 +1615,19 @@ class SimServer:
         if sink is not None:
             if self._streamer is None:
                 sink.close()
+                self._mark_streamed(t)
             elif status != DONE:
                 # cancel/timeout of a RUNNING request: its last window
                 # may still be queued on the streamer — close in FIFO
                 # order so partial records land before the close
                 ev = self._stream_done.get(t.request_id)
-                self._streamer.submit_close(
-                    sink, on_close=ev.set if ev is not None else None
-                )
+
+                def closed(t=t, ev=ev) -> None:
+                    self._mark_streamed(t)
+                    if ev is not None:
+                        ev.set()
+
+                self._streamer.submit_close(sink, on_close=closed)
             # pipelined DONE: the retiring window's LaneSlice carries
             # close_after, keeping append->close order per request
         if t.admitted_at is not None and not pipelined_done \
@@ -1167,6 +1639,176 @@ class SimServer:
                 t.admitted_at - t.submitted_at,
                 t.finished_at - t.submitted_at,
             )
+
+    # -- WAL recovery --------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Replay the WAL into live server state (constructor-time,
+        before any client call). Finished requests (a terminal
+        ``retire``; DONE additionally needs ``streamed`` — under the
+        pipeline, status runs ahead of the sink, and recovery must not
+        trust a DONE whose records never fully landed) materialize as
+        terminal tickets over their on-disk result logs, with held
+        snapshots re-pinned from their spills. Everything else is
+        RE-QUEUED under its original id and re-runs from its exact
+        inputs — the determinism contract turns that into a bitwise
+        resume (its partial result log is truncated at re-admission).
+        Continuations re-queue from their parent's spilled snapshot,
+        whether or not the parent itself finished."""
+        recs: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        retired: Dict[str, Dict[str, Any]] = {}
+        streamed: set = set()
+        holds: Dict[str, Dict[str, Any]] = {}
+        released: set = set()
+        for ev in self._wal.events:
+            kind = ev.get("event")
+            rid = ev.get("rid")
+            if kind in (SUBMIT, RESUBMIT):
+                recs[rid] = ev
+                order.append(rid)
+            elif kind == RETIRE:
+                retired[rid] = ev  # last wins (quarantine flips DONE)
+            elif kind == STREAMED:
+                streamed.add(rid)
+            elif kind == HOLD:
+                holds[rid] = ev
+            elif kind == RELEASE:
+                released.add(rid)
+            # unknown events: forward-compat, ignored
+        if not order:
+            return
+        self.queue.skip_ids(
+            1 + max(int(r.rsplit("-", 1)[1]) for r in order)
+        )
+        for rid in order:
+            fin = retired.get(rid)
+            finished = fin is not None and not (
+                fin.get("status") == DONE and rid not in streamed
+            )
+            if finished:
+                self._materialize(rid, recs, fin, holds, released)
+            else:
+                self._requeue(rid, recs, holds)
+                self.recovered += 1
+                self._metrics.inc("recovered")
+
+    def _effective_request(
+        self, rid: str, recs: Mapping[str, Mapping[str, Any]]
+    ) -> ScenarioRequest:
+        """The full-horizon request a WAL record denotes: a submit
+        record's request as-is; a resubmit record resolves its parent
+        chain and extends the horizon — a continuation is, bitwise,
+        one long request."""
+        rec = recs[rid]
+        if rec.get("event") == SUBMIT:
+            return ScenarioRequest.from_mapping(rec["request"])
+        parent = self._effective_request(rec["parent"], recs)
+        return dc_replace(
+            parent,
+            horizon=float(parent.horizon) + float(rec["extra_horizon"]),
+        )
+
+    def _rehydrate(self, hold: Mapping[str, Any], pin: bool):
+        """Load one spilled snapshot back into the store; returns its
+        key. Idempotent across multiple continuations of one parent."""
+        from lens_tpu.checkpoint import restore_tree
+
+        key = key_from_json(hold["key"])
+        if key not in self.snapshots:
+            path = os.path.join(
+                self.recover_dir, SPILL_DIR, str(hold["name"])
+            )
+            if not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"held snapshot spill {path} is missing — the WAL "
+                    f"records a hold for request {hold.get('rid')!r} "
+                    f"but its spill directory is gone; recovery "
+                    f"cannot rebuild the held state"
+                )
+            self.snapshots.put(key, restore_tree(path), pin=pin)
+        elif pin:
+            self.snapshots.put(key, self.snapshots.state(key), pin=True)
+        return key
+
+    def _materialize(self, rid, recs, fin, holds, released) -> None:
+        """A finished request becomes a terminal ticket: status, error,
+        result path, and (for an unreleased hold) the re-pinned held
+        snapshot — so ``status``/``result``/``resubmit`` keep working
+        across the restart."""
+        request = self._effective_request(rid, recs)
+        bucket = self.buckets[request.composite]
+        steps = self._horizon_steps(bucket, request.horizon)
+        status = str(fin.get("status"))
+        t = Ticket(
+            request_id=rid,
+            request=request,
+            status=status,
+            error=fin.get("error"),
+            horizon_steps=steps,
+            steps_done=int(fin.get("steps", steps)),
+            emit_count=steps // bucket.pool.emit_every,
+            parent=recs[rid].get("parent"),
+            content_key=(
+                self._content_key(bucket, request, steps)
+                if request.hold_state
+                else None
+            ),
+        )
+        if "SimulationDiverged" in str(fin.get("error") or ""):
+            t.diverged = True
+        path = os.path.join(self.out_dir, f"{rid}.lens")
+        if os.path.exists(path):
+            t.result_path = path
+        if (
+            status == DONE
+            and rid in holds
+            and rid not in released
+            and request.hold_state
+        ):
+            t.held_key = self._rehydrate(holds[rid], pin=True)
+        self.tickets[rid] = t
+
+    def _requeue(self, rid, recs, holds) -> None:
+        """Re-admit one unfinished request under its original id."""
+        rec = recs[rid]
+        request = self._effective_request(rid, recs)
+        if rec.get("event") == SUBMIT:
+            ticket = self._build_ticket(request, rid)
+        else:
+            # a continuation: re-arm only the extension, seeded from
+            # the parent's spilled snapshot (present by WAL ordering:
+            # resubmit implies the parent retired DONE, which implies
+            # its hold was spilled first) — independent of whether the
+            # parent itself is being re-run for its records
+            parent_rid = rec["parent"]
+            parent_req = self._effective_request(parent_rid, recs)
+            bucket = self.buckets[request.composite]
+            total_steps = self._horizon_steps(bucket, request.horizon)
+            parent_steps = self._horizon_steps(
+                bucket, parent_req.horizon
+            )
+            ticket = Ticket(
+                request_id=rid,
+                request=request,
+                horizon_steps=total_steps,
+                steps_done=parent_steps,
+                emit_count=parent_steps // bucket.pool.emit_every,
+                content_key=(
+                    self._content_key(bucket, request, total_steps)
+                    if request.hold_state
+                    else None
+                ),
+                parent=parent_rid,
+            )
+            ticket.carry_key = self._rehydrate(
+                holds[parent_rid], pin=False
+            )
+            self.snapshots.acquire(ticket.carry_key)
+        # force: the bounded queue is client backpressure; refusing
+        # our own recovery backlog would drop admitted work
+        self.queue.push(ticket, retry_after=0.0, force=True)
+        self._register(ticket)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1181,6 +1823,26 @@ class SimServer:
             return
         self._closed = True
         first_error: Optional[BaseException] = None
+        # fail coalesced-prefix waiters FIRST, with the cause: their
+        # shared prefix run will never land now, and a queued fork
+        # left QUEUED forever would read as "still pending" to any
+        # client holding its id (no sink exists yet, so this touches
+        # no streamer state)
+        try:
+            for key, waiters in list(self._pending_prefix.items()):
+                for w in waiters:
+                    if w.status == QUEUED:
+                        self.queue.drop(w)
+                        w.error = (
+                            "server closed while the shared prefix "
+                            "this fork was waiting on was still in "
+                            "flight"
+                        )
+                        self._finish(w, FAILED)
+                        self._metrics.inc("failed")
+            self._pending_prefix.clear()
+        except BaseException as e:
+            first_error = e
         if self._streamer is not None:
             try:
                 self._streamer.close()
@@ -1221,6 +1883,11 @@ class SimServer:
                 )
             except BaseException as e:
                 # never let a failed meta write mask the root cause
+                first_error = first_error or e
+        if self._wal is not None:
+            try:
+                self._wal.close()
+            except BaseException as e:
                 first_error = first_error or e
         self.snapshots.clear()  # free the resident device trees
         if first_error is not None:

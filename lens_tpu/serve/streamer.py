@@ -49,6 +49,17 @@ from lens_tpu.emit.log import SEP
 from lens_tpu.utils.dicts import flatten_paths, set_path
 
 
+class WatchdogTimeout(RuntimeError):
+    """The serve watchdog expired: a device window / streamer handoff
+    stalled longer than ``watchdog_s``. Raised into the scheduler
+    (``tick``/``drain``/``result``) instead of wedging it forever — a
+    hung sink, a disk that stopped accepting writes, or a device
+    program that never completes all surface here with a bounded
+    detection time. The server is NOT automatically healthy afterwards
+    (whatever wedged is still wedged); the caller decides whether to
+    close, shed load, or page a human."""
+
+
 def filter_paths(tree: Any, prefixes: List[str]) -> Dict:
     """Keep leaves whose ``/``-joined path starts with any prefix
     (component-aligned: prefix ``cell`` matches ``cell/volume``, not
@@ -112,12 +123,18 @@ class WindowItem:
     dispatched_at: float = 0.0
 
 
-def process_window(host: Any, slices: List[LaneSlice]) -> None:
+def process_window(
+    host: Any, slices: List[LaneSlice], faults: Any = None
+) -> None:
     """Apply every slice of one window to its sink, in order. Shared by
     the stream thread and the ``pipeline="off"`` synchronous path, so
-    both produce byte-identical sink contents."""
+    both produce byte-identical sink contents. ``faults`` (a
+    ``FaultPlan``) arms the ``sink.append`` io_error seam on both
+    paths."""
     for s in slices:
         if s.idx is not None:
+            if faults:
+                faults.io_error("sink.append", s.request_id)
             source = host
             if s.paths:
                 source = filter_paths(host, s.paths)
@@ -139,14 +156,33 @@ class Streamer:
     (close-only control items ride free — they hold no device memory
     and must never deadlock a shutdown). ``metrics`` (a
     ``ServerMetrics``) receives per-window stream samples.
+
+    ``watchdog_s`` arms the handoff watchdog: any blocking wait on the
+    stream pipe (``submit`` backpressure, ``drain``, ``close``'s join)
+    that makes no progress for that long raises
+    :class:`WatchdogTimeout` instead of wedging the scheduler — the
+    bounded-detection-time answer to a hung sink or a device window
+    that never lands. ``faults`` (a ``FaultPlan``) arms the
+    ``stream.window`` stall seam and the ``sink.append`` io_error seam
+    on the stream thread.
     """
 
-    def __init__(self, max_inflight: int = 2, metrics: Any = None):
+    def __init__(
+        self,
+        max_inflight: int = 2,
+        metrics: Any = None,
+        watchdog_s: Optional[float] = None,
+        faults: Any = None,
+    ):
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight={max_inflight} must be >= 1"
             )
+        if watchdog_s is not None and watchdog_s <= 0:
+            raise ValueError(f"watchdog_s={watchdog_s} must be > 0")
         self.max_inflight = int(max_inflight)
+        self.watchdog_s = watchdog_s
+        self._faults = faults
         self._metrics = metrics
         self._queue: List[WindowItem] = []
         self._cond = threading.Condition()
@@ -167,6 +203,15 @@ class Streamer:
             if self._error is not None:
                 raise self._error
 
+    def progress_token(self):
+        """An opaque snapshot of pipe state; two equal tokens a
+        watchdog period apart mean NO item completed in between — the
+        no-progress test the watchdog waits (``drain``, the server's
+        ``result``) key off, so a slow-but-moving pipe never trips
+        them."""
+        with self._cond:
+            return (len(self._queue), self._inflight, self._busy)
+
     def submit(self, item: WindowItem) -> float:
         """Enqueue a window; BLOCKS while ``max_inflight`` windows are
         already queued/processing (the pipeline's backpressure: the
@@ -186,11 +231,23 @@ class Streamer:
             real = item.traj is not None
             if real and self._inflight >= self.max_inflight:
                 t0 = time.perf_counter()
-                self._cond.wait_for(
+                done = self._cond.wait_for(
                     lambda: self._inflight < self.max_inflight
                     or self._error is not None
-                    or self._stop
+                    or self._stop,
+                    timeout=self.watchdog_s,
                 )
+                if not done:
+                    # the watchdog: the pipe made no progress for a
+                    # whole watchdog period — a hung sink or a device
+                    # window that never landed. Raise instead of
+                    # wedging tick() forever.
+                    raise WatchdogTimeout(
+                        f"stream handoff stalled > {self.watchdog_s}s "
+                        f"with {self._inflight}/{self.max_inflight} "
+                        f"windows in flight — a sink append or the "
+                        f"device window fetch is hung"
+                    )
                 stalled = time.perf_counter() - t0
                 if self._error is not None:
                     raise self._error
@@ -222,23 +279,49 @@ class Streamer:
     def drain(self) -> None:
         """Block until every queued item is fully processed; raise any
         stream-thread failure. The barrier ``result()``,
-        ``run_until_idle()``, and ``close()`` sit behind."""
+        ``run_until_idle()``, and ``close()`` sit behind. With the
+        watchdog armed, a drain that makes no progress for a whole
+        watchdog period raises :class:`WatchdogTimeout`."""
         with self._cond:
-            self._cond.wait_for(
-                lambda: (not self._queue and self._inflight == 0
-                         and not self._busy)
-                or self._error is not None
-            )
-            if self._error is not None:
-                raise self._error
+            while True:
+                pending = (len(self._queue), self._inflight, self._busy)
+                done = self._cond.wait_for(
+                    lambda: (not self._queue and self._inflight == 0
+                             and not self._busy)
+                    or self._error is not None,
+                    timeout=self.watchdog_s,
+                )
+                if self._error is not None:
+                    raise self._error
+                if done:
+                    return
+                if (
+                    len(self._queue), self._inflight, self._busy
+                ) == pending:
+                    raise WatchdogTimeout(
+                        f"stream drain stalled > {self.watchdog_s}s "
+                        f"({pending[0]} queued, {pending[1]} in "
+                        f"flight) — a sink append or the device "
+                        f"window fetch is hung"
+                    )
+                # progress happened (slower than the watchdog period
+                # per item is fine) — keep waiting
 
     def close(self) -> None:
         """Drain, stop, and join the stream thread. Raises a parked
-        stream error after the thread is down (cleanup first)."""
+        stream error after the thread is down (cleanup first). With
+        the watchdog armed, a join the stream thread never completes
+        (hung mid-item) raises :class:`WatchdogTimeout` — the daemon
+        thread is abandoned, not waited on forever."""
         with self._cond:
             self._stop = True
             self._cond.notify_all()
-        self._thread.join()
+        self._thread.join(timeout=self.watchdog_s)
+        if self._thread.is_alive():
+            raise WatchdogTimeout(
+                f"stream thread did not stop within "
+                f"{self.watchdog_s}s of close — abandoned (daemon)"
+            )
         self.check()
 
     # -- stream thread -------------------------------------------------------
@@ -270,12 +353,16 @@ class Streamer:
                 self._cond.notify_all()
 
     def _process(self, item: WindowItem) -> None:
+        if self._faults and item.traj is not None:
+            # injected window stall: models a hung device fetch / slow
+            # sink without needing either to actually misbehave
+            self._faults.stall("stream.window")
         host = None
         if item.traj is not None:
             # waits for compute + the async copy started at dispatch
             host = jax.device_get(item.traj)
         ready = time.perf_counter()
-        process_window(host, item.slices)
+        process_window(host, item.slices, faults=self._faults)
         if item.traj is not None:
             done = time.perf_counter()
             if self._metrics is not None:

@@ -1,0 +1,174 @@
+"""The serve write-ahead log: a SIGKILL'd server loses no admitted work.
+
+Before round 12 the scenario server's only durable output was the
+per-request ``.lens`` result logs — a killed server forgot every
+admitted-but-unfinished request, every held snapshot, and every
+resubmit chain. This module is the sweep ledger's discipline
+(append-only framed JSON events, replay at open — the same
+:class:`~lens_tpu.emit.log.JsonFrameLog` framing) applied to serving:
+
+- every client ``submit``/``resubmit`` is one event, written (and
+  flushed to the OS) before the request id is returned;
+- every terminal status is a ``retire`` event; a ``streamed`` event
+  marks the moment the request's records are DURABLY down (sink closed
+  and flushed) — the distinction that makes recovery honest under the
+  pipeline, where status flips DONE while sink appends are still in
+  flight;
+- a ``hold_state`` retirement spills the pinned snapshot via the
+  checkpoint rename protocol (:func:`lens_tpu.checkpoint.save_tree`)
+  and records a ``hold`` event, so a recovered server can re-pin the
+  exact bits and serve ``resubmit`` continuations from them.
+
+Recovery (``SimServer(recover_dir=...)``) is replay: finished requests
+(retire + streamed for DONE) materialize as terminal tickets pointing
+at their existing result logs; everything else is RE-RUN FROM ITS
+EXACT INPUTS — the serving determinism contract (a request's bits are
+a pure function of its request) turns "re-run" into "bitwise resume",
+so a recovered run's outputs equal an uninterrupted run's byte for
+byte (pinned in tests/test_recovery.py, SIGKILL at every named
+kill-point).
+
+Durability policy: appends flush to the OS immediately (a SIGKILL'd
+process loses nothing appended), while fsync is GROUP COMMIT — the
+scheduler syncs once per tick before acting on the queue, and appends
+are sequential so every sync makes a clean prefix durable. The framing
+tolerates a torn tail frame exactly like the sweep ledger.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Dict, List, Mapping, Optional
+
+from lens_tpu.emit.log import JsonFrameLog
+
+WAL_NAME = "serve.wal"
+SPILL_DIR = "snapshots"
+
+#: Event vocabulary (replay ignores unknown events, so old readers
+#: tolerate newer WALs — the ledger's forward-compat posture).
+BEGIN = "server_begin"   # {fingerprint, buckets}
+SUBMIT = "submit"        # {rid, request}
+RESUBMIT = "resubmit"    # {rid, parent, extra_horizon}
+RETIRE = "retire"        # {rid, status, error, steps}
+STREAMED = "streamed"    # {rid} records durably on disk
+HOLD = "hold"            # {rid, key, name} held snapshot spilled
+RELEASE = "release"      # {rid} hold dropped
+
+
+def buckets_fingerprint(buckets: Mapping[str, Mapping[str, Any]]) -> str:
+    """sha256 over the BITS-RELEVANT bucket configuration. Scheduling
+    knobs (lanes, window, queue depth) are deliberately absent — the
+    co-batching determinism contract makes results independent of
+    them, so a recovered server may legally resize its pool. Anything
+    that changes what a request computes (composite, config, capacity,
+    agent defaults, timestep, emit cadence) is in."""
+    canon = {
+        name: {
+            "composite": cfg.get("composite") or name,
+            "config": cfg.get("config") or {},
+            "capacity": cfg.get("capacity"),
+            "n_agents": cfg.get("n_agents"),
+            "division": cfg.get("division", True),
+            "timestep": float(cfg.get("timestep", 1.0)),
+            "emit_every": int(cfg.get("emit_every", 1)),
+        }
+        for name, cfg in buckets.items()
+    }
+    blob = json.dumps(canon, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def key_to_json(key: Any) -> Any:
+    """A SnapshotStore key (nested tuples of str/int) as JSON."""
+    if isinstance(key, tuple):
+        return [key_to_json(k) for k in key]
+    return key
+
+
+def key_from_json(key: Any) -> Any:
+    """Inverse of :func:`key_to_json` (lists back to tuples, exactly —
+    the store addresses by tuple equality)."""
+    if isinstance(key, list):
+        return tuple(key_from_json(k) for k in key)
+    return key
+
+
+def spill_name(key: Any) -> str:
+    """Deterministic spill-directory name for a snapshot key — stable
+    across a re-run of the same request, so a crash between spill and
+    WAL append is healed by the next spill simply overwriting it."""
+    digest = hashlib.sha256(repr(key).encode()).hexdigest()[:16]
+    return f"snap_{digest}"
+
+
+class ServeWal:
+    """One server's write-ahead log (thread-safe: ``streamed`` events
+    land from the stream thread while the scheduler appends).
+
+    ``events`` is the replayed history; :meth:`begin` pins (or, on a
+    replayed file, verifies) the bucket fingerprint — recovering with
+    buckets that would compute different bits is refused instead of
+    silently serving a different simulation under old request ids.
+    """
+
+    def __init__(self, path: str):
+        self._log = JsonFrameLog(path, fsync_every=False)
+        self._lock = threading.Lock()
+        self._dirty = False
+        self.path = path
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        return self._log.events
+
+    def replayed(self) -> bool:
+        """True when the file held events before this open — the
+        server must run recovery before serving."""
+        return any(e.get("event") != BEGIN for e in self._log.events)
+
+    def begin(
+        self, fingerprint: str, buckets: Mapping[str, Any]
+    ) -> None:
+        for e in self._log.events:
+            if e.get("event") == BEGIN:
+                if e.get("fingerprint") != fingerprint:
+                    raise ValueError(
+                        f"{self.path} belongs to a server with bucket "
+                        f"fingerprint {e.get('fingerprint')!r}, not "
+                        f"{fingerprint!r} — the bucket configuration "
+                        f"changed in a bits-relevant way; recovery "
+                        f"under old request ids would serve a "
+                        f"different simulation. Use a fresh "
+                        f"recover_dir (or restore the original "
+                        f"buckets)."
+                    )
+                return
+        self.append({
+            "event": BEGIN,
+            "fingerprint": fingerprint,
+            "buckets": {k: dict(v) for k, v in buckets.items()},
+        })
+
+    def append(self, event: Mapping[str, Any]) -> None:
+        """Append one event: framed + flushed to the OS (SIGKILL-safe)
+        now, fsynced at the next :meth:`sync` (group commit)."""
+        with self._lock:
+            self._log.append(event)
+            self._dirty = True
+
+    def sync(self) -> None:
+        """Group commit: fsync every append so far (the scheduler
+        calls this once per tick, before acting on the queue; a tick
+        with nothing appended skips the syscall)."""
+        with self._lock:
+            if self._dirty:
+                self._log.sync()
+                self._dirty = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._log.sync()
+            self._log.close()

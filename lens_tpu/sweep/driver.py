@@ -282,6 +282,12 @@ class _ServerSweep:
             self.max_in_flight = 2 * pool.n_lanes
         emit_paths = objective.emit_paths()
         self.emit_spec = {"paths": emit_paths} if emit_paths else None
+        # QueueFull backoff jitter: seeded from the sweep seed so a
+        # replayed sweep's sleep schedule (never its BITS — sleeps
+        # cannot touch results) is reproducible too
+        self._backoff_rng = np.random.default_rng(
+            np.random.SeedSequence([int(spec.seed), 0xB0FF])
+        )
         self.warmup = (
             dict(spec.warmup) if spec.warmup is not None else None
         )
@@ -310,23 +316,41 @@ class _ServerSweep:
 
     # -- plumbing ------------------------------------------------------------
 
-    def _submit(self, request) -> str:
+    def _retrying(self, attempt: Callable[[], str]) -> str:
+        """Submit with honest client-side backpressure handling: the
+        first retry just ticks (this driver IS the server's driver, so
+        ticking drains our own backlog — sleeping first would only
+        idle the device); past that, capped exponential backoff with
+        seeded jitter, never sleeping longer than the server's
+        occupancy-derived ``retry_after`` hint (the hint is an
+        estimate of when space opens — sleeping past it wastes wall,
+        sleeping a jittered fraction of it avoids every client
+        retrying in lockstep). The remote-client policy, documented
+        in docs/serving.md "Backpressure & backoff"."""
         from lens_tpu.serve import QueueFull
 
+        attempts = 0
         while True:
             try:
-                return self.server.submit(request)
-            except QueueFull:
+                return attempt()
+            except QueueFull as e:
                 self.server.tick()
+                attempts += 1
+                if attempts < 2:
+                    continue  # a tick freed a lane most of the time
+                delay = min(0.01 * 2 ** (attempts - 2), 1.0)
+                delay *= 0.5 + self._backoff_rng.uniform(0.0, 1.0)
+                if e.retry_after > 0:
+                    delay = min(delay, e.retry_after)
+                time.sleep(delay)
+
+    def _submit(self, request) -> str:
+        return self._retrying(lambda: self.server.submit(request))
 
     def _resubmit(self, rid: str, extra_horizon: float) -> str:
-        from lens_tpu.serve import QueueFull
-
-        while True:
-            try:
-                return self.server.resubmit(rid, extra_horizon)
-            except QueueFull:
-                self.server.tick()
+        return self._retrying(
+            lambda: self.server.resubmit(rid, extra_horizon)
+        )
 
     def _request(self, trial: Trial, horizon: float, hold: bool):
         from lens_tpu.serve import ScenarioRequest

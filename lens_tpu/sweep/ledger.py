@@ -10,10 +10,10 @@ re-run only what has no terminal event. Because trials are bitwise
 reproducible within a backend, the resumed table is identical to an
 uninterrupted run's.
 
-Framing reuses the emit-log record frame (``emit/log.py``: magic + crc
-+ length, via :func:`~lens_tpu.emit.log.iter_frames`) with a JSON
-payload instead of npz — same truncation semantics: a kill mid-append
-loses at most the torn tail frame, which replay silently drops. The
+Framing rides :class:`~lens_tpu.emit.log.JsonFrameLog` (the emit-log
+record frame — magic + crc + length — with JSON payloads, shared with
+the serve WAL) — same truncation semantics: a kill mid-append loses at
+most the torn tail frame, which replay silently drops. The
 final ``sweep_result.json`` table is written with ``checkpoint.py``'s
 write-tmp-then-rename discipline so a kill mid-write can never leave a
 torn table shadowing a good ledger.
@@ -31,7 +31,7 @@ import json
 import os
 from typing import Any, Dict, List, Mapping, Optional
 
-from lens_tpu.emit.log import frame, iter_frames
+from lens_tpu.emit.log import JsonFrameLog
 
 #: Event types (the full vocabulary — replay ignores unknown events so
 #: old readers tolerate newer ledgers).
@@ -82,28 +82,13 @@ class TrialLedger:
         self.stopped: Dict[int, Dict[str, Any]] = {}
         self.rungs: Dict[int, Dict[int, float]] = {}
         self.events: List[Dict[str, Any]] = []
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        if os.path.exists(path):
-            good = 0  # offset past the last COMPLETE frame
-            for payload, end in iter_frames(path, with_offsets=True):
-                try:
-                    event = json.loads(payload.decode())
-                except (UnicodeDecodeError, json.JSONDecodeError) as e:
-                    raise ValueError(
-                        f"{path}: complete frame with undecodable JSON "
-                        f"payload ({e}) — not a sweep ledger?"
-                    )
-                self._apply(event)
-                good = end
-            if os.path.getsize(path) > good:
-                # a kill mid-append left a torn tail frame: drop it NOW,
-                # before reopening for append — otherwise this run's
-                # events would land after the torn bytes and every
-                # later replay would read garbage (CRC error) from the
-                # first resume onward
-                with open(path, "r+b") as f:
-                    f.truncate(good)
-        self._file = open(path, "ab")
+        # JsonFrameLog owns the framing, replay, and torn-tail
+        # truncation (shared with the serve WAL); fsync-per-append is
+        # the ledger's durability policy — an event is on disk before
+        # the driver acts on it
+        self._log = JsonFrameLog(path, fsync_every=True)
+        for event in self._log.events:
+            self._apply(event)
 
     def __len__(self) -> int:
         return len(self.events)
@@ -150,17 +135,12 @@ class TrialLedger:
         """Durably append one event: framed, flushed, fsynced BEFORE the
         driver acts on it — the ordering that makes replay an upper
         bound on lost work (at most the in-flight trials)."""
-        event = dict(event)
-        payload = json.dumps(event, sort_keys=True, default=float).encode()
-        self._file.write(frame(payload))
-        self._file.flush()
-        os.fsync(self._file.fileno())
-        self._apply(event)
+        self._apply(self._log.append(event))
 
     def close(self) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        if self._log is not None:
+            self._log.close()
+            self._log = None
 
     def __enter__(self) -> "TrialLedger":
         return self
@@ -180,7 +160,7 @@ class MemoryLedger(TrialLedger):
         self.stopped = {}
         self.rungs = {}
         self.events = []
-        self._file = None
+        self._log = None
 
     def append(self, event: Mapping[str, Any]) -> None:
         self._apply(dict(event))

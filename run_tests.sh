@@ -40,6 +40,8 @@ tests/test_colony.py
 tests/test_serve.py
 tests/test_streamer.py
 tests/test_snapshots.py
+tests/test_faults.py
+tests/test_recovery.py
 tests/test_sweep.py
 "
 
@@ -59,7 +61,7 @@ BATCHES=(
   "tests/test_adi.py"
   "tests/test_parallel.py tests/test_distributed.py"
   "tests/test_multispecies.py tests/test_ensemble.py"
-  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py"
+  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_faults.py tests/test_recovery.py"
   "tests/test_sweep.py tests/test_cli.py"
   "tests/test_experiment.py"
   "tests/test_bridge.py"

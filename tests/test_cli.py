@@ -96,6 +96,30 @@ class TestParsing:
         )
         assert args.snapshot_budget_mb == 16.5
 
+    def test_serve_fault_tolerance_args(self):
+        """Round 12: quarantine / watchdog / WAL / fault-plan flags."""
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json"]
+        )
+        assert args.check_finite == "off"      # bitwise r11 default
+        assert args.watchdog is None
+        assert args.recover_dir is None
+        assert args.faults is None
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json",
+             "--check-finite", "window", "--watchdog", "2.5",
+             "--recover-dir", "out/wal", "--faults", "faults.json"]
+        )
+        assert args.check_finite == "window"
+        assert args.watchdog == 2.5
+        assert args.recover_dir == "out/wal"
+        assert args.faults == "faults.json"
+        with pytest.raises(SystemExit):  # only off|window
+            _build_parser().parse_args(
+                ["serve", "--requests", "r.json",
+                 "--check-finite", "sometimes"]
+            )
+
     def test_sweep_args(self):
         args = _build_parser().parse_args(
             ["sweep", "--spec", "sweep.json", "--out-dir", "out/s",
@@ -199,6 +223,126 @@ class TestServeCommand:
         assert rc == 0
         assert "served 1 requests" in capsys.readouterr().out
         assert os.path.exists(os.path.join(out, "server_meta.json"))
+
+
+class TestServeEagerValidation:
+    """Round 12 satellite: malformed request JSON fails at submit with
+    a descriptive SystemExit — not a FAILED ticket from deep inside
+    admission compile, and never a half-served list."""
+
+    def _serve(self, tmp_path, reqs, extra=()):
+        path = tmp_path / "reqs.json"
+        path.write_text(json.dumps(reqs))
+        return main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4",
+            "--requests", str(path),
+            "--out-dir", str(tmp_path / "served"), *extra,
+        ])
+
+    def test_unknown_request_key_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="unknown request keys"):
+            self._serve(tmp_path, [{"seed": 1, "horizont": 8.0}])
+
+    def test_unknown_override_path_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="not a schema variable"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0,
+                 "overrides": {"cell": {"glucose_internol": 0.2}}},
+            ])
+
+    def test_malformed_emit_block_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="unknown emit keys"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0,
+                 "emit": {"path": ["alive"]}},  # 'paths', not 'path'
+            ])
+        with pytest.raises(SystemExit, match="list of path-prefix"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0, "emit": {"paths": "alive"}},
+            ])
+
+    def test_malformed_prefix_block_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="unknown prefix keys"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0,
+                 "prefix": {"horizon": 4.0, "override": {}}},
+            ])
+        with pytest.raises(SystemExit, match="prefix override path"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0,
+                 "prefix": {"horizon": 4.0,
+                            "overrides": {"cell": {"nope": 1.0}}}},
+            ])
+
+    def test_out_of_range_n_agents_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="bucket capacity"):
+            self._serve(tmp_path, [
+                {"seed": 1, "horizon": 8.0, "n_agents": 99},
+            ])
+
+    def test_bad_faults_plan_rejected(self, tmp_path):
+        bad = tmp_path / "faults.json"
+        bad.write_text(json.dumps([{"kind": "explode"}]))
+        with pytest.raises(SystemExit, match="unknown kind"):
+            self._serve(
+                tmp_path, [{"seed": 1, "horizon": 8.0}],
+                extra=("--faults", str(bad)),
+            )
+
+    def test_sweep_inherits_eager_validation(self, tmp_path):
+        """The sweep's server backend submits through the same eager
+        checks: a bad override path in the space fails the FIRST
+        submit descriptively, not an admission compile later."""
+        from lens_tpu.sweep import run_sweep
+
+        spec = {
+            "composite": "minimal_ode",
+            "space": {"kind": "grid", "params": {
+                "environment/glucose_externol": {"grid": [0.5, 1.0]},
+            }},
+            "horizon": 8.0,
+            "objective": {"path": "cell/glucose_internal",
+                          "reduction": "final_live_sum", "mode": "max"},
+            "capacity": 4,
+            "backend": {"kind": "server", "lanes": 2, "window": 4},
+        }
+        with pytest.raises(ValueError, match="not a schema variable"):
+            run_sweep(spec)
+
+
+class TestServeRecoveryFlags:
+    def test_serve_writes_wal_when_recover_dir_given(
+        self, tmp_path, capsys
+    ):
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps([{"seed": 1, "horizon": 8.0}]))
+        out = str(tmp_path / "served")
+        wal = str(tmp_path / "wal")
+        rc = main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4",
+            "--requests", str(reqs), "--out-dir", out,
+            "--recover-dir", wal, "--check-finite", "window",
+        ])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "served 1 requests" in printed
+        assert os.path.exists(os.path.join(wal, "serve.wal"))
+        assert "serve.wal" in printed
+        # a second invocation over the same dirs recovers: everything
+        # already finished, so it submits nothing and reports the
+        # replayed request as done
+        rc = main([
+            "serve", "--composite", "minimal_ode", "--capacity", "4",
+            "--lanes", "2", "--window", "4",
+            "--requests", str(reqs), "--out-dir", out,
+            "--recover-dir", wal,
+        ])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "recovered 1 request(s)" in printed
+        assert "done=1" in printed
 
 
 class TestSweepCommand:

@@ -1,0 +1,363 @@
+"""Fault injection + lane quarantine + the serve watchdog (round 12).
+
+The chaos pins of ISSUE 10's tentpole: NaN injected into one lane
+fails ONLY that request while co-batched lanes' streamed bytes are
+bitwise unchanged vs a no-fault run; a hung streamer handoff expires
+via the watchdog instead of wedging ``tick()``; injected sink I/O
+errors propagate through the existing stream-error contract; and the
+deterministic :class:`~lens_tpu.serve.faults.FaultPlan` behind all of
+it replays identically.
+"""
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lens_tpu.serve import (
+    DONE,
+    FaultPlan,
+    QueueFull,
+    ScenarioRequest,
+    SimServer,
+    SimulationDiverged,
+    WatchdogTimeout,
+)
+from lens_tpu.serve.faults import KILL_SEAMS
+
+
+def _toggle_server(**kw):
+    kw.setdefault("lanes", 4)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+class TestFaultPlan:
+    """The harness itself: deterministic, seeded, validated."""
+
+    def test_occurrence_counting_is_deterministic(self):
+        plan = FaultPlan([
+            {"kind": "stall", "occurrence": 3, "seconds": 0.0},
+        ])
+        fired = [bool(plan.fire("stream.window")) for _ in range(5)]
+        assert fired == [False, False, True, False, False]
+
+    def test_occurrence_zero_fires_every_match(self):
+        plan = FaultPlan([{"kind": "stall", "occurrence": 0}])
+        assert all(bool(plan.fire("stream.window")) for _ in range(4))
+
+    def test_request_and_step_filters(self):
+        plan = FaultPlan([
+            {"kind": "nan", "request": "req-000001", "after_steps": 16},
+        ])
+        assert not plan.poison("req-000000", 100)  # wrong request
+        assert not plan.poison("req-000001", 8)    # too early
+        assert plan.poison("req-000001", 16)       # fires once
+        assert not plan.poison("req-000001", 24)   # spent
+
+    def test_seeded_probabilistic_replays_identically(self):
+        def draw(seed):
+            plan = FaultPlan(
+                [{"kind": "stall", "occurrence": 0, "p": 0.5}],
+                seed=seed,
+            )
+            return [bool(plan.fire("stream.window")) for _ in range(32)]
+
+        a, b = draw(7), draw(7)
+        assert a == b            # same seed, same chaos
+        assert any(a) and not all(a)  # actually probabilistic
+        assert draw(8) != a      # a different seed is different chaos
+
+    def test_from_spec_forms_and_validation(self, tmp_path):
+        import json
+
+        assert not FaultPlan.from_spec(None)
+        plan = FaultPlan.from_spec(
+            {"seed": 3, "faults": [{"kind": "stall"}]}
+        )
+        assert plan.seed == 3 and len(plan.faults) == 1
+        path = tmp_path / "faults.json"
+        path.write_text(json.dumps([{"kind": "kill",
+                                     "at": "window.dispatched"}]))
+        assert len(FaultPlan.from_spec(str(path)).faults) == 1
+        with pytest.raises(ValueError, match="unknown kind"):
+            FaultPlan([{"kind": "explode"}])
+        with pytest.raises(ValueError, match="kill seam"):
+            FaultPlan([{"kind": "kill", "at": "nowhere"}])
+        with pytest.raises(ValueError, match="unknown keys"):
+            FaultPlan([{"kind": "stall", "surprise": 1}])
+        with pytest.raises(ValueError, match="fires at seam"):
+            FaultPlan([{"kind": "nan", "at": "sink.append"}])
+        with pytest.raises(ValueError, match="unknown fault-plan"):
+            FaultPlan.from_spec({"faults": [], "extra": 1})
+
+    def test_kill_seams_are_the_documented_set(self):
+        # docs/serving.md lists these; a rename must update both
+        assert KILL_SEAMS == (
+            "submit.walled", "resubmit.walled", "admitted",
+            "window.dispatched", "hold.spilled", "retired.walled",
+            "streamed.walled",
+        )
+
+
+class TestQuarantine:
+    """check_finite="window": a poisoned lane fails only its request."""
+
+    def _serve_logged(self, out_dir, faults, pipeline="on"):
+        srv = _toggle_server(
+            out_dir=str(out_dir), sink="log",
+            check_finite="window", faults=faults, pipeline=pipeline,
+        )
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=24.0,
+            ))
+            for s in (1, 2, 3)
+        ]
+        srv.run_until_idle(max_ticks=200)
+        paths = {r: srv.status(r)["result_path"] for r in rids}
+        statuses = {r: srv.status(r)["status"] for r in rids}
+        counters = srv.metrics()["counters"]
+        errors = {r: srv.status(r)["error"] for r in rids}
+        return srv, rids, paths, statuses, counters, errors
+
+    @pytest.mark.parametrize("pipeline", ["on", "off"])
+    def test_nan_fails_only_poisoned_request_bitwise(
+        self, tmp_path, pipeline
+    ):
+        """THE quarantine pin: the poisoned request alone fails with a
+        descriptive SimulationDiverged; the co-batched requests'
+        streamed BYTES are identical to a no-fault run's."""
+        plan = FaultPlan([
+            {"kind": "nan", "request": "req-000001", "after_steps": 8},
+        ])
+        srv_f, rids, paths_f, st_f, c_f, err_f = self._serve_logged(
+            tmp_path / "faulty", plan, pipeline
+        )
+        srv_c, _, paths_c, st_c, c_c, _ = self._serve_logged(
+            tmp_path / "clean", None, pipeline
+        )
+        assert st_f[rids[1]] == "failed"
+        assert st_f[rids[0]] == st_f[rids[2]] == DONE
+        assert c_f["diverged"] == 1 and c_c["diverged"] == 0
+        assert "SimulationDiverged" in err_f[rids[1]]
+        assert "reclaimed" in err_f[rids[1]]
+        with pytest.raises(SimulationDiverged, match="non-finite"):
+            srv_f.result(rids[1])
+        for rid in (rids[0], rids[2]):
+            with open(paths_f[rid], "rb") as a, \
+                    open(paths_c[rid], "rb") as b:
+                assert a.read() == b.read()  # bitwise, whole file
+        srv_f.close()
+        srv_c.close()
+
+    def test_default_off_is_round_11_behavior(self):
+        """check_finite="off" (the default): the same injected NaN
+        sails through — no check program, no status change (the
+        garbage is the client's problem, exactly as before round 12)."""
+        plan = FaultPlan([
+            {"kind": "nan", "request": "req-000000", "after_steps": 8},
+        ])
+        srv = _toggle_server(faults=plan)  # check_finite defaults off
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=24.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(rid)["status"] == DONE
+        ts = srv.result(rid)  # no SimulationDiverged raised
+        assert np.isnan(
+            np.asarray(ts["cell"]["protein_u"])
+        ).any()  # the poison really flowed through
+        assert srv.metrics()["counters"]["diverged"] == 0
+        srv.close()
+
+    def test_final_window_divergence_flips_done_to_failed(self):
+        """The one-window detection lag can land AFTER the lane
+        retired DONE: the flip path — status becomes failed, result()
+        still raises, a held snapshot is never left extendable."""
+        plan = FaultPlan([
+            # horizon 16, window 8: poison before the SECOND (final)
+            # window, so retirement and detection race
+            {"kind": "nan", "request": "req-000000", "after_steps": 8},
+        ])
+        srv = _toggle_server(
+            lanes=2, check_finite="window", faults=plan
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(rid)["status"] == "failed"
+        with pytest.raises(SimulationDiverged):
+            srv.result(rid)
+        with pytest.raises(ValueError, match="only DONE"):
+            srv.resubmit(rid, 8.0)  # flipped to failed: not extendable
+        # and the poisoned hold itself was dropped (no pin leaked)
+        assert srv.snapshots.refs_total() == 0
+        srv.close()
+
+    def test_quarantined_lane_serves_the_next_request(self):
+        """Quarantine reclaims the lane: a subsequent request admitted
+        into the (stale-NaN) lane is built fresh and runs clean."""
+        plan = FaultPlan([
+            {"kind": "nan", "request": "req-000000", "after_steps": 8},
+        ])
+        srv = _toggle_server(
+            lanes=1, check_finite="window", faults=plan
+        )
+        bad = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=400.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(bad)["status"] == "failed"
+        ok = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=16.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(ok)["status"] == DONE
+        assert not np.isnan(
+            np.asarray(srv.result(ok)["cell"]["protein_u"])
+        ).any()
+        assert srv.metrics()["counters"]["diverged"] == 1
+        srv.close()
+
+
+class TestWatchdog:
+    def test_stalled_stream_raises_instead_of_wedging(self):
+        """A streamer stalled past the watchdog raises WatchdogTimeout
+        from tick() in bounded time — previously an unbounded wedge
+        behind the backpressure wait."""
+        plan = FaultPlan([
+            {"kind": "stall", "occurrence": 0, "seconds": 0.8},
+        ])
+        srv = _toggle_server(
+            lanes=1, window=4, watchdog_s=0.2, stream_queue=1,
+            faults=plan,
+        )
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=400.0,
+        ))
+        t0 = time.perf_counter()
+        with pytest.raises(WatchdogTimeout, match="stalled"):
+            for _ in range(50):
+                srv.tick()
+        assert time.perf_counter() - t0 < 5.0  # bounded, not wedged
+        with contextlib.suppress(WatchdogTimeout):
+            srv.close()
+
+    def test_injected_sink_io_error_propagates(self):
+        """The io_error seam rides the existing stream-error contract:
+        the failure parks on the stream thread and raises at the next
+        scheduler call; close() re-raises without masking."""
+        plan = FaultPlan([{"kind": "io_error", "request": "req-000000"}])
+        srv = _toggle_server(lanes=1, window=4, faults=plan)
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        with pytest.raises(OSError, match="injected"):
+            srv.run_until_idle(max_ticks=100)
+        with pytest.raises(OSError, match="injected"):
+            srv.close()
+
+    def test_injected_sink_io_error_sync_path(self):
+        """pipeline="off": the same seam raises inline from tick()."""
+        plan = FaultPlan([{"kind": "io_error", "request": "req-000000"}])
+        srv = _toggle_server(
+            lanes=1, window=4, pipeline="off", faults=plan
+        )
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        with pytest.raises(OSError, match="injected"):
+            srv.run_until_idle(max_ticks=100)
+        srv.close()
+
+
+class TestDeadlineStreamRace:
+    def test_expiry_after_handoff_delivers_partials_exactly_once(self):
+        """A request expired AFTER its window was handed to the
+        background streamer still delivers that window's records
+        exactly once: the injected stall holds the window in the
+        streamer while the deadline fires, the TIMEOUT close queues
+        BEHIND the pending appends, and result() returns the partial
+        rows once — no loss, no duplication."""
+        plan = FaultPlan([
+            {"kind": "stall", "occurrence": 1, "seconds": 0.5},
+        ])
+        srv = _toggle_server(
+            lanes=1, window=4, stream_queue=1, faults=plan
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=400.0,
+            deadline=0.25,
+        ))
+        srv.tick()  # admit + window 1 -> handed to the (stalled) streamer
+        assert srv.status(rid)["status"] == "running"
+        time.sleep(0.3)  # the deadline passes while the window streams
+        srv.tick()  # expiry sweep: TIMEOUT, lane reclaimed
+        assert srv.status(rid)["status"] == "timeout"
+        partial = srv.result(rid)
+        times = np.asarray(partial["__times__"])
+        assert times.shape[0] == 4          # window 1's rows, exactly
+        assert np.array_equal(times, np.arange(1.0, 5.0))  # once each
+        srv.close()
+
+
+class TestOccupancyRetryAfter:
+    def test_hint_scales_with_queued_work_not_queue_length(self):
+        """QueueFull.retry_after is derived from the backlog's actual
+        remaining WINDOWS (occupancy mirrors + queued horizons), so a
+        queue of one long request hints a proportionally longer wait
+        than a queue of one short one — same queue LENGTH."""
+
+        def hint(horizon):
+            srv = _toggle_server(lanes=1, window=8, queue_depth=1)
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=1, horizon=horizon,
+            ))
+            with pytest.raises(QueueFull) as exc:
+                srv.submit(ScenarioRequest(
+                    composite="toggle_colony", seed=2, horizon=8.0,
+                ))
+            srv.close()
+            return exc.value.retry_after
+
+        short, long = hint(8.0), hint(800.0)
+        assert short > 0
+        assert long > 5 * short  # 100 queued windows vs 1
+
+    def test_hint_counts_time_to_the_next_free_lane(self):
+        """With every lane busy, the hint includes windows until the
+        EARLIEST lane frees (read off the host-mirrored counters)."""
+        srv = _toggle_server(lanes=1, window=8, queue_depth=1)
+        running = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=800.0,
+        ))
+        srv.tick()  # admitted: lane busy, ~99 windows left
+        assert srv.status(running)["status"] == "running"
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=8.0,
+        ))
+        with pytest.raises(QueueFull) as exc:
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=3, horizon=8.0,
+            ))
+        # >= ~90 windows to the free lane at the measured window rate;
+        # just pin it clears a plain one-window hint by a wide margin
+        assert exc.value.retry_after > 10 * \
+            srv._metrics.avg_window_seconds()
+        srv.cancel(running)
+        srv.run_until_idle(max_ticks=100)
+        srv.close()

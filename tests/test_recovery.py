@@ -1,0 +1,367 @@
+"""Crash-recoverable serving: the WAL, snapshot spills, and the
+SIGKILL-at-every-kill-point bitwise pin (round 12).
+
+The contract (docs/serving.md, "Fault tolerance & recovery"): a server
+built with ``recover_dir`` WALs every client submit/resubmit/terminal,
+spills held snapshots via the checkpoint rename protocol, and — killed
+at ANY point and rebuilt over the same directory — produces per-request
+result logs bitwise equal to an uninterrupted run's. Finished requests
+keep their logs; unfinished ones re-run from their exact inputs, which
+the serving determinism contract turns into a bitwise resume.
+
+The quick tests exercise recovery in-process (abandon without close —
+the streamer/writer threads are daemons, so this under-approximates a
+real kill only in that OS buffers survive; the slow tier SIGKILLs real
+subprocesses at every named kill-point, which approximates nothing).
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from lens_tpu.emit.log import JsonFrameLog
+from lens_tpu.serve import (
+    DONE,
+    ScenarioRequest,
+    ServeWal,
+    SimServer,
+)
+from lens_tpu.serve.faults import KILL_SEAMS
+from lens_tpu.serve.wal import key_from_json, key_to_json
+
+
+def _mk(out_dir, recover_dir, composite="toggle_colony", **kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    return SimServer.single_bucket(
+        composite, out_dir=str(out_dir), sink="log",
+        recover_dir=str(recover_dir), **kw,
+    )
+
+
+def _lens_bytes(out_dir):
+    return {
+        os.path.basename(p): open(p, "rb").read()
+        for p in glob.glob(os.path.join(str(out_dir), "*.lens"))
+    }
+
+
+class TestServeWal:
+    def test_key_json_roundtrip(self):
+        key = ("bucket", 3, (("ecoli", 2), ("scav", 1)), "abcd", 64)
+        assert key_from_json(
+            json.loads(json.dumps(key_to_json(key)))
+        ) == key
+        assert key_from_json(key_to_json(("held", "req-000001"))) \
+            == ("held", "req-000001")
+
+    def test_torn_tail_frame_is_truncated_on_replay(self, tmp_path):
+        path = str(tmp_path / "serve.wal")
+        wal = ServeWal(path)
+        wal.append({"event": "submit", "rid": "req-000000"})
+        wal.close()
+        size = os.path.getsize(path)
+        with open(path, "ab") as f:
+            f.write(b"LENS-torn")  # kill mid-append
+        wal2 = ServeWal(path)
+        assert [e["event"] for e in wal2.events] == ["submit"]
+        assert os.path.getsize(path) == size  # torn bytes dropped
+        wal2.append({"event": "retire", "rid": "req-000000"})
+        wal2.close()
+        assert len(ServeWal(path).events) == 2  # clean append after
+
+    def test_begin_refuses_changed_bucket_fingerprint(self, tmp_path):
+        path = str(tmp_path / "serve.wal")
+        wal = ServeWal(path)
+        wal.begin("fp-aaaa", {"toggle_colony": {}})
+        wal.close()
+        wal2 = ServeWal(path)
+        wal2.begin("fp-aaaa", {"toggle_colony": {}})  # same: fine
+        with pytest.raises(ValueError, match="fingerprint"):
+            wal2.begin("fp-bbbb", {"toggle_colony": {}})
+        wal2.close()
+
+    def test_recover_dir_requires_log_sink(self, tmp_path):
+        with pytest.raises(ValueError, match="sink='log'"):
+            SimServer.single_bucket(
+                "toggle_colony", capacity=16,
+                recover_dir=str(tmp_path / "wal"),
+            )
+
+    def test_changed_bucket_config_refused_at_construction(
+        self, tmp_path
+    ):
+        srv = _mk(tmp_path / "out", tmp_path / "wal")
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.close()
+        with pytest.raises(ValueError, match="fingerprint"):
+            _mk(tmp_path / "out", tmp_path / "wal", capacity=32)
+
+
+class TestRecoveryInProcess:
+    """Abandon-without-close crashes: replay, re-queue, rehydrate."""
+
+    REQS = [
+        dict(composite="toggle_colony", seed=1, horizon=24.0),
+        dict(composite="toggle_colony", seed=2, horizon=24.0,
+             prefix={"horizon": 8.0},
+             overrides={"global": {"volume": 1.1}}),
+        dict(composite="toggle_colony", seed=3, horizon=16.0,
+             emit={"every": 2}),
+    ]
+
+    def _reference(self, tmp_path):
+        out = tmp_path / "ref"
+        srv = _mk(out, tmp_path / "ref_wal")
+        for r in self.REQS:
+            srv.submit(dict(r))
+        srv.run_until_idle(max_ticks=300)
+        srv.close()
+        return _lens_bytes(out)
+
+    def test_mid_flight_crash_recovers_bitwise(self, tmp_path):
+        ref = self._reference(tmp_path)
+        out, wal = tmp_path / "cr", tmp_path / "cr_wal"
+        srv = _mk(out, wal)
+        for r in self.REQS:
+            srv.submit(dict(r))
+        srv.tick()
+        srv.tick()  # some windows ran, nothing finished
+        srv._streamer.drain()  # settle in-flight appends, then vanish
+        del srv
+
+        srv2 = _mk(out, wal)
+        c = srv2.metrics()["counters"]
+        assert c["recovered"] == 3  # every client request re-queued
+        srv2.run_until_idle(max_ticks=300)
+        assert _lens_bytes(out) == ref  # bitwise, per-request
+        # the recovered server keeps serving normally
+        extra = srv2.submit(ScenarioRequest(
+            composite="toggle_colony", seed=9, horizon=8.0,
+        ))
+        srv2.run_until_idle(max_ticks=100)
+        assert srv2.status(extra)["status"] == DONE
+        srv2.close()
+
+    def test_finished_requests_are_not_re_run(self, tmp_path):
+        """Requests with a durable streamed event materialize as
+        terminal tickets over their existing logs — recovery re-runs
+        only what lacks one."""
+        ref = self._reference(tmp_path)
+        out, wal = tmp_path / "cr", tmp_path / "cr_wal"
+        srv = _mk(out, wal)
+        first = srv.submit(dict(self.REQS[0]))
+        srv.run_until_idle(max_ticks=300)  # finish request 0 alone
+        assert srv.status(first)["status"] == DONE
+        for r in self.REQS[1:]:
+            srv.submit(dict(r))
+        srv.tick()
+        srv._streamer.drain()
+        finished_log = open(
+            os.path.join(str(out), f"{first}.lens"), "rb"
+        ).read()
+        del srv
+
+        srv2 = _mk(out, wal)
+        c = srv2.metrics()["counters"]
+        assert c["recovered"] == 2  # only the unfinished pair
+        assert srv2.status(first)["status"] == DONE  # replayed terminal
+        assert srv2.result(first).endswith(f"{first}.lens")
+        srv2.run_until_idle(max_ticks=300)
+        srv2.close()
+        got = _lens_bytes(out)
+        assert got == ref
+        # the finished request's log was never touched, not re-written
+        assert got[f"{first}.lens"] == finished_log
+
+    def test_resubmit_chain_recovers_from_spilled_hold(self, tmp_path):
+        """A continuation killed mid-run re-queues from the parent's
+        SPILLED snapshot (rehydrated, not recomputed), and the
+        recovered parent stays resubmittable — the stochastic
+        hybrid_cell composite, so bitwise equality is meaningful."""
+        def chain(out, wal, crash):
+            srv = _mk(out, wal, composite="hybrid_cell",
+                      window=4, capacity=8)
+            parent = srv.submit(ScenarioRequest(
+                composite="hybrid_cell", seed=3, horizon=8.0,
+                hold_state=True,
+            ))
+            srv.run_until_idle(max_ticks=200)
+            cont = srv.resubmit(parent, 8.0)
+            if crash:
+                srv.tick()
+                srv._streamer.drain()
+                del srv
+                return parent, cont
+            srv.run_until_idle(max_ticks=200)
+            srv.close()
+            return parent, cont
+
+        ref_out = tmp_path / "ref"
+        chain(ref_out, tmp_path / "ref_wal", crash=False)
+        ref = _lens_bytes(ref_out)
+
+        out, wal = tmp_path / "cr", tmp_path / "cr_wal"
+        parent, cont = chain(out, wal, crash=True)
+        srv2 = _mk(out, wal, composite="hybrid_cell",
+                   window=4, capacity=8)
+        assert srv2.status(parent)["status"] == DONE
+        assert srv2.metrics()["counters"]["recovered"] == 1
+        srv2.run_until_idle(max_ticks=300)
+        assert srv2.status(cont)["status"] == DONE
+        assert _lens_bytes(out) == ref
+        # held snapshot was re-pinned from its spill: still extendable
+        again = srv2.resubmit(parent, 4.0)
+        srv2.run_until_idle(max_ticks=200)
+        assert srv2.status(again)["status"] == DONE
+        srv2.close()
+
+    def test_released_hold_is_replayed_as_released(self, tmp_path):
+        srv = _mk(tmp_path / "out", tmp_path / "wal")
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.release_state(rid)
+        srv.close()
+        srv2 = _mk(tmp_path / "out", tmp_path / "wal")
+        with pytest.raises(ValueError, match="no final state"):
+            srv2.resubmit(rid, 8.0)  # the release survived the restart
+        assert srv2.snapshots.refs_total() == 0
+        srv2.close()
+
+
+def _run_cli(args, cwd, expect_kill=False, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "lens_tpu", "serve", *args],
+        cwd=cwd, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    else:
+        assert proc.returncode == 0, (
+            f"rc={proc.returncode}\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}"
+        )
+    return proc
+
+
+_CLI_REQS = [
+    {"seed": 1, "horizon": 24.0, "hold_state": True},
+    {"seed": 2, "horizon": 24.0, "prefix": {"horizon": 8.0},
+     "overrides": {"global": {"volume": 1.1}}},
+    {"seed": 3, "horizon": 16.0},
+]
+
+
+def _kill_point_roundtrip(tmp_path, repo_root, seam, composite,
+                          extra_flags=()):
+    """SIGKILL a real serve process at ``seam``, recover over the same
+    dir, and return (reference bytes, recovered bytes)."""
+    reqs = tmp_path / "reqs.json"
+    reqs.write_text(json.dumps(_CLI_REQS))
+    base = [
+        "--composite", composite, "--capacity", "8", "--lanes", "2",
+        "--window", "4", "--requests", str(reqs), *extra_flags,
+    ]
+    tag = seam.replace(".", "_")
+    ref_out = tmp_path / f"ref_{tag}"
+    _run_cli(
+        base + ["--out-dir", str(ref_out),
+                "--recover-dir", str(tmp_path / f"ref_wal_{tag}")],
+        repo_root,
+    )
+    out = tmp_path / f"out_{tag}"
+    wal = tmp_path / f"wal_{tag}"
+    faults = tmp_path / f"faults_{tag}.json"
+    faults.write_text(json.dumps([{"kind": "kill", "at": seam}]))
+    _run_cli(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal),
+                "--faults", str(faults)],
+        repo_root, expect_kill=True,
+    )
+    _run_cli(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal)],
+        repo_root,
+    )
+    return _lens_bytes(ref_out), _lens_bytes(out)
+
+
+@pytest.fixture(scope="module")
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestKillPoints:
+    """A real SIGKILL through the CLI, recovered over the same dir —
+    the quick-tier representative; the slow tier sweeps EVERY seam."""
+
+    def test_kill_at_window_dispatch_recovers_bitwise(
+        self, tmp_path, repo_root
+    ):
+        ref, got = _kill_point_roundtrip(
+            tmp_path, repo_root, "window.dispatched", "toggle_colony"
+        )
+        assert set(ref) <= set(got)  # recovery may add later requests
+        for name, data in ref.items():
+            assert got[name] == data, f"{name} differs after recovery"
+
+
+@pytest.mark.slow
+class TestKillPointsExhaustive:
+    """SIGKILL at EVERY named kill-point, stochastic composite,
+    pipeline on, check_finite armed — the full ISSUE-10 chaos pin."""
+
+    @pytest.mark.parametrize(
+        "seam",
+        # resubmit.walled needs a resubmit-driving client (covered
+        # in-process above); the CLI list exercises the rest
+        [s for s in KILL_SEAMS if s != "resubmit.walled"],
+    )
+    def test_kill_everywhere_recovers_bitwise(
+        self, tmp_path, repo_root, seam
+    ):
+        ref, got = _kill_point_roundtrip(
+            tmp_path, repo_root, seam, "hybrid_cell",
+            extra_flags=("--check-finite", "window"),
+        )
+        assert ref, "reference run produced no logs?"
+        for name, data in ref.items():
+            assert got[name] == data, f"{name} differs after {seam}"
+
+
+class TestJsonFrameLogShared:
+    """The framing layer the ledger AND the WAL ride (emit/log.py)."""
+
+    def test_group_commit_policy_defers_fsync_not_write(self, tmp_path):
+        path = str(tmp_path / "ev.log")
+        log = JsonFrameLog(path, fsync_every=False)
+        log.append({"a": 1})
+        # flushed to the OS even before sync(): a reader sees it now
+        assert len(JsonFrameLog(str(tmp_path / "ev.log")).events) == 1
+        log.sync()
+        log.close()
+
+    def test_undecodable_complete_frame_raises(self, tmp_path):
+        from lens_tpu.emit.log import frame
+
+        path = str(tmp_path / "bad.log")
+        with open(path, "wb") as f:
+            f.write(frame(b"\xff\xfenot json"))
+        with pytest.raises(ValueError, match="not an event log"):
+            JsonFrameLog(path)

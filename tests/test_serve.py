@@ -431,13 +431,47 @@ class TestBackpressureAndLifecycle:
             )
         srv.close()
 
-    def test_bad_overrides_fail_request_not_server(self):
+    def test_unknown_override_path_rejected_at_submit(self):
+        """Round 12: unknown override paths fail EAGERLY at submit with
+        a descriptive error (the round-8 behavior — a FAILED ticket
+        from deep inside the admission build — made the typo invisible
+        until the request was already queued)."""
+        srv = _toggle_server()
+        with pytest.raises(ValueError, match="not_a_variable"):
+            srv.submit(
+                ScenarioRequest(
+                    composite="toggle_colony",
+                    horizon=8.0,
+                    overrides={"global": {"not_a_variable": 1.0}},
+                )
+            )
+        # same eager check guards the prefix block's shared overrides
+        with pytest.raises(ValueError, match="prefix override"):
+            srv.submit(
+                ScenarioRequest(
+                    composite="toggle_colony",
+                    horizon=16.0,
+                    prefix={
+                        "horizon": 8.0,
+                        "overrides": {"global": {"nope": 1.0}},
+                    },
+                )
+            )
+        srv.close()
+
+    def test_bad_override_shape_fails_request_not_server(self):
+        """Value SHAPES still validate at admission (they need the
+        built state): a wrong per-agent leading dim fails only the one
+        request, and the server keeps serving."""
+        import numpy as np
+
         srv = _toggle_server()
         bad = srv.submit(
             ScenarioRequest(
                 composite="toggle_colony",
                 horizon=8.0,
-                overrides={"global": {"not_a_variable": 1.0}},
+                # capacity is 16; a 3-row per-agent override cannot fit
+                overrides={"global": {"volume": np.ones(3)}},
             )
         )
         ok = srv.submit(
@@ -445,7 +479,7 @@ class TestBackpressureAndLifecycle:
         )
         srv.run_until_idle(max_ticks=50)
         assert srv.status(bad)["status"] == "failed"
-        assert "not_a_variable" in srv.status(bad)["error"]
+        assert "leading dim" in srv.status(bad)["error"]
         assert srv.status(ok)["status"] == DONE
         srv.close()
 

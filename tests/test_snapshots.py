@@ -497,13 +497,18 @@ class TestPrefixValidationAndFailure:
         srv.close()
 
     def test_failed_prefix_run_fails_every_coalesced_fork(self):
+        """Admission-time prefix failure (a value SHAPE error — path
+        typos are rejected eagerly at submit since round 12) fails
+        every coalesced waiter with the cause."""
         srv = _toggle_server()
         rids = [
             srv.submit(ScenarioRequest(
                 composite="toggle_colony", seed=0, horizon=16.0,
                 prefix={
                     "horizon": 8.0,
-                    "overrides": {"global": {"not_a_variable": 1.0}},
+                    # capacity is 16: a 3-row per-agent override fails
+                    # the prefix run's admission build
+                    "overrides": {"global": {"volume": np.ones(3)}},
                 },
             ))
             for _ in range(2)
@@ -515,7 +520,7 @@ class TestPrefixValidationAndFailure:
         for rid in rids:
             st = srv.status(rid)
             assert st["status"] == "failed"
-            assert "not_a_variable" in st["error"]
+            assert "leading dim" in st["error"]
         assert srv.status(ok)["status"] == DONE  # pool unharmed
         srv.close()
 
@@ -524,11 +529,11 @@ class TestPrefixValidationAndFailure:
         bad = srv.submit(ScenarioRequest(
             composite="toggle_colony", seed=2, horizon=16.0,
             prefix={"horizon": 8.0},
-            overrides={"global": {"not_a_variable": 1.0}},
+            overrides={"global": {"volume": np.ones(3)}},
         ))
         srv.run_until_idle(max_ticks=100)
         assert srv.status(bad)["status"] == "failed"
-        assert "not_a_variable" in srv.status(bad)["error"]
+        assert "leading dim" in srv.status(bad)["error"]
         # the prefix snapshot itself was computed and cached: a good
         # fork of the same prefix now hits
         good = srv.submit(ScenarioRequest(
@@ -542,6 +547,30 @@ class TestPrefixValidationAndFailure:
         assert c["prefix_hits"] == 1 and c["prefix_misses"] == 1
         assert srv.snapshots.refs_total() == 0
         srv.close()
+
+    def test_close_mid_prefix_fails_waiters_with_cause(self):
+        """``close()`` during an in-flight coalesced prefix run: every
+        waiting fork fails FAST with a clear cause (not left QUEUED
+        forever, reading as pending to a client holding its id), and
+        the snapshot store ends at zero refs — close() itself raises
+        on any refcount imbalance, so a clean close IS the leak pin."""
+        srv = _toggle_server(lanes=1)  # the prefix occupies the lane
+        forks = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=5, horizon=64.0,
+                prefix={"horizon": 32.0},
+            ))
+            for _ in range(2)
+        ]
+        srv.tick()  # internal prefix run admitted; forks still waiting
+        srv.close()  # raises on pin imbalance; must not here
+        for rid in forks:
+            st = srv.status(rid)
+            assert st["status"] == "failed"
+            assert "closed while the shared prefix" in st["error"]
+            with pytest.raises(ValueError, match="never admitted"):
+                srv.result(rid)
+        assert srv.snapshots.refs_total() == 0
 
     def test_cancelled_waiting_fork_leaves_the_rest_healthy(self):
         """Cancel a fork while it waits on an in-flight prefix: it
