@@ -41,7 +41,27 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
+
+if "--mesh" in sys.argv and "xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # the mesh bench simulates devices on CPU; the flag must land
+    # before jax initializes, so it cannot live behind argparse.
+    # Consume digits only up to the first non-digit token — anything
+    # after that belongs to OTHER flags (--window 128 must not force
+    # 128 simulated devices)
+    _sizes = []
+    for _a in sys.argv[sys.argv.index("--mesh") + 1:]:
+        if not _a.isdigit():
+            break
+        _sizes.append(int(_a))
+    _n = max(_sizes or [8])
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_n}"
+    ).strip()
 
 import jax
 import numpy as np
@@ -609,6 +629,224 @@ def run_prefix_bench(args) -> int:
     return 0
 
 
+def _flat_bytes(tree):
+    """A result tree as {joined-path: bytes} for bitwise pins."""
+    from lens_tpu.utils.dicts import flatten_paths
+
+    return {
+        "/".join(map(str, path)): np.asarray(value).tobytes()
+        for path, value in flatten_paths(tree)
+    }
+
+
+def _solo_reference(composite, capacity, window, emit_every, seeds,
+                    horizon_steps):
+    """One request at a time on a single-device 1-lane server — the
+    bitwise oracle the mesh rows pin against."""
+    srv = SimServer.single_bucket(
+        composite, capacity=capacity, lanes=1, window=window,
+        emit_every=emit_every,
+    )
+    out = {}
+    for seed in seeds:
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=seed,
+            horizon=float(horizon_steps),
+        ))
+        srv.run_until_idle(max_ticks=10_000)
+        out[seed] = _flat_bytes(srv.result(rid))
+    srv.close()
+    return out
+
+
+def run_mesh_bench(args) -> int:
+    """Round-13 mesh-serving scaling + failover drill: served
+    agent-steps/s at N simulated devices (one lane pool per device,
+    one host scheduler), each size pinned per shard against the
+    single-device solo oracle, plus a kill-one-device chaos round per
+    size (FaultPlan ``device_down`` mid-load; every request must
+    still complete, bitwise equal to the no-fault oracle)."""
+    from lens_tpu.serve.faults import FaultPlan
+
+    sizes = [
+        n for n in args.mesh if n <= jax.device_count()
+    ]
+    if sizes != list(args.mesh):
+        print(
+            f"note: only {jax.device_count()} devices attached; "
+            f"running sizes {sizes}"
+        )
+    if not sizes:
+        raise SystemExit(
+            f"no requested mesh size fits the {jax.device_count()} "
+            f"attached device(s); on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count=N (or let the "
+            f"bare --mesh flag default it)"
+        )
+    lanes = args.lanes[0] if args.lanes else 2  # lanes PER SHARD
+    horizon_steps = args.horizon_windows * args.window
+    pin_seeds = (3, 5, 7)
+    oracle = _solo_reference(
+        args.composite, args.capacity, args.window, args.emit_every,
+        pin_seeds, horizon_steps,
+    )
+    record = {
+        "bench": "serve-mesh",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "lanes_per_shard": lanes,
+        "mesh": [],
+        "failover": [],
+    }
+
+    for n_dev in sizes:
+        srv = SimServer.single_bucket(
+            args.composite, capacity=args.capacity, lanes=lanes,
+            window=args.window, emit_every=args.emit_every,
+            queue_depth=max(4 * n_dev * lanes, 64), mesh=n_dev,
+        )
+        _warm(srv, args.composite, n_dev * lanes, args.window)
+        n = args.fill_rounds * n_dev * lanes
+        wall = float("inf")
+        for rep in range(args.reps):
+            wall = min(wall, _serve_round(
+                srv, args.composite, n, horizon_steps,
+                seed0=1000 + rep * n,
+            ))
+        # per-shard solo==co-batched pin: the pin seeds ride one more
+        # co-batched round (they spread across shards) and must match
+        # the single-device solo oracle byte for byte
+        rids = {
+            seed: srv.submit(ScenarioRequest(
+                composite=args.composite, seed=seed,
+                horizon=float(horizon_steps),
+            ))
+            for seed in pin_seeds
+        }
+        filler = [
+            srv.submit(ScenarioRequest(
+                composite=args.composite, seed=9000 + i,
+                horizon=float(horizon_steps),
+            ))
+            for i in range(n_dev * lanes - len(pin_seeds))
+        ]
+        srv.run_until_idle(max_ticks=100_000)
+        pin_shards = sorted(
+            {srv.tickets[r].shard for r in rids.values()}
+        )
+        pins_green = all(
+            _flat_bytes(srv.result(rid)) == oracle[seed]
+            for seed, rid in rids.items()
+        ) and all(
+            srv.status(r)["status"] == "done" for r in filler
+        )
+        snap = srv.metrics()
+        row = {
+            "mesh": n_dev,
+            "lanes_total": n_dev * lanes,
+            "requests": n,
+            "served_row_steps_s": round(
+                n * horizon_steps * args.capacity / wall
+            ),
+            "served_req_s": round(n / wall, 2),
+            "occupancy": snap["occupancy"],
+            "retraces": snap["retraces"],
+            "pins_green": bool(pins_green),
+            "pin_shards": pin_shards,
+            "shards": snap["shards"],
+        }
+        record["mesh"].append(row)
+        print(json.dumps(
+            {k: row[k] for k in row if k != "shards"}
+        ), flush=True)
+        srv.close()
+
+        # kill-one-device drill at this size: down shard 1 after its
+        # second window, mid-load; every request must still complete
+        # with oracle-equal bytes. A 1-device mesh has no survivor to
+        # fail over to — downing its only shard correctly fails every
+        # request, so the drill is meaningless there and skipped.
+        if n_dev < 2:
+            continue
+        victim = 1
+        drill = SimServer.single_bucket(
+            args.composite, capacity=args.capacity, lanes=lanes,
+            window=args.window, emit_every=args.emit_every,
+            queue_depth=max(4 * n_dev * lanes, 64), mesh=n_dev,
+            faults=FaultPlan([{
+                "kind": "device_down", "shard": victim,
+                "occurrence": 2,
+            }]),
+        )
+        _warm(drill, args.composite, n_dev * lanes, args.window)
+        t0 = time.perf_counter()
+        drill_ids = {
+            seed: drill.submit(ScenarioRequest(
+                composite=args.composite, seed=seed,
+                horizon=float(horizon_steps),
+            ))
+            for seed in pin_seeds
+        }
+        drill_ids.update({
+            9100 + i: drill.submit(ScenarioRequest(
+                composite=args.composite, seed=9100 + i,
+                horizon=float(horizon_steps),
+            ))
+            for i in range(2 * n_dev * lanes - len(pin_seeds))
+        })
+        drill.run_until_idle(max_ticks=100_000)
+        drill_wall = time.perf_counter() - t0
+        dsnap = drill.metrics()
+        all_done = all(
+            drill.status(r)["status"] == "done"
+            for r in drill_ids.values()
+        )
+        drill_pins = all(
+            _flat_bytes(drill.result(drill_ids[seed])) == oracle[seed]
+            for seed in pin_seeds
+        )
+        frow = {
+            "mesh": n_dev,
+            "victim_shard": victim,
+            "requests": len(drill_ids),
+            "wall_s": round(drill_wall, 3),
+            "all_done": bool(all_done),
+            "pins_green": bool(drill_pins),
+            "requeued": dsnap["counters"]["requeued"],
+            "quarantined_devices": dsnap["quarantined_devices"],
+        }
+        record["failover"].append(frow)
+        print(json.dumps(frow), flush=True)
+        drill.close()
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    base = record["mesh"][0]
+    for row in record["mesh"]:
+        scale = (
+            row["served_row_steps_s"] / base["served_row_steps_s"]
+            * base["mesh"] / row["mesh"]
+        )
+        print(
+            f"mesh {row['mesh']}: {row['served_row_steps_s']} "
+            f"row-steps/s (per-device efficiency vs {base['mesh']}-dev "
+            f"baseline {scale:.2f}) pins_green={row['pins_green']}"
+        )
+    ok = all(
+        r["pins_green"] for r in record["mesh"]
+    ) and all(
+        r["all_done"] and r["pins_green"] for r in record["failover"]
+    )
+    print(f"all pins green: {ok}")
+    return 0 if ok else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--composite", default="toggle_colony")
@@ -648,6 +886,16 @@ def main() -> int:
         "BENCH_FAULTS_CPU_r12.json unless --out is given)",
     )
     p.add_argument(
+        "--mesh", type=int, nargs="*", default=None,
+        help="run the round-13 mesh-serving scaling bench at these "
+        "simulated device counts (bare flag: 2 4 8; forces "
+        "xla_force_host_platform_device_count on CPU). Per size: "
+        "served agent-steps/s, per-shard gauges, per-shard "
+        "solo==co-batched bitwise pins, and a kill-one-device "
+        "failover drill. Writes BENCH_MESH_CPU_r13.json unless "
+        "--out is given; --lanes sets lanes PER SHARD (default 2)",
+    )
+    p.add_argument(
         "--prefix-frac", type=float, default=0.75,
         help="shared-prefix fraction of the horizon (fork A/B), "
         "snapped to whole windows",
@@ -665,8 +913,18 @@ def main() -> int:
     args = p.parse_args()
 
     # per-mode defaults (None = not explicitly passed)
-    if args.prefix and args.faults:
-        raise SystemExit("--prefix and --faults are separate modes")
+    if sum(
+        1 for m in (args.prefix, args.faults, args.mesh is not None)
+        if m
+    ) > 1:
+        raise SystemExit(
+            "--prefix / --faults / --mesh are separate modes"
+        )
+    if args.mesh is not None:
+        args.mesh = args.mesh or [2, 4, 8]
+        args.out = args.out or "BENCH_MESH_CPU_r13.json"
+        args.horizon_windows = args.horizon_windows or 6
+        return run_mesh_bench(args)
     if args.faults:
         args.out = args.out or "BENCH_FAULTS_CPU_r12.json"
         args.lanes = args.lanes or [2, 4, 8]

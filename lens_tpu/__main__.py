@@ -249,6 +249,23 @@ def _build_parser() -> argparse.ArgumentParser:
         "already recorded",
     )
     serve.add_argument(
+        "--mesh", type=int, default=None, metavar="N",
+        help="shard the server across N devices (one resident lane "
+        "pool per device, a host scheduler ticking all shards; a "
+        "dead device quarantines and its requests fail over to the "
+        "survivors — docs/serving.md, 'Mesh serving & device "
+        "failover'). On CPU, simulate devices with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N. "
+        "Default: single default-device serving",
+    )
+    serve.add_argument(
+        "--device-watchdog", type=float, default=None,
+        metavar="SECONDS",
+        help="quarantine a device whose dispatched window makes no "
+        "progress for this many seconds (whole-device fail-stop "
+        "detection; requests re-queue onto surviving devices)",
+    )
+    serve.add_argument(
         "--faults", default=None, metavar="JSON",
         help="fault-injection plan (a JSON file, or '-' for stdin): "
         '{"seed": 0, "faults": [{"kind": "nan", "request": '
@@ -447,6 +464,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         watchdog_s=args.watchdog,
         recover_dir=args.recover_dir,
         faults=faults,
+        mesh=args.mesh,
+        device_watchdog_s=args.device_watchdog,
     )
     with server:
         if server.recovered or any(
@@ -533,6 +552,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             print(
                 f"fault tolerance: diverged={c['diverged']} "
                 f"recovered={c['recovered']}"
+            )
+        if args.mesh is not None and args.mesh > 1:
+            rows = " ".join(
+                f"shard{s['shard']}"
+                f"{'[QUARANTINED]' if s['quarantined'] else ''}="
+                f"{s['windows']}w"
+                for s in snap["shards"]
+            )
+            print(
+                f"mesh {args.mesh}: {rows} "
+                f"quarantined={snap['quarantined_devices']} "
+                f"requeued={c['requeued']}"
             )
         print(f"results: {args.out_dir}/<request-id>.lens")
         print(f"meta:    {args.out_dir}/server_meta.json")

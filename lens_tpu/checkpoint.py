@@ -114,10 +114,21 @@ def save_tree(path: str, state: Any) -> str:
     return path
 
 
-def restore_tree(path: str) -> Any:
-    """Inverse of :func:`save_tree` (typed states rebuilt)."""
+def restore_tree(path: str, device: Any = None) -> Any:
+    """Inverse of :func:`save_tree` (typed states rebuilt).
+
+    ``device`` (a ``jax.Device`` or any ``jax.sharding.Sharding``)
+    re-pins the restored leaves there instead of the default
+    placement. The mesh-serving failover client: a spill captured on a
+    device that has since been quarantined must rehydrate onto a
+    SURVIVING device — the original layout no longer exists — and the
+    bytes are placement-independent, so the restored state is the
+    spilled state wherever it lands."""
     plain = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
-    return _from_plain(jax.tree.map(jax.numpy.asarray, plain))
+    plain = jax.tree.map(jax.numpy.asarray, plain)
+    if device is not None:
+        plain = jax.device_put(plain, device)
+    return _from_plain(plain)
 
 
 class Checkpointer:

@@ -58,6 +58,41 @@ def make_mesh(
     )
 
 
+def serve_devices(mesh=None) -> list:
+    """Resolve a serving-mesh spec into the ordered per-shard device
+    list ``SimServer`` places bucket lane pools on (one ``LanePool``
+    per entry — the serving failure domain is one device).
+
+    ``None`` -> ``[None]``: a single uncommitted pool on the default
+    device, the pre-mesh behavior bit for bit. ``int n`` -> the first
+    ``n`` of ``jax.devices()``. A :class:`jax.sharding.Mesh` -> its
+    devices in flat order (the serve layer packs independent lanes, so
+    only the device LIST matters — axis structure is the SPMD
+    runners' concern). Any other sequence -> taken as an explicit
+    device list.
+    """
+    if mesh is None:
+        return [None]
+    if isinstance(mesh, Mesh):
+        return list(np.asarray(mesh.devices).flat)
+    if isinstance(mesh, (int, np.integer)):
+        n = int(mesh)
+        if n < 1:
+            raise ValueError(f"mesh={n} must be >= 1 devices")
+        devices = jax.devices()
+        if n > len(devices):
+            raise ValueError(
+                f"mesh={n} devices requested but only {len(devices)} "
+                f"are attached (on CPU, simulate more with "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=N)"
+            )
+        return devices[:n]
+    devices = list(mesh)
+    if not devices:
+        raise ValueError("mesh device list is empty")
+    return devices
+
+
 def colony_pspecs(colony_state) -> "jax.tree_util.PyTreeDef":
     """PartitionSpecs for a ColonyState: agent leaves split on the agent
     axis, PRNG key and step counter replicated."""

@@ -155,7 +155,13 @@ class Ticket:
     error: Optional[str] = None
     horizon_steps: int = 0
     steps_done: int = 0
+    # steps already accounted for BEFORE this ticket ever runs (the
+    # shared prefix's steps, or the parent chain's for a resubmit
+    # continuation) — what steps_done/emit_count reset to when a
+    # device quarantine re-queues the ticket for a clean re-run
+    steps_base: int = 0
     lane: Optional[int] = None
+    shard: Optional[int] = None  # device shard the lane lives on
     submitted_at: float = field(default_factory=time.perf_counter)
     admitted_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -179,6 +185,7 @@ class Ticket:
     # server-generated prefix ticket (no client, no sink, no result).
     # parent: the request id this ticket continues, for provenance.
     carry_state: Any = None
+    carry_shard: Optional[int] = None  # shard holding carry_state
     carry_key: Any = None
     prefix_key: Any = None
     content_key: Any = None

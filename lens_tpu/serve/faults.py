@@ -28,6 +28,12 @@ Fault kinds and their seams:
 - ``stall`` (seam ``stream.window``): sleep ``seconds`` inside the
   stream thread's window processing — exercises backpressure and the
   scheduler watchdog.
+- ``device_down`` (seam ``shard.window``): declare a whole device
+  dead at the N-th window dispatched on it (optionally filtered to
+  one ``shard``) — the mesh server quarantines the device, drains it
+  from scheduling, and re-queues its requests onto survivors
+  (docs/serving.md, "Mesh serving & device failover"); the
+  kill-one-device drill injector.
 - ``kill`` (any seam in :data:`KILL_SEAMS`): ``SIGKILL`` the process
   at a named scheduler/WAL seam — the crash-recovery pins
   (tests/test_recovery.py) SIGKILL at every one of these and require
@@ -65,10 +71,12 @@ _KIND_SEAMS = {
     "nan": "lane.state",
     "io_error": "sink.append",
     "stall": "stream.window",
+    "device_down": "shard.window",
 }
 
 _FAULT_KEYS = {
-    "kind", "at", "request", "after_steps", "occurrence", "seconds", "p",
+    "kind", "at", "request", "after_steps", "occurrence", "seconds",
+    "p", "shard",
 }
 
 
@@ -87,6 +95,7 @@ class Fault:
     occurrence: int = 1
     seconds: float = 0.0
     p: Optional[float] = None
+    shard: Optional[int] = None  # device_down: which device (None=any)
     _count: int = field(default=0, repr=False)
     _done: bool = field(default=False, repr=False)
 
@@ -152,6 +161,23 @@ class FaultPlan:
             p = f.get("p")
             if p is not None and not 0.0 < float(p) <= 1.0:
                 raise ValueError(f"fault {i}: p={p} must be in (0, 1]")
+            shard = f.get("shard")
+            if shard is not None:
+                if kind != "device_down":
+                    raise ValueError(
+                        f"fault {i}: 'shard' only applies to "
+                        f"device_down faults (kind {kind!r} has no "
+                        f"device context)"
+                    )
+                if int(shard) < 0:
+                    raise ValueError(
+                        f"fault {i}: shard={shard} must be >= 0"
+                    )
+            if kind == "device_down" and f.get("request") is not None:
+                raise ValueError(
+                    f"fault {i}: device_down faults target a device, "
+                    f"not a request (use 'shard'/'occurrence')"
+                )
             self.faults.append(Fault(
                 kind=str(kind),
                 at=str(at),
@@ -160,6 +186,7 @@ class FaultPlan:
                 occurrence=int(f.get("occurrence", 1)),
                 seconds=float(f.get("seconds", 0.0)),
                 p=None if p is None else float(p),
+                shard=None if shard is None else int(shard),
             ))
 
     @classmethod
@@ -192,11 +219,13 @@ class FaultPlan:
         seam: str,
         request_id: Optional[str] = None,
         steps: Optional[int] = None,
+        shard: Optional[int] = None,
     ) -> List[Fault]:
         """Faults firing NOW at ``seam`` for this context. Occurrence
-        counters advance on every MATCH (seam + request + after_steps),
-        fired-or-not, so a plan's N-th-occurrence semantics are a pure
-        function of the call sequence — deterministic and replayable."""
+        counters advance on every MATCH (seam + request + after_steps
+        + shard), fired-or-not, so a plan's N-th-occurrence semantics
+        are a pure function of the call sequence — deterministic and
+        replayable."""
         if not self.faults:
             return []
         out: List[Fault] = []
@@ -205,6 +234,8 @@ class FaultPlan:
                 if f._done or f.at != seam:
                     continue
                 if f.request is not None and request_id != f.request:
+                    continue
+                if f.shard is not None and shard != f.shard:
                     continue
                 if f.after_steps and (
                     steps is None or steps < f.after_steps
@@ -247,3 +278,12 @@ class FaultPlan:
         count (the server then poisons the lane before the next window
         dispatch)."""
         return bool(self.fire("lane.state", request_id, steps))
+
+    def device_down(self, shard: int) -> bool:
+        """True when a device_down fault fires for this shard at this
+        window dispatch (the server then quarantines the whole device
+        — drains it from scheduling and fails its work over to the
+        surviving shards). The seam fires once per window-dispatch
+        attempt per shard, so ``occurrence`` counts that shard's
+        windows."""
+        return bool(self.fire("shard.window", shard=shard))

@@ -106,6 +106,16 @@ class LanePool:
     emit_every:
         Steps between emitted slices inside the window;
         ``window_steps`` must be a positive multiple.
+    device:
+        Pin this pool's resident state (and therefore every program
+        that consumes it — jit follows committed inputs) to ONE
+        device: the mesh-serving placement primitive, one pool per
+        shard. Everything entering the pool from elsewhere — a freshly
+        built solo state, a snapshot captured on another shard — is
+        ``device_put`` onto it at admission, so cross-device failover
+        is a transfer, never a tracing difference. ``None`` (default)
+        leaves placement to jax: the single-device behavior, bit for
+        bit.
     """
 
     def __init__(
@@ -115,6 +125,7 @@ class LanePool:
         window_steps: int = 32,
         timestep: float = 1.0,
         emit_every: int = 1,
+        device: Any = None,
     ):
         if n_lanes < 1:
             raise ValueError(f"n_lanes={n_lanes} must be >= 1")
@@ -130,6 +141,7 @@ class LanePool:
         self.window_steps = int(window_steps)
         self.timestep = float(timestep)
         self.emit_every = int(emit_every)
+        self.device = device
         self.emits_per_window = self.window_steps // self.emit_every
 
         # Idle-lane filler: an empty (0 alive) solo state broadcast to
@@ -146,6 +158,12 @@ class LanePool:
             template,
         )
         self.remaining = jnp.zeros(self.n_lanes, jnp.int32)
+        if device is not None:
+            # committed inputs route every jitted program below to this
+            # device; uncommitted scalars (lane index, step counts)
+            # follow the committed operands
+            self.states = jax.device_put(self.states, device)
+            self.remaining = jax.device_put(self.remaining, device)
         # Host mirror of ``remaining``: admission/retire arithmetic is
         # fully host-predictable (arm H, subtract min(window, left) per
         # window), so the scheduler never reads the device counter —
@@ -418,6 +436,10 @@ class LanePool:
             )
         n_agents = self.default_agents(n_agents)
         solo = self._build_solo(n_agents, seed, overrides)
+        if self.device is not None:
+            # the jitted solo build lands uncommitted (default device);
+            # a committed pool must not mix devices inside one program
+            solo = jax.device_put(solo, self.device)
         self.states, self.remaining = self._admit(
             self.states,
             self.remaining,
@@ -452,6 +474,12 @@ class LanePool:
             raise IndexError(f"lane {lane} not in [0, {self.n_lanes})")
         if steps < 1:
             raise ValueError(f"steps={steps} must be >= 1")
+        if self.device is not None:
+            # a snapshot may live on another shard's device (prefix
+            # forks after failover, rehydrated spills): migrating it is
+            # one transfer, and the bits are the bits — device_put is
+            # a byte copy, so the determinism contract rides along
+            state = jax.device_put(state, self.device)
         if overrides:
             self._fork_admit(lane, state, steps, overrides)
             return

@@ -80,6 +80,13 @@ class ServerMetrics:
       quarantined (each also counts under ``failed``); ``recovered`` —
       unfinished requests re-admitted from the WAL at
       ``recover_dir`` startup.
+    - mesh failover (round 13, docs/serving.md "Mesh serving & device
+      failover"): ``requeued`` — client requests displaced from a
+      quarantined DEVICE and re-queued onto surviving shards under
+      their original ids. The per-shard view lives in the ``shards``
+      gauge list (occupancy, windows, diverged, snapshot bytes,
+      quarantined flag per device) plus the ``quarantined_devices``
+      count — both refreshed by the server alongside queue depth.
     """
 
     _COUNTERS = (
@@ -102,6 +109,7 @@ class ServerMetrics:
         "snapshot_evictions",
         "diverged",
         "recovered",
+        "requeued",
     )
 
     def __init__(self) -> None:
@@ -114,6 +122,11 @@ class ServerMetrics:
         # queue depth / busy lanes)
         self.snapshots_resident = 0
         self.snapshot_bytes = 0
+        # mesh gauges: one dict per device shard (index, device,
+        # quarantined, lanes, occupancy, windows, diverged,
+        # snapshot_bytes) + the quarantined-device count
+        self.shards: List[Dict[str, Any]] = []
+        self.quarantined_devices = 0
         self._t0 = time.perf_counter()
         # per finished request: wall seconds submit->admit and submit->done
         self.wait_seconds: List[float] = []
@@ -212,6 +225,8 @@ class ServerMetrics:
             "retraces": self.retraces,
             "snapshots_resident": self.snapshots_resident,
             "snapshot_bytes": self.snapshot_bytes,
+            "shards": [dict(s) for s in self.shards],
+            "quarantined_devices": self.quarantined_devices,
             "uptime_seconds": time.perf_counter() - self._t0,
             "avg_window_seconds": (
                 self.avg_window_seconds() if self.window_seconds else None

@@ -104,9 +104,11 @@ from lens_tpu.serve.streamer import (
     process_window,
     subsample_rows,
 )
+from lens_tpu.parallel.mesh import serve_devices
 from lens_tpu.serve.wal import (
     BEGIN,
     HOLD,
+    QUARANTINE,
     RELEASE,
     RESUBMIT,
     RETIRE,
@@ -244,19 +246,61 @@ class _LogResult:
         return self.path
 
 
-class _Bucket:
-    """One resident program + its lane assignments."""
+class _Shard:
+    """One device's slice of a bucket: a resident :class:`LanePool`
+    committed to that device plus the per-device scheduler
+    bookkeeping. The mesh server's failure domain — quarantine flips
+    ``quarantined`` and everything here is written off together."""
 
-    def __init__(self, name: str, cfg: Dict[str, Any]):
-        from lens_tpu.experiment import build_model
-        from lens_tpu.utils.dicts import deep_merge
-
-        self.name = name
+    def __init__(self, index: int, device: Any, pool: LanePool):
+        self.index = index
+        self.device = device
+        self.pool = pool
+        self.assignments: Dict[int, Ticket] = {}
         # quarantine bookkeeping (check_finite="window"): the previous
         # window's device finite flags plus the {lane: (ticket,
         # step-after-window)} map frozen at dispatch — consumed at the
         # next tick's sweep
         self.pending_check = None
+        self.quarantined = False
+        # device watchdog arm: (dispatch wall time, THAT dispatch's
+        # output handle). The handle is captured per window — newer
+        # dispatches replace pool.remaining, so polling the pool's
+        # current array would time window k's deadline against window
+        # k+n's readiness and falsely quarantine a busy-but-healthy
+        # device; the captured array stays pollable forever. None =
+        # nothing being timed (watchdog off, or the last timed window
+        # completed).
+        self.watch: Optional[tuple] = None
+        # per-shard accumulators behind the shard gauges
+        self.windows = 0
+        self.lane_windows_busy = 0
+        self.lane_windows_total = 0
+        self.diverged = 0
+
+    def free_lanes(self) -> int:
+        if self.quarantined:
+            return 0
+        return self.pool.n_lanes - len(self.assignments)
+
+    def next_free_lane(self) -> int:
+        return next(
+            i for i in range(self.pool.n_lanes)
+            if i not in self.assignments
+        )
+
+
+class _Bucket:
+    """One composite's resident programs: a lane pool PER DEVICE SHARD
+    (all identically shaped — one logical bucket, N failure domains)."""
+
+    def __init__(
+        self, name: str, cfg: Dict[str, Any], devices: List[Any]
+    ):
+        from lens_tpu.experiment import build_model
+        from lens_tpu.utils.dicts import deep_merge
+
+        self.name = name
         self.cfg = cfg = deep_merge(BUCKET_DEFAULTS, cfg or {})
         composite = cfg["composite"] or name
         built = build_model(
@@ -266,25 +310,62 @@ class _Bucket:
             n_agents=cfg["n_agents"],
             division=cfg["division"],
         )
-        self.pool = LanePool(
-            built.sim,
-            n_lanes=int(cfg["lanes"]),
-            window_steps=int(cfg["window"]),
-            timestep=float(cfg["timestep"]),
-            emit_every=int(cfg["emit_every"]),
-        )
+        self.shards = [
+            _Shard(
+                k,
+                dev,
+                LanePool(
+                    built.sim,
+                    n_lanes=int(cfg["lanes"]),
+                    window_steps=int(cfg["window"]),
+                    timestep=float(cfg["timestep"]),
+                    emit_every=int(cfg["emit_every"]),
+                    device=dev,
+                ),
+            )
+            for k, dev in enumerate(devices)
+        ]
         # normalize the bucket's n_agents default to the sim form once
         # (an int fans out per species on multi-species buckets)
         cfg["n_agents"] = self.pool.default_agents(cfg["n_agents"])
-        self.assignments: Dict[int, Ticket] = {}
+
+    @property
+    def pool(self) -> LanePool:
+        """The bucket's shape/validation surface (identical across
+        shards — one bucket, one compiled shape family); shard 0's
+        pool by convention. Device work must go through a specific
+        shard's pool, never this."""
+        return self.shards[0].pool
+
+    def active_shards(self) -> List[_Shard]:
+        return [s for s in self.shards if not s.quarantined]
 
     def free_lanes(self) -> int:
-        return self.pool.n_lanes - len(self.assignments)
+        return sum(s.free_lanes() for s in self.shards)
 
-    def next_free_lane(self) -> int:
-        return next(
-            i for i in range(self.pool.n_lanes)
-            if i not in self.assignments
+    def lanes_total(self) -> int:
+        """Schedulable lanes (quarantined devices excluded — a
+        half-dead mesh must not advertise capacity it cannot run)."""
+        return sum(
+            s.pool.n_lanes for s in self.shards if not s.quarantined
+        )
+
+    def busy(self) -> int:
+        return sum(len(s.assignments) for s in self.shards)
+
+    def place(self, prefer: Optional[int] = None) -> _Shard:
+        """Choose the shard a ticket admits into: the preferred shard
+        (the one owning its cached snapshot — the scatter stays
+        device-local) when it has a free lane, else the active shard
+        with the most free lanes (deterministic tie-break: lowest
+        index). Callers guarantee at least one free lane exists."""
+        if prefer is not None and 0 <= prefer < len(self.shards):
+            s = self.shards[prefer]
+            if s.free_lanes() > 0:
+                return s
+        return max(
+            self.active_shards(),
+            key=lambda s: (s.free_lanes(), -s.index),
         )
 
 
@@ -363,8 +444,27 @@ class SimServer:
     faults:
         A :class:`~lens_tpu.serve.faults.FaultPlan` (tests/bench/CI
         chaos only): deterministic injection of NaN lanes, sink I/O
-        errors, stream stalls, and SIGKILL kill-points at the named
-        seams. ``None`` = no seams armed.
+        errors, stream stalls, device-down declarations, and SIGKILL
+        kill-points at the named seams. ``None`` = no seams armed.
+    mesh:
+        Shard the server across devices (docs/serving.md, "Mesh
+        serving & device failover"): each bucket holds one resident
+        lane pool PER DEVICE, admission scatter and ``hold_state``
+        capture stay device-local, and this one host scheduler ticks
+        all shards. Accepts a device count (the first N of
+        ``jax.devices()``), an explicit device list, or a
+        ``jax.sharding.Mesh`` (its devices in flat order). ``None``
+        (default): one uncommitted pool on the default device — the
+        single-device server, bit for bit. Per-request bits are
+        placement-independent (each lane is an independent scenario),
+        so results are bitwise identical at any mesh size.
+    device_watchdog_s:
+        Whole-device hang detection: a shard whose dispatched window
+        has not completed (output buffers still not ready) after this
+        many wall seconds is QUARANTINED — drained from scheduling,
+        its requests re-queued onto surviving devices (``None`` =
+        off). The fail-stop companion to ``FaultPlan`` ``device_down``
+        declarations and operator :meth:`quarantine_device` calls.
     """
 
     def __init__(
@@ -382,6 +482,8 @@ class SimServer:
         watchdog_s: Optional[float] = None,
         recover_dir: Optional[str] = None,
         faults: Optional[FaultPlan] = None,
+        mesh: Any = None,
+        device_watchdog_s: Optional[float] = None,
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -405,14 +507,22 @@ class SimServer:
                 "recover_dir needs sink='log': recovery can only hand "
                 "back results that live on disk"
             )
+        if device_watchdog_s is not None and device_watchdog_s <= 0:
+            raise ValueError(
+                f"device_watchdog_s={device_watchdog_s} must be > 0"
+            )
+        self.devices = serve_devices(mesh)
+        self.n_shards = len(self.devices)
+        self.device_watchdog_s = device_watchdog_s
+        self._quarantined: set = set()  # downed device shard indices
         self.buckets = {
-            name: _Bucket(name, dict(cfg or {}))
+            name: _Bucket(name, dict(cfg or {}), self.devices)
             for name, cfg in buckets.items()
         }
         self.queue = RequestQueue(queue_depth)
         self._metrics = ServerMetrics()
         self._metrics.lanes_total = sum(
-            b.pool.n_lanes for b in self.buckets.values()
+            b.lanes_total() for b in self.buckets.values()
         )
         self.out_dir = out_dir
         self.sink = sink
@@ -452,7 +562,10 @@ class SimServer:
         self.recovered = 0  # unfinished WAL requests re-queued
         if recover_dir:
             os.makedirs(recover_dir, exist_ok=True)
-            self._wal = ServeWal(os.path.join(recover_dir, WAL_NAME))
+            self._wal = ServeWal(
+                os.path.join(recover_dir, WAL_NAME),
+                n_shards=self.n_shards,
+            )
             had_events = self._wal.replayed()
             self._wal.begin(
                 buckets_fingerprint(
@@ -474,7 +587,7 @@ class SimServer:
             "queue_depth", "out_dir", "sink", "stream_flush",
             "flush_every", "pipeline", "stream_queue",
             "snapshot_budget_mb", "check_finite", "watchdog_s",
-            "recover_dir", "faults",
+            "recover_dir", "faults", "mesh", "device_watchdog_s",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -527,6 +640,13 @@ class SimServer:
                 f"no bucket serves composite {request.composite!r}; "
                 f"configured: {sorted(self.buckets)}"
             )
+        if not bucket.active_shards():
+            raise ValueError(
+                f"every device serving composite "
+                f"{request.composite!r} is quarantined "
+                f"({sorted(self._quarantined)}); the server has no "
+                f"schedulable capacity for this bucket"
+            )
         steps = self._horizon_steps(bucket, request.horizon)
         self._validate_request(bucket, request)
         prefix_steps, prefix_key = self._validate_prefix(
@@ -541,6 +661,7 @@ class SimServer:
             # the suffix rows land exactly where a solo full run's
             # would (times AND every-k subsample phase)
             steps_done=prefix_steps,
+            steps_base=prefix_steps,
             emit_count=prefix_steps // bucket.pool.emit_every,
             prefix_key=prefix_key,
             # the content address is only read when the final state is
@@ -719,13 +840,16 @@ class SimServer:
         self.tickets[warm_ticket.request_id] = warm_ticket
         self._pending_prefix[key] = [t]
 
-    def _resolve_waiters(self, key, state) -> None:
+    def _resolve_waiters(self, key, state, shard: int = 0) -> None:
         """A prefix run landed: hand its state to every still-queued
         coalesced fork (they scatter the same device tree — admission
-        copies it into each lane, the source is never donated)."""
+        copies it into each lane, the source is never donated).
+        ``shard`` records where the tree lives so each fork prefers
+        the owning device at admission."""
         for w in self._pending_prefix.pop(key, []):
             if w.status == QUEUED:
                 w.carry_state = state
+                w.carry_shard = shard
                 w.waiting = False
 
     @staticmethod
@@ -797,6 +921,7 @@ class SimServer:
             request=request,
             horizon_steps=total_steps,
             steps_done=parent.steps_done,
+            steps_base=parent.steps_done,
             emit_count=parent.emit_count,
             # a pure parent's continuation is pure at the longer
             # horizon: same address, step coordinate advanced
@@ -891,6 +1016,8 @@ class SimServer:
             "lanes_busy": self._metrics.lanes_busy,
             "lanes_total": self._metrics.lanes_total,
             "retraces": self._metrics.retraces,
+            "quarantined_devices": self._metrics.quarantined_devices,
+            "shards": [dict(s) for s in self._metrics.shards],
             "snapshots": {
                 "resident": self._metrics.snapshots_resident,
                 "resident_bytes": self._metrics.snapshot_bytes,
@@ -918,13 +1045,51 @@ class SimServer:
     def _refresh_gauges(self) -> None:
         self._metrics.queue_depth = len(self.queue)
         self._metrics.lanes_busy = sum(
-            len(b.assignments) for b in self.buckets.values()
+            b.busy() for b in self.buckets.values()
+        )
+        self._metrics.lanes_total = sum(
+            b.lanes_total() for b in self.buckets.values()
         )
         self._metrics.retraces = sum(
-            b.pool.retraces() for b in self.buckets.values()
+            s.pool.retraces()
+            for b in self.buckets.values()
+            for s in b.shards
         )
         self._metrics.snapshots_resident = len(self.snapshots)
         self._metrics.snapshot_bytes = self.snapshots.resident_bytes()
+        self._metrics.quarantined_devices = len(self._quarantined)
+        self._metrics.shards = self._shard_gauges()
+
+    def _shard_gauges(self) -> List[Dict[str, Any]]:
+        """One gauge dict per device shard (summed across buckets) —
+        the mesh observability surface in ``metrics()``/``status()``/
+        ``server_meta.json`` and the ``bench_serve --mesh`` columns."""
+        out: List[Dict[str, Any]] = []
+        for k, dev in enumerate(self.devices):
+            shards = [b.shards[k] for b in self.buckets.values()]
+            busy_acc = sum(s.lane_windows_busy for s in shards)
+            total_acc = sum(s.lane_windows_total for s in shards)
+            out.append({
+                "shard": k,
+                "device": "default" if dev is None else str(dev),
+                "quarantined": k in self._quarantined,
+                "lanes_busy": sum(
+                    len(s.assignments) for s in shards
+                ),
+                "lanes_total": sum(s.pool.n_lanes for s in shards),
+                "occupancy": (
+                    busy_acc / total_acc if total_acc else None
+                ),
+                "windows": sum(s.windows for s in shards),
+                "diverged": sum(s.diverged for s in shards),
+                "snapshots_resident": len(
+                    self.snapshots.keys_on_shard(k)
+                ),
+                "snapshot_bytes": self.snapshots.resident_bytes(
+                    shard=k
+                ),
+            })
+        return out
 
     def result(self, request_id: str):
         """The request's streamed trajectory: a stacked timeseries tree
@@ -1038,13 +1203,20 @@ class SimServer:
         self._metrics.inc("ticks")
         did_work = False
 
-        # 0. quarantine sweep (check_finite="window"): consume the
-        #    previous window's per-lane finite flags BEFORE admission,
-        #    so a poisoned lane is reclaimed (and reusable) this tick
-        #    and never dispatches another window
+        # 0a. device watchdog: a shard whose dispatched window never
+        #     completed within device_watchdog_s is declared dead and
+        #     quarantined BEFORE this tick schedules anything onto it
+        if self.device_watchdog_s is not None:
+            self._check_device_watchdog(now)
+
+        # 0b. lane quarantine sweep (check_finite="window"): consume
+        #     the previous window's per-lane finite flags BEFORE
+        #     admission, so a poisoned lane is reclaimed (and
+        #     reusable) this tick and never dispatches another window
         if self.check_finite == "window":
             for bucket in self.buckets.values():
-                self._sweep_quarantine(bucket)
+                for shard in bucket.shards:
+                    self._sweep_quarantine(bucket, shard)
 
         # 1. queued-side expiry (cancel of queued tickets is immediate
         #    in cancel(); only deadlines need the sweep)
@@ -1052,20 +1224,39 @@ class SimServer:
             self._finish(t, TIMEOUT)
             self._metrics.inc("timeouts")
 
+        # 1b. a bucket whose every device is quarantined can never
+        #     admit again — fail its queued work with the cause now
+        #     instead of parking it forever (run_until_idle would
+        #     otherwise spin on a queue nothing can drain)
+        dead = {
+            name for name, b in self.buckets.items()
+            if not b.active_shards()
+        }
+        if dead:
+            for t in list(self.queue):
+                if t.request.composite in dead and self.queue.drop(t):
+                    t.error = (
+                        f"every device serving bucket "
+                        f"{t.request.composite!r} is quarantined"
+                    )
+                    self._finish(t, FAILED)
+                    self._metrics.inc("failed")
+
         # 2. running-side cancel/expiry: reclaim lanes BEFORE admission
         #    so freed lanes are reusable this very tick
         for bucket in self.buckets.values():
-            for lane, t in list(bucket.assignments.items()):
-                if t.cancel_requested or t.expired(now):
-                    bucket.pool.release(lane)
-                    del bucket.assignments[lane]
-                    if t.cancel_requested:
-                        self._finish(t, CANCELLED)
-                        self._metrics.inc("cancelled")
-                    else:
-                        self._finish(t, TIMEOUT)
-                        self._metrics.inc("timeouts")
-                    did_work = True
+            for shard in bucket.shards:
+                for lane, t in list(shard.assignments.items()):
+                    if t.cancel_requested or t.expired(now):
+                        shard.pool.release(lane)
+                        del shard.assignments[lane]
+                        if t.cancel_requested:
+                            self._finish(t, CANCELLED)
+                            self._metrics.inc("cancelled")
+                        else:
+                            self._finish(t, TIMEOUT)
+                            self._metrics.inc("timeouts")
+                        did_work = True
 
         # 3. admission: FIFO over the queue, per-bucket free lanes;
         #    forks waiting on an in-flight prefix are skipped in place
@@ -1080,18 +1271,33 @@ class SimServer:
             self._admit(t, now)
         self._metrics.queue_depth = len(self.queue)
 
-        # 4. one window per bucket with any occupied lane
+        # 4. one window per (bucket, shard) with any occupied lane —
+        #    each shard is its own device program, so the dispatches
+        #    queue independently per device and run concurrently.
+        #    The FaultPlan's device_down seam fires per dispatch
+        #    attempt: a declared-dead device is quarantined INSTEAD of
+        #    dispatching, its work failing over to the survivors.
         for bucket in self.buckets.values():
-            if not bucket.assignments:
-                continue
-            did_work = True
-            self._run_bucket_window(bucket)
+            for shard in bucket.shards:
+                if shard.quarantined or not shard.assignments:
+                    continue
+                if self.faults and self.faults.device_down(shard.index):
+                    self.quarantine_device(
+                        shard.index,
+                        reason="FaultPlan device_down declaration",
+                    )
+                    did_work = True
+                    continue
+                did_work = True
+                self._run_shard_window(bucket, shard)
 
         self._metrics.lanes_busy = sum(
-            len(b.assignments) for b in self.buckets.values()
+            b.busy() for b in self.buckets.values()
         )
         self._metrics.retraces = sum(
-            b.pool.retraces() for b in self.buckets.values()
+            s.pool.retraces()
+            for b in self.buckets.values()
+            for s in b.shards
         )
         return did_work
 
@@ -1130,18 +1336,23 @@ class SimServer:
         signal, not a promise (retirement order depends on horizons
         admitted later), but it scales with the real backlog instead
         of just the queue LENGTH: ten queued 4000-step requests now
-        hint a proportionally longer wait than ten 37-step ones."""
+        hint a proportionally longer wait than ten 37-step ones.
+
+        Mesh honesty: the math counts only NON-QUARANTINED shards'
+        lanes — a half-dead mesh must not advertise capacity it
+        cannot schedule (the hint would undershoot forever)."""
         total_lanes = sum(
-            b.pool.n_lanes for b in self.buckets.values()
+            b.lanes_total() for b in self.buckets.values()
         )
         to_free = 0.0
         if not any(b.free_lanes() > 0 for b in self.buckets.values()):
             to_free = min(
                 (
-                    -(-int(b.pool.remaining_host[lane])
-                      // b.pool.window_steps)
+                    -(-int(s.pool.remaining_host[lane])
+                      // s.pool.window_steps)
                     for b in self.buckets.values()
-                    for lane in b.assignments
+                    for s in b.active_shards()
+                    for lane in s.assignments
                 ),
                 default=0.0,
             )
@@ -1155,7 +1366,17 @@ class SimServer:
 
     def _admit(self, t: Ticket, now: float) -> None:
         bucket = self.buckets[t.request.composite]
-        lane = bucket.next_free_lane()
+        # placement: a ticket scattering a cached snapshot prefers the
+        # shard whose device already holds it (the scatter stays
+        # device-local); everything else balances onto the emptiest
+        # active shard
+        prefer = None
+        if t.carry_key is not None:
+            prefer = self.snapshots.shard_of(t.carry_key)
+        elif t.carry_state is not None:
+            prefer = t.carry_shard
+        shard = bucket.place(prefer)
+        lane = shard.next_free_lane()
         # a continuation/fork ticket arms only its REMAINING steps (its
         # steps_done already counts the parent's run / the shared
         # prefix); fresh tickets have steps_done == 0 so this is their
@@ -1171,7 +1392,7 @@ class SimServer:
         )
         try:
             if t.carry_key is not None:
-                bucket.pool.admit_state(
+                shard.pool.admit_state(
                     lane,
                     self.snapshots.state(t.carry_key),
                     arm_steps,
@@ -1183,13 +1404,14 @@ class SimServer:
                 )
                 t.carry_key = None
             elif t.carry_state is not None:
-                bucket.pool.admit_state(
+                shard.pool.admit_state(
                     lane, t.carry_state, arm_steps,
                     overrides=fork_overrides,
                 )
                 t.carry_state = None  # scattered; drop the shared ref
+                t.carry_shard = None
             else:
-                bucket.pool.admit(
+                shard.pool.admit(
                     lane,
                     seed=int(t.request.seed),
                     horizon_steps=arm_steps,
@@ -1205,8 +1427,9 @@ class SimServer:
             self._metrics.inc("prefix_forks")
         t.status = RUNNING
         t.lane = lane
+        t.shard = shard.index
         t.admitted_at = now
-        bucket.assignments[lane] = t
+        shard.assignments[lane] = t
         if not t.internal:
             self._results[t.request_id] = self._make_sink(t)
             if self._streamer is not None:
@@ -1254,26 +1477,31 @@ class SimServer:
             flush_every=self.flush_every if self.stream_flush else None,
         )
 
-    def _sweep_quarantine(self, bucket: _Bucket) -> None:
-        """Consume a bucket's pending finite flags (dispatched with the
+    def _sweep_quarantine(self, bucket: _Bucket, shard: _Shard) -> None:
+        """Consume a shard's pending finite flags (dispatched with the
         previous window, host-copied alongside its trajectory) and
         quarantine any occupied-at-dispatch lane that went non-finite.
         Reading the flags waits only for the PREVIOUS window's compute
         — work the device had to finish before the next dispatch
         anyway — so the check adds a tiny transfer, not a sync point
         the pipeline didn't already have."""
-        if bucket.pending_check is None:
+        if shard.pending_check is None:
             return
-        flags_dev, watched = bucket.pending_check
-        bucket.pending_check = None
+        flags_dev, watched = shard.pending_check
+        shard.pending_check = None
         flags = np.asarray(jax.device_get(flags_dev))
         for lane, (t, step_after) in watched.items():
             if bool(flags[lane]):
                 continue
-            self._quarantine(bucket, lane, t, step_after)
+            self._quarantine(bucket, shard, lane, t, step_after)
 
     def _quarantine(
-        self, bucket: _Bucket, lane: int, t: Ticket, step_after: int
+        self,
+        bucket: _Bucket,
+        shard: _Shard,
+        lane: int,
+        t: Ticket,
+        step_after: int,
     ) -> None:
         """Fail ONE diverged request: reclaim its lane (running) or
         flip its just-retired DONE to FAILED (the one-window detection
@@ -1282,19 +1510,21 @@ class SimServer:
         quarantine is pure bookkeeping. The poisoned state stays
         frozen in the lane until the next admission overwrites every
         leaf of it."""
-        dt = bucket.pool.timestep
+        dt = shard.pool.timestep
         t.diverged = True
         t.error = (
             f"SimulationDiverged: non-finite state (NaN/Inf) in lane "
-            f"{lane} of bucket {bucket.name!r} within the window "
+            f"{lane} (shard {shard.index}) of bucket {bucket.name!r} "
+            f"within the window "
             f"ending at step {step_after} (t={step_after * dt:g}); "
             f"the request failed and its lane was reclaimed — "
             f"co-batched requests are unaffected"
         )
         self._metrics.inc("diverged")
-        if t.status == RUNNING and bucket.assignments.get(lane) is t:
-            bucket.pool.release(lane)
-            del bucket.assignments[lane]
+        shard.diverged += 1
+        if t.status == RUNNING and shard.assignments.get(lane) is t:
+            shard.pool.release(lane)
+            del shard.assignments[lane]
             self._finish(t, FAILED)
             self._metrics.inc("failed")
         elif t.status == DONE:
@@ -1332,13 +1562,275 @@ class SimServer:
                     "status": FAILED,
                     "error": t.error,
                     "steps": t.steps_done,
-                })
+                }, shard=shard.index)
         # already terminal non-DONE (cancelled/expired raced the
         # check): keep the terminal status, the diverged flag and
         # error still mark the records as suspect
 
-    def _run_bucket_window(self, bucket: _Bucket) -> None:
-        """Dispatch one window and route its host work.
+    # -- whole-device failover (docs/serving.md, "Mesh serving &
+    # device failover") ------------------------------------------------------
+
+    def quarantine_device(
+        self, shard: int, reason: str = "operator request"
+    ) -> int:
+        """Quarantine one device shard: drain it from scheduling and
+        fail its work over to the surviving devices. Returns how many
+        running requests were displaced.
+
+        Every bucket's pool on that device stops dispatching; its
+        running requests RE-QUEUE under their original ids — a
+        continuation re-arms from its parent's held snapshot (bitwise
+        resume where the snapshot survives, via a rehydrated spill or
+        a surviving shard), everything else re-runs deterministically
+        from its exact inputs — and each re-queued request's sink
+        restarts, so the final streamed bytes equal a never-faulted
+        run's. Snapshots whose buffers lived in the dead device's
+        memory rehydrate from their spills onto a survivor
+        (``recover_dir``); without a spill they are lost, and whatever
+        depended on the exact bits (queued continuations, future
+        ``resubmit`` of a held parent) fails with a descriptive error
+        rather than silently recomputing different state.
+
+        Reached three ways: a ``FaultPlan`` ``device_down``
+        declaration at the shard's window seam, the device watchdog
+        (``device_watchdog_s``), or an operator calling this directly.
+        Idempotent per device. There is deliberately no
+        un-quarantine: a revived device needs a fresh server (the WAL
+        makes that cheap)."""
+        if not 0 <= shard < self.n_shards:
+            raise IndexError(
+                f"shard {shard} not in [0, {self.n_shards})"
+            )
+        if shard in self._quarantined:
+            return 0
+        # settle the stream pipe before touching any sink: windows
+        # already handed off (including this shard's) finish
+        # appending, so the sinks we are about to reset are quiescent.
+        # If the pipe is stuck on the DEAD device's own transfer (a
+        # truly hung chip under the pipeline), a watchdog-bounded
+        # drain times out — proceed with the failover anyway:
+        # displaced sinks restart from scratch, and the per-handoff
+        # watchdog_s keeps every later stream handoff bounded. (With
+        # watchdog_s unset a hung transfer blocks here indefinitely —
+        # arm BOTH watchdogs for full hang coverage; docs/serving.md.)
+        if self._streamer is not None:
+            try:
+                self._streamer.drain()
+            except WatchdogTimeout:
+                pass
+        self._quarantined.add(shard)
+        displaced: List[Ticket] = []
+        for bucket in self.buckets.values():
+            s = bucket.shards[shard]
+            s.quarantined = True
+            s.pending_check = None
+            s.watch = None
+            displaced.extend(s.assignments.values())
+            s.assignments.clear()
+        self._failover_snapshots(shard)
+        # re-queue in submission order — failover preserves the FIFO
+        # fairness the queue had before the device died
+        for t in sorted(displaced, key=lambda t: t.request_id):
+            self._requeue_displaced(t, shard, reason)
+        self._metrics.quarantined_devices = len(self._quarantined)
+        self._metrics.lanes_total = sum(
+            b.lanes_total() for b in self.buckets.values()
+        )
+        self._metrics.queue_depth = len(self.queue)
+        if self._wal is not None:
+            # observability, not recovery state: a restarted server
+            # starts with every device healthy (replay ignores this)
+            self._wal.append(
+                {"event": QUARANTINE, "shard": shard, "reason": reason}
+            )
+        return len(displaced)
+
+    def _check_device_watchdog(self, now: float) -> None:
+        """Quarantine any device whose oldest dispatched window has
+        not completed within ``device_watchdog_s`` — fail-stop
+        detection for a chip that silently stopped making progress
+        (the per-handoff ``watchdog_s`` catches hung HOST seams; this
+        one catches the device itself)."""
+        for k in range(self.n_shards):
+            if k in self._quarantined:
+                continue
+            stalled = False
+            for bucket in self.buckets.values():
+                s = bucket.shards[k]
+                if s.watch is None:
+                    continue
+                if self._window_ready(s):
+                    s.watch = None
+                elif now - s.watch[0] > self.device_watchdog_s:
+                    stalled = True
+            if stalled:
+                self.quarantine_device(
+                    k,
+                    reason=(
+                        f"device watchdog: a dispatched window made "
+                        f"no progress for {self.device_watchdog_s}s"
+                    ),
+                )
+
+    @staticmethod
+    def _window_ready(shard: _Shard) -> bool:
+        """Non-blocking completion poll of the WATCHED window's own
+        output handle — not the pool's current (newest) one, which a
+        busy shard overwrites every tick (jax arrays expose
+        ``is_ready``). Anything unpollable reads as ready — the
+        watchdog degrades to off rather than false-positive on an
+        exotic array type."""
+        probe = getattr(shard.watch[1], "is_ready", None)
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:
+            return True
+
+    def _failover_snapshots(self, dead: int) -> None:
+        """Re-home every snapshot whose buffers lived in the dead
+        device's memory: rehydrate from its durable spill onto a
+        surviving device where one exists (same key, same refs, new
+        residency — outstanding pins keep working), otherwise declare
+        it lost and repair the tickets that depended on it."""
+        from lens_tpu.checkpoint import restore_tree
+
+        target = next(
+            (
+                k for k in range(self.n_shards)
+                if k not in self._quarantined
+            ),
+            None,
+        )
+        for key in self.snapshots.keys_on_shard(dead):
+            path = (
+                os.path.join(
+                    self.recover_dir, SPILL_DIR, spill_name(key)
+                )
+                if self.recover_dir
+                else None
+            )
+            if (
+                target is not None
+                and path is not None
+                and os.path.isdir(path)
+            ):
+                self.snapshots.reassign(
+                    key,
+                    restore_tree(path, device=self.devices[target]),
+                    shard=target,
+                )
+                continue
+            orphaned = self.snapshots.discard(key)
+            self._metrics.inc("snapshot_evictions")
+            if orphaned:
+                self._repair_lost_refs(key)
+
+    def _repair_lost_refs(self, key) -> None:
+        """A pinned snapshot died with its device (no spill): every
+        ticket holding a ref must stop pointing at it — holds are
+        dropped (a later ``resubmit`` refuses descriptively), queued
+        forks re-resolve their prefix (a fresh run on a survivor),
+        queued continuations fail (the parent's exact bits are
+        unrecoverable)."""
+        for t in list(self.tickets.values()):
+            if t.held_key == key:
+                t.held_key = None
+            if t.carry_key == key and t.status == QUEUED:
+                t.carry_key = None
+                bucket = self.buckets[t.request.composite]
+                if t.prefix_key == key:
+                    self._resolve_prefix(t, bucket)
+                elif self.queue.drop(t):
+                    t.error = (
+                        "the held snapshot this continuation extends "
+                        "died with its quarantined device and had no "
+                        "durable spill (serve with recover_dir to "
+                        "make holds survive device loss)"
+                    )
+                    self._finish(t, FAILED)
+                    self._metrics.inc("failed")
+
+    def _requeue_displaced(
+        self, t: Ticket, dead: int, reason: str
+    ) -> None:
+        """Re-queue one request displaced from a quarantined device,
+        under its ORIGINAL id. The sink restarts (partial records from
+        the dead device are discarded) and the step/emit counters
+        reset to the ticket's base, so the re-run regenerates the
+        complete stream — bitwise what a never-faulted run would have
+        streamed, by the serving determinism contract. A continuation
+        re-pins its parent's held snapshot (rehydrated by
+        :meth:`_failover_snapshots` when the parent ran on the dead
+        device); a fork re-resolves its prefix against the store."""
+        bucket = self.buckets[t.request.composite]
+        sink = self._results.pop(t.request_id, None)
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:
+                pass  # a torn sink must not abort the failover
+        self._stream_done.pop(t.request_id, None)
+        t.status = QUEUED
+        t.lane = None
+        t.shard = None
+        t.diverged = False
+        t.error = None
+        t.steps_done = t.steps_base
+        t.emit_count = t.steps_base // bucket.pool.emit_every
+        t.carry_state = None
+        t.carry_shard = None
+        t.waiting = False
+        if t.cancel_requested:
+            self._finish(t, CANCELLED)
+            self._metrics.inc("cancelled")
+            return
+        failure = None
+        parent = (
+            self.tickets.get(t.parent)
+            if t.parent is not None and t.prefix_key is None
+            else None
+        )
+        if not bucket.active_shards():
+            failure = (
+                f"device {dead} quarantined ({reason}) and no "
+                f"surviving device serves bucket {bucket.name!r}"
+            )
+        elif (
+            not t.internal
+            and t.parent is not None
+            and t.prefix_key is None
+            and (parent is None or parent.held_key is None)
+        ):
+            failure = (
+                f"device {dead} quarantined ({reason}); the parent "
+                f"request's held state died with it and had no "
+                f"durable spill, so this continuation cannot re-arm "
+                f"(serve with recover_dir to make holds survive "
+                f"device loss)"
+            )
+        if failure is not None:
+            t.error = failure
+            self._finish(t, FAILED)
+            self._metrics.inc("failed")
+            return
+        # force: failover re-queues already-admitted work; bouncing it
+        # off the client backpressure bound would drop accepted
+        # requests
+        self.queue.push(t, retry_after=0.0, force=True)
+        if not t.internal:
+            self._metrics.inc("requeued")
+        if parent is not None and not t.internal:
+            t.carry_key = parent.held_key
+            self.snapshots.acquire(parent.held_key)
+        if t.prefix_key is not None:
+            self._resolve_prefix(t, bucket)
+
+    def _run_shard_window(self, bucket: _Bucket, shard: _Shard) -> None:
+        """Dispatch one window on ONE device shard and route its host
+        work (each shard's window is an independent device program —
+        dispatches across shards queue per-device and overlap).
 
         Pipelined (default): start the trajectory's device->host copy,
         do ALL retire/admit bookkeeping from the host-mirrored
@@ -1353,28 +1845,40 @@ class SimServer:
         ``process_window`` the streamer runs, so both modes produce
         byte-identical sink contents.
         """
-        pool = bucket.pool
+        pool = shard.pool
         pipelined = self._streamer is not None
         if self.faults:
             # fault seam "lane.state": poison a matched request's lane
             # BEFORE the dispatch, so the NaN propagates through this
             # window and the finite check sees it at the next tick
-            for lane, t in bucket.assignments.items():
+            for lane, t in shard.assignments.items():
                 if self.faults.poison(t.request_id, t.steps_done):
                     pool.poison_lane(lane)
         t0 = time.perf_counter()
         remaining_before, traj = pool.run_window()
+        shard.windows += 1
+        if self.device_watchdog_s is not None and shard.watch is None:
+            # device watchdog arm: time THIS window against its own
+            # output handle (a [L] int32 — negligible to keep alive);
+            # the next window is timed only after this one completes.
+            # Clock starts NOW, not at t0: run_window() returns after
+            # trace/compile, and a first-dispatch compile can dwarf
+            # any sane deadline — the watchdog must time device
+            # progress only
+            shard.watch = (time.perf_counter(), pool.remaining)
         self.faults.kill("window.dispatched")
         self._metrics.inc("windows")
-        self._metrics.inc("lane_windows_busy", len(bucket.assignments))
+        self._metrics.inc("lane_windows_busy", len(shard.assignments))
         self._metrics.inc("lane_windows_total", pool.n_lanes)
+        shard.lane_windows_busy += len(shard.assignments)
+        shard.lane_windows_total += pool.n_lanes
 
         if self.check_finite == "window":
             # per-lane finite flags over the post-window states, read
             # at the NEXT tick's sweep; the map freezes lane->ticket at
             # dispatch (lanes retire/reassign underneath the lag)
             flags = pool.finite_flags()
-            bucket.pending_check = (
+            shard.pending_check = (
                 flags,
                 {
                     lane: (
@@ -1384,7 +1888,7 @@ class SimServer:
                             pool.window_steps,
                         ),
                     )
-                    for lane, t in bucket.assignments.items()
+                    for lane, t in shard.assignments.items()
                 },
             )
             if pipelined:
@@ -1399,10 +1903,11 @@ class SimServer:
             # per-segment transfer).
             host = jax.device_get(traj)
             ready = time.perf_counter()
+            shard.watch = None  # blocked through it: observed complete
 
         slices: List[LaneSlice] = []
         retiring = []
-        for lane, t in list(bucket.assignments.items()):
+        for lane, t in list(shard.assignments.items()):
             before = int(remaining_before[lane])
             retire = before <= pool.window_steps  # horizon elapsed
             if t.internal:
@@ -1453,13 +1958,18 @@ class SimServer:
                 snap = pool.lane_state_device(lane)
                 if t.internal:
                     # a finished prefix run: publish the snapshot
-                    # (unpinned cache content) and release every
-                    # coalesced fork waiting on it
+                    # (unpinned cache content, owned by this shard's
+                    # device) and release every coalesced fork
+                    # waiting on it
                     self._metrics.inc(
                         "snapshot_evictions",
-                        self.snapshots.put(t.content_key, snap),
+                        self.snapshots.put(
+                            t.content_key, snap, shard=shard.index
+                        ),
                     )
-                    self._resolve_waiters(t.content_key, snap)
+                    self._resolve_waiters(
+                        t.content_key, snap, shard=shard.index
+                    )
                 else:
                     # hold_state: pin the snapshot for resubmit —
                     # content-addressed when the run is pure (so it
@@ -1472,12 +1982,14 @@ class SimServer:
                     )
                     self._metrics.inc(
                         "snapshot_evictions",
-                        self.snapshots.put(held, snap, pin=True),
+                        self.snapshots.put(
+                            held, snap, pin=True, shard=shard.index
+                        ),
                     )
                     t.held_key = held
                     if self._wal is not None:
                         self._spill_hold(t, held, snap)
-            del bucket.assignments[lane]
+            del shard.assignments[lane]
             self._finish(t, DONE)
             self._metrics.inc("retired")
 
@@ -1539,7 +2051,7 @@ class SimServer:
             "rid": t.request_id,
             "key": key_to_json(key),
             "name": name,
-        })
+        }, shard=t.shard or 0)
         self.faults.kill("hold.spilled")
 
     def _mark_streamed(self, t: Ticket) -> None:
@@ -1549,7 +2061,10 @@ class SimServer:
         thread (pipelined) or the scheduler (sync) — the WAL is
         thread-safe."""
         if self._wal is not None and not t.internal:
-            self._wal.append({"event": STREAMED, "rid": t.request_id})
+            self._wal.append(
+                {"event": STREAMED, "rid": t.request_id},
+                shard=t.shard or 0,
+            )
             self.faults.kill("streamed.walled")
 
     def _completion_cb(self, t: Ticket):
@@ -1586,7 +2101,7 @@ class SimServer:
                 "status": status,
                 "error": t.error,
                 "steps": t.steps_done,
-            })
+            }, shard=t.shard or 0)
             self.faults.kill("retired.walled")
         if t.carry_key is not None:
             # terminal before the scatter consumed it (failed
@@ -1711,7 +2226,10 @@ class SimServer:
 
     def _rehydrate(self, hold: Mapping[str, Any], pin: bool):
         """Load one spilled snapshot back into the store; returns its
-        key. Idempotent across multiple continuations of one parent."""
+        key. Idempotent across multiple continuations of one parent.
+        The restored tree is re-pinned onto the first healthy device —
+        the shard layout the spill was captured under need not exist
+        anymore (a recovered server may have a different mesh)."""
         from lens_tpu.checkpoint import restore_tree
 
         key = key_from_json(hold["key"])
@@ -1726,7 +2244,19 @@ class SimServer:
                     f"but its spill directory is gone; recovery "
                     f"cannot rebuild the held state"
                 )
-            self.snapshots.put(key, restore_tree(path), pin=pin)
+            target = next(
+                (
+                    k for k in range(self.n_shards)
+                    if k not in self._quarantined
+                ),
+                0,
+            )
+            self.snapshots.put(
+                key,
+                restore_tree(path, device=self.devices[target]),
+                pin=pin,
+                shard=target,
+            )
         elif pin:
             self.snapshots.put(key, self.snapshots.state(key), pin=True)
         return key
@@ -1793,6 +2323,7 @@ class SimServer:
                 request=request,
                 horizon_steps=total_steps,
                 steps_done=parent_steps,
+                steps_base=parent_steps,
                 emit_count=parent_steps // bucket.pool.emit_every,
                 content_key=(
                     self._content_key(bucket, request, total_steps)

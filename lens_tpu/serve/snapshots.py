@@ -119,6 +119,7 @@ class _Entry:
     nbytes: int
     refs: int = 0
     used: int = 0  # LRU stamp (monotonic per store)
+    shard: int = 0  # device shard whose memory holds the state tree
 
 
 class SnapshotStore:
@@ -148,8 +149,28 @@ class SnapshotStore:
     def __contains__(self, key: SnapshotKey) -> bool:
         return key in self._entries
 
-    def resident_bytes(self) -> int:
-        return sum(e.nbytes for e in self._entries.values())
+    def resident_bytes(self, shard: Optional[int] = None) -> int:
+        return sum(
+            e.nbytes
+            for e in self._entries.values()
+            if shard is None or e.shard == shard
+        )
+
+    def shard_of(self, key: SnapshotKey) -> Optional[int]:
+        """The device shard owning an entry's buffers (None if
+        absent) — mesh admission places a fork on the shard that
+        already holds its cached prefix, so the scatter never crosses
+        devices on the happy path."""
+        entry = self._entries.get(key)
+        return entry.shard if entry is not None else None
+
+    def keys_on_shard(self, shard: int) -> List[SnapshotKey]:
+        """Every entry whose buffers live in one shard's device memory
+        — the set a device quarantine must rehydrate (from spills) or
+        declare lost."""
+        return [
+            k for k, e in self._entries.items() if e.shard == shard
+        ]
 
     def refs_total(self) -> int:
         """Outstanding pins across all entries — 0 when every acquire
@@ -205,11 +226,17 @@ class SnapshotStore:
     # -- writes --------------------------------------------------------------
 
     def put(
-        self, key: SnapshotKey, state: Any, pin: bool = False
+        self,
+        key: SnapshotKey,
+        state: Any,
+        pin: bool = False,
+        shard: int = 0,
     ) -> int:
         """Insert (or re-touch) a snapshot; returns how many entries
         were evicted to make room. ``pin=True`` adds one ref (the
         ``hold_state`` path — the caller promises a ``release``).
+        ``shard`` records which device shard's memory holds the tree
+        (0 on a single-device server).
 
         Inserting an existing key never replaces the state: by the
         content-address contract the bits are identical, so the
@@ -228,6 +255,7 @@ class SnapshotStore:
             nbytes=tree_nbytes(state),
             refs=1 if pin else 0,
             used=self._clock,
+            shard=int(shard),
         )
         self._entries[key] = entry
         # LRU eviction may consume the new entry itself (it is the
@@ -235,6 +263,30 @@ class SnapshotStore:
         # unpinned snapshot that cannot fit is simply not retained —
         # the caller still holds the tree for its immediate consumers.
         return self._evict_to_budget()
+
+    def reassign(
+        self, key: SnapshotKey, state: Any, shard: int
+    ) -> None:
+        """Replace an entry's buffers in place (same content, new
+        device residency) — the failover path: a quarantined shard's
+        spilled snapshot rehydrates onto a survivor while every
+        outstanding ref (queued continuations, held parents) keeps
+        pointing at the same key."""
+        entry = self._entries[key]
+        entry.state = state
+        entry.nbytes = tree_nbytes(state)
+        entry.shard = int(shard)
+        self._clock += 1
+        entry.used = self._clock
+
+    def discard(self, key: SnapshotKey) -> int:
+        """Forget an entry EVEN IF PINNED; returns the orphaned ref
+        count. Reserved for device loss (the buffers are gone no
+        matter who still holds a pin) — the caller must repair every
+        ticket that held a ref, which is why the count comes back.
+        ``drop`` stays the checked single-device path."""
+        entry = self._entries.pop(key, None)
+        return entry.refs if entry is not None else 0
 
     def drop(self, key: SnapshotKey) -> None:
         """Forget an unpinned entry now (explicit invalidation)."""

@@ -37,8 +37,11 @@ tolerates a torn tail frame exactly like the sweep ledger.
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import json
+import os
+import re
 import threading
 from typing import Any, Dict, List, Mapping, Optional
 
@@ -46,6 +49,8 @@ from lens_tpu.emit.log import JsonFrameLog
 
 WAL_NAME = "serve.wal"
 SPILL_DIR = "snapshots"
+
+_SHARD_WAL_RE = re.compile(r"^serve-shard(\d+)\.wal$")
 
 #: Event vocabulary (replay ignores unknown events, so old readers
 #: tolerate newer WALs — the ledger's forward-compat posture).
@@ -56,6 +61,14 @@ RETIRE = "retire"        # {rid, status, error, steps}
 STREAMED = "streamed"    # {rid} records durably on disk
 HOLD = "hold"            # {rid, key, name} held snapshot spilled
 RELEASE = "release"      # {rid} hold dropped
+QUARANTINE = "device_quarantined"  # {shard, reason} observability only
+
+
+def shard_wal_name(shard: int) -> str:
+    """Per-shard WAL file name. Shard 0 keeps the historical
+    ``serve.wal`` name, so every pre-mesh recover_dir is a valid
+    1-shard mesh WAL and vice versa."""
+    return WAL_NAME if shard == 0 else f"serve-shard{shard:02d}.wal"
 
 
 def buckets_fingerprint(buckets: Mapping[str, Mapping[str, Any]]) -> str:
@@ -105,70 +118,164 @@ def spill_name(key: Any) -> str:
 
 
 class ServeWal:
-    """One server's write-ahead log (thread-safe: ``streamed`` events
-    land from the stream thread while the scheduler appends).
+    """One server's write-ahead log, ONE framed-JSON file PER SHARD
+    (thread-safe: ``streamed`` events land from the stream thread
+    while the scheduler appends).
 
-    ``events`` is the replayed history; :meth:`begin` pins (or, on a
-    replayed file, verifies) the bucket fingerprint — recovering with
-    buckets that would compute different bits is refused instead of
-    silently serving a different simulation under old request ids.
+    Mesh discipline (round 13): a multi-device server's durability
+    must not funnel every shard's retire/streamed/hold traffic through
+    one file — per-shard logs keep the write path independent per
+    failure domain (on a real multi-host mesh each host fsyncs its
+    own log), and a torn tail on ONE shard's file loses only that
+    shard's last event. What makes the split safe is the **merge
+    protocol**: every append is stamped with a global monotonically
+    increasing ``seq`` drawn under one lock, so ``events`` — and
+    therefore recovery — is the TOTAL ORDER the scheduler actually
+    produced, reconstructed by merging all shard files on ``seq``.
+    Replaying the merged stream is byte-equal to replaying a single
+    WAL holding the same appends (pinned in tests/test_mesh_serve.py).
+    Legacy single-file WALs (pre-seq events) sort before all stamped
+    events in file order, so old recover_dirs replay unchanged.
+
+    ``events`` is the merged replayed history; :meth:`begin` pins (or,
+    on replayed files, verifies) the bucket fingerprint per shard file
+    — recovering with buckets that would compute different bits is
+    refused instead of silently serving a different simulation under
+    old request ids. A server may legally reopen with a different
+    shard count (scheduling knobs are outside the fingerprint): extra
+    existing shard files are still read and merged; appends for
+    shards this server does not have route to shard 0.
     """
 
-    def __init__(self, path: str):
-        self._log = JsonFrameLog(path, fsync_every=False)
-        self._lock = threading.Lock()
-        self._dirty = False
+    def __init__(self, path: str, n_shards: int = 1):
+        if n_shards < 1:
+            raise ValueError(f"n_shards={n_shards} must be >= 1")
         self.path = path
+        self.n_shards = int(n_shards)
+        self._dir = os.path.dirname(path) or "."
+        self._lock = threading.Lock()
+        self._dirty: set = set()
+        # every shard this server writes, plus any shard file a
+        # previous (wider) incarnation left behind — recovery must
+        # merge ALL of them or silently forget that shard's retires
+        shards = set(range(self.n_shards))
+        for p in glob.glob(os.path.join(self._dir, "serve-shard*.wal")):
+            m = _SHARD_WAL_RE.match(os.path.basename(p))
+            if m:
+                shards.add(int(m.group(1)))
+        self._logs: Dict[int, JsonFrameLog] = {
+            k: JsonFrameLog(
+                os.path.join(self._dir, shard_wal_name(k))
+                if k else path,
+                fsync_every=False,
+            )
+            for k in sorted(shards)
+        }
+        self._seq = 1 + max(
+            (
+                int(e["seq"])
+                for log in self._logs.values()
+                for e in log.events
+                if "seq" in e
+            ),
+            default=-1,
+        )
 
     @property
     def events(self) -> List[Dict[str, Any]]:
-        return self._log.events
+        """All shards' events merged into the total append order:
+        sorted by the global ``seq`` stamp; pre-seq (legacy) events
+        keep their file order ahead of every stamped one."""
+        merged = []
+        for shard, log in sorted(self._logs.items()):
+            for pos, e in enumerate(log.events):
+                merged.append((int(e.get("seq", -1)), shard, pos, e))
+        merged.sort(key=lambda t: t[:3])
+        return [e for *_, e in merged]
 
     def replayed(self) -> bool:
-        """True when the file held events before this open — the
+        """True when any shard file held events before this open — the
         server must run recovery before serving."""
-        return any(e.get("event") != BEGIN for e in self._log.events)
+        return any(
+            e.get("event") != BEGIN
+            for log in self._logs.values()
+            for e in log.events
+        )
 
     def begin(
         self, fingerprint: str, buckets: Mapping[str, Any]
     ) -> None:
-        for e in self._log.events:
-            if e.get("event") == BEGIN:
-                if e.get("fingerprint") != fingerprint:
-                    raise ValueError(
-                        f"{self.path} belongs to a server with bucket "
-                        f"fingerprint {e.get('fingerprint')!r}, not "
-                        f"{fingerprint!r} — the bucket configuration "
-                        f"changed in a bits-relevant way; recovery "
-                        f"under old request ids would serve a "
-                        f"different simulation. Use a fresh "
-                        f"recover_dir (or restore the original "
-                        f"buckets)."
-                    )
-                return
-        self.append({
-            "event": BEGIN,
-            "fingerprint": fingerprint,
-            "buckets": {k: dict(v) for k, v in buckets.items()},
-        })
-
-    def append(self, event: Mapping[str, Any]) -> None:
-        """Append one event: framed + flushed to the OS (SIGKILL-safe)
-        now, fsynced at the next :meth:`sync` (group commit)."""
         with self._lock:
-            self._log.append(event)
-            self._dirty = True
+            for shard, log in self._logs.items():
+                had = False
+                for e in log.events:
+                    if e.get("event") == BEGIN:
+                        had = True
+                        if e.get("fingerprint") != fingerprint:
+                            raise ValueError(
+                                f"{log.path} belongs to a server with "
+                                f"bucket fingerprint "
+                                f"{e.get('fingerprint')!r}, not "
+                                f"{fingerprint!r} — the bucket "
+                                f"configuration changed in a "
+                                f"bits-relevant way; recovery under "
+                                f"old request ids would serve a "
+                                f"different simulation. Use a fresh "
+                                f"recover_dir (or restore the "
+                                f"original buckets)."
+                            )
+                if not had:
+                    self._append_locked(
+                        {
+                            "event": BEGIN,
+                            "fingerprint": fingerprint,
+                            "shard": shard,
+                            "buckets": {
+                                k: dict(v) for k, v in buckets.items()
+                            },
+                        },
+                        shard,
+                    )
+
+    def _append_locked(
+        self, event: Mapping[str, Any], shard: int
+    ) -> None:
+        # dict.get + explicit None test: JsonFrameLog has __len__, so
+        # an EMPTY shard log is falsy — an `or` fallback would
+        # silently misroute its first event to shard 0
+        log = self._logs.get(int(shard))
+        if log is None:
+            log = self._logs[0]
+        stamped = dict(event)
+        stamped["seq"] = self._seq
+        self._seq += 1
+        log.append(stamped)
+        self._dirty.add(id(log))
+
+    def append(
+        self, event: Mapping[str, Any], shard: int = 0
+    ) -> None:
+        """Append one event to ``shard``'s log (events about a request
+        land on the shard that ran it; submit-side events land on
+        shard 0): seq-stamped under the lock, framed + flushed to the
+        OS (SIGKILL-safe) now, fsynced at the next :meth:`sync` (group
+        commit)."""
+        with self._lock:
+            self._append_locked(event, shard)
 
     def sync(self) -> None:
-        """Group commit: fsync every append so far (the scheduler
-        calls this once per tick, before acting on the queue; a tick
-        with nothing appended skips the syscall)."""
+        """Group commit: fsync every shard file with appends since the
+        last sync (the scheduler calls this once per tick, before
+        acting on the queue; untouched shards skip the syscall)."""
         with self._lock:
-            if self._dirty:
-                self._log.sync()
-                self._dirty = False
+            for log in self._logs.values():
+                if id(log) in self._dirty:
+                    log.sync()
+            self._dirty.clear()
 
     def close(self) -> None:
         with self._lock:
-            self._log.sync()
-            self._log.close()
+            for log in self._logs.values():
+                log.sync()
+                log.close()
+            self._dirty.clear()

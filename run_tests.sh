@@ -87,6 +87,12 @@ if [ "${1:-}" = "--quick" ]; then
   # the quick tier is the fast signal: slow-marked soaks stay out of it
   # (a caller's own -m overrides, since pytest takes the last -m given)
   run_per_file "$QUICK_FILES" -m "not slow" "$@"
+  if [ -e tests/test_mesh_serve.py ]; then
+    # mesh serving batch (simulated devices; see MESH_FILES below)
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m pytest tests/test_mesh_serve.py -q -m "not slow" "$@"
+    note_rc $?
+  fi
   exit $rc
 elif [ "${1:-}" = "--per-file" ]; then
   shift
@@ -98,8 +104,17 @@ if [ "$mode" = "perfile" ]; then
   exit $rc
 fi
 
+# Mesh batch: the multi-device serving tests run in their own process
+# with the device-count flag explicit. (tests/conftest.py already
+# forces 8 simulated host devices for the whole suite, so this is
+# belt-and-suspenders for running the file OUTSIDE pytest-with-
+# conftest contexts; the subprocess drills inside set their own env.)
+# Kept out of the grouped batches so the compile-heavy 4-device
+# servers do not ride a shared process near the crash horizon.
+MESH_FILES="tests/test_mesh_serve.py"
+
 # files not named in any batch (newly added) run per-file at the end
-assigned=" ${BATCHES[*]} "
+assigned=" ${BATCHES[*]} $MESH_FILES "
 leftovers=""
 for f in tests/test_*.py; do
   case "$assigned" in
@@ -128,4 +143,14 @@ done
 if [ -n "$leftovers" ]; then
   run_per_file "$leftovers" "$@"
 fi
+
+# mesh batch: 8 simulated CPU devices (the exhaustive kill-one-device
+# sweep inside is slow-marked, so `-m "not slow"` callers skip it)
+for f in $MESH_FILES; do
+  if [ -e "$f" ]; then
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python -m pytest "$f" -q "$@"
+    note_rc $?
+  fi
+done
 exit $rc

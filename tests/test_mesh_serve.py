@@ -1,0 +1,794 @@
+"""Mesh-sharded serving (round 13): whole-device failover and the
+merge-on-recover sharded WAL.
+
+The contract (docs/serving.md, "Mesh serving & device failover"):
+``SimServer(mesh=N)`` places one resident lane pool per device behind
+one host scheduler; per-request bits are placement-independent, so a
+request's streamed bytes are identical served on any shard, any mesh
+size, solo or co-batched. A device that dies — a ``FaultPlan``
+``device_down`` declaration, the device watchdog, or an operator call
+— becomes a RECOVERABLE EVENT: the shard is quarantined, its snapshots
+rehydrate from spills onto survivors, and its requests re-queue under
+their original ids, ending bitwise where a never-faulted run would
+have. The WAL is one framed-JSON file per shard with a global ``seq``
+stamp; merged replay equals a single-WAL replay of the same appends.
+
+The in-process tests need simulated devices — tests/conftest.py
+already forces 8 for the whole suite, and the run_tests.sh mesh batch
+sets the flag explicitly for conftest-less contexts; the ``needs_mesh``
+guard skips rather than errors anywhere neither applies. The
+subprocess drills set their own environment and the WAL/merge tests
+need no devices at all.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lens_tpu.serve import (
+    DONE,
+    FAILED,
+    ScenarioRequest,
+    ServeWal,
+    SimServer,
+)
+from lens_tpu.serve.faults import FaultPlan
+from lens_tpu.serve.wal import shard_wal_name
+from lens_tpu.utils.dicts import flatten_paths
+
+N_DEVICES = jax.device_count()
+needs_mesh = pytest.mark.skipif(
+    N_DEVICES < 4,
+    reason="needs >=4 devices: run under XLA_FLAGS="
+    "--xla_force_host_platform_device_count=8 (run_tests.sh mesh "
+    "batch)",
+)
+
+
+def _flat(tree):
+    return {
+        "/".join(map(str, p)): np.asarray(v)
+        for p, v in flatten_paths(tree)
+    }
+
+
+def _assert_bitwise(got, ref, label=""):
+    got, ref = _flat(got), _flat(ref)
+    assert set(got) == set(ref), label
+    for k in ref:
+        assert got[k].tobytes() == ref[k].tobytes(), f"{label}: {k}"
+
+
+def _solo_oracle(seeds, horizon, composite="toggle_colony", **kw):
+    """Single-device, one-lane, one-at-a-time — the bitwise oracle."""
+    kw.setdefault("capacity", 16)
+    kw.setdefault("window", 8)
+    srv = SimServer.single_bucket(composite, lanes=1, **kw)
+    out = {}
+    for s in seeds:
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=s, horizon=horizon,
+        ))
+        srv.run_until_idle(max_ticks=500)
+        out[s] = srv.result(rid)
+    srv.close()
+    return out
+
+
+class TestShardedWal:
+    """The merge-on-recover protocol — no devices needed."""
+
+    def _events(self, wal):
+        return [
+            (e["event"], e.get("rid"))
+            for e in wal.events
+            if e.get("event") != "server_begin"
+        ]
+
+    def test_appends_route_to_shard_files(self, tmp_path):
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=3)
+        wal.append({"event": "submit", "rid": "req-000000"})
+        wal.append({"event": "retire", "rid": "req-000000"}, shard=2)
+        wal.append({"event": "streamed", "rid": "req-000000"}, shard=2)
+        wal.append({"event": "submit", "rid": "req-000001"})
+        wal.append({"event": "retire", "rid": "req-000001"}, shard=1)
+        wal.close()
+        for k in range(3):
+            assert os.path.exists(str(tmp_path / shard_wal_name(k)))
+        # shard 2's file holds exactly its two events
+        from lens_tpu.emit.log import JsonFrameLog
+
+        solo = JsonFrameLog(str(tmp_path / shard_wal_name(2)))
+        assert [
+            e["event"] for e in solo.events
+            if e.get("event") != "server_begin"
+        ] == ["retire", "streamed"]
+        solo.close()
+
+    def test_merged_order_is_total_append_order(self, tmp_path):
+        """Events interleaved across shards replay in exactly the
+        order the scheduler appended them — the seq stamp's job."""
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=4)
+        appended = []
+        for i in range(20):
+            ev = {"event": f"ev{i}", "rid": f"req-{i:06d}"}
+            wal.append(ev, shard=i % 4)
+            appended.append((ev["event"], ev["rid"]))
+        wal.close()
+        wal2 = ServeWal(str(tmp_path / "serve.wal"), n_shards=4)
+        assert self._events(wal2) == appended
+        wal2.close()
+
+    def test_merge_equals_single_wal_reference(self, tmp_path):
+        """The same append sequence through N shard files and through
+        one file replays identically (same events, same order, same
+        seq stamps) — multi-WAL recovery IS single-WAL recovery."""
+        multi = ServeWal(str(tmp_path / "m" / "serve.wal"), n_shards=3)
+        single = ServeWal(str(tmp_path / "s" / "serve.wal"))
+        for i in range(12):
+            ev = {"event": "retire", "rid": f"req-{i:06d}", "n": i}
+            multi.append(ev, shard=i % 3)
+            single.append(ev)
+        multi.close()
+        single.close()
+        m = ServeWal(str(tmp_path / "m" / "serve.wal"), n_shards=3)
+        s = ServeWal(str(tmp_path / "s" / "serve.wal"))
+        strip = lambda wal: [
+            {k: v for k, v in e.items() if k != "shard"}
+            for e in wal.events
+            if e.get("event") != "server_begin"
+        ]
+        assert strip(m) == strip(s)
+        m.close()
+        s.close()
+
+    def test_torn_tail_on_one_shard_loses_only_that_event(
+        self, tmp_path
+    ):
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=2)
+        wal.append({"event": "submit", "rid": "req-000000"})
+        wal.append({"event": "retire", "rid": "req-000000"}, shard=1)
+        wal.append({"event": "submit", "rid": "req-000001"})
+        wal.close()
+        # kill mid-append on shard 1's log: torn tail frame
+        with open(str(tmp_path / shard_wal_name(1)), "ab") as f:
+            f.write(b"LENS-torn-frame")
+        wal2 = ServeWal(str(tmp_path / "serve.wal"), n_shards=2)
+        assert self._events(wal2) == [
+            ("submit", "req-000000"),
+            ("retire", "req-000000"),
+            ("submit", "req-000001"),
+        ]
+        # appends after the truncation keep the global order
+        wal2.append({"event": "retire", "rid": "req-000001"}, shard=1)
+        assert self._events(wal2)[-1] == ("retire", "req-000001")
+        wal2.close()
+
+    def test_interleaved_retire_streamed_across_shards(self, tmp_path):
+        """The DONE-needs-streamed recovery rule depends on relative
+        order across SHARD FILES; the merge must preserve it."""
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=3)
+        wal.append({"event": "submit", "rid": "req-000000"})
+        wal.append({"event": "submit", "rid": "req-000001"})
+        wal.append(
+            {"event": "retire", "rid": "req-000000", "status": "done"},
+            shard=1,
+        )
+        wal.append(
+            {"event": "retire", "rid": "req-000001", "status": "done"},
+            shard=2,
+        )
+        wal.append({"event": "streamed", "rid": "req-000001"}, shard=2)
+        wal.append({"event": "streamed", "rid": "req-000000"}, shard=1)
+        wal.close()
+        wal2 = ServeWal(str(tmp_path / "serve.wal"), n_shards=3)
+        kinds = self._events(wal2)
+        assert kinds.index(("retire", "req-000001")) \
+            < kinds.index(("streamed", "req-000001"))
+        assert kinds.index(("streamed", "req-000001")) \
+            < kinds.index(("streamed", "req-000000"))
+        wal2.close()
+
+    def test_begin_fingerprint_verified_per_shard_file(self, tmp_path):
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=2)
+        wal.begin("fp-aaaa", {"toggle_colony": {}})
+        wal.close()
+        wal2 = ServeWal(str(tmp_path / "serve.wal"), n_shards=2)
+        wal2.begin("fp-aaaa", {"toggle_colony": {}})  # same: fine
+        with pytest.raises(ValueError, match="fingerprint"):
+            wal2.begin("fp-bbbb", {"toggle_colony": {}})
+        wal2.close()
+
+    def test_narrower_reopen_still_merges_all_shards(self, tmp_path):
+        """A 1-shard server over a 4-shard recover_dir must still see
+        every shard's events (mesh resize across recovery is legal)."""
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=4)
+        for i in range(8):
+            wal.append(
+                {"event": "retire", "rid": f"req-{i:06d}"}, shard=i % 4
+            )
+        wal.close()
+        narrow = ServeWal(str(tmp_path / "serve.wal"), n_shards=1)
+        assert len(self._events(narrow)) == 8
+        narrow.close()
+
+    def test_legacy_unstamped_events_sort_first(self, tmp_path):
+        """A pre-round-13 WAL (no seq stamps) replays in file order
+        ahead of any new stamped appends."""
+        from lens_tpu.emit.log import JsonFrameLog
+
+        legacy = JsonFrameLog(str(tmp_path / "serve.wal"))
+        legacy.append({"event": "submit", "rid": "req-000000"})
+        legacy.append({"event": "retire", "rid": "req-000000"})
+        legacy.close()
+        wal = ServeWal(str(tmp_path / "serve.wal"), n_shards=2)
+        wal.append({"event": "submit", "rid": "req-000001"}, shard=1)
+        assert self._events(wal) == [
+            ("submit", "req-000000"),
+            ("retire", "req-000000"),
+            ("submit", "req-000001"),
+        ]
+        wal.close()
+
+
+class TestRestoreTreeDevice:
+    """checkpoint.restore_tree re-pins a spill onto a chosen device
+    (the failover satellite) — meaningful at any device count."""
+
+    def test_restore_lands_on_requested_device(self, tmp_path):
+        from lens_tpu.checkpoint import restore_tree, save_tree
+
+        state = {
+            "a": jax.numpy.arange(6.0),
+            "b": {"c": jax.numpy.arange(3)},
+        }
+        path = str(tmp_path / "spill")
+        save_tree(path, state)
+        target = jax.devices()[-1]
+        back = restore_tree(path, device=target)
+        for leaf in jax.tree.leaves(back):
+            assert leaf.devices() == {target}
+        _assert_bitwise(back, state)
+
+    def test_default_placement_unchanged(self, tmp_path):
+        from lens_tpu.checkpoint import restore_tree, save_tree
+
+        state = {"a": jax.numpy.arange(4.0)}
+        path = str(tmp_path / "spill")
+        save_tree(path, state)
+        _assert_bitwise(restore_tree(path), state)
+
+
+@needs_mesh
+class TestMeshServing:
+    def test_solo_equals_cobatched_across_shards(self):
+        """The determinism contract survives placement: requests
+        co-batched across 4 devices stream the same bytes as solo
+        single-device runs — including the stochastic composite."""
+        for composite, kw in (
+            ("toggle_colony", dict(capacity=16, window=8)),
+            ("hybrid_cell", dict(capacity=8, window=4)),
+        ):
+            horizon = 16.0
+            seeds = list(range(6))
+            ref = _solo_oracle(seeds, horizon, composite, **kw)
+            srv = SimServer.single_bucket(
+                composite, lanes=2, mesh=4, **kw
+            )
+            rids = {
+                s: srv.submit(ScenarioRequest(
+                    composite=composite, seed=s, horizon=horizon,
+                ))
+                for s in seeds
+            }
+            srv.run_until_idle(max_ticks=500)
+            used = {srv.tickets[r].shard for r in rids.values()}
+            assert len(used) > 1, "requests never spread across shards"
+            for s, rid in rids.items():
+                _assert_bitwise(
+                    srv.result(rid), ref[s], f"{composite} seed {s}"
+                )
+            assert srv.metrics()["retraces"] == 0
+            srv.close()
+
+    def test_prefix_fork_lands_on_owner_shard(self):
+        """The shard-keyed snapshot store routes forks to the device
+        that owns the cached prefix tree (device-local scatter)."""
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=2, window=8, mesh=4,
+        )
+        first = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=3, horizon=24.0,
+            prefix={"horizon": 8.0},
+            overrides={"global": {"volume": 1.1}},
+        ))
+        srv.run_until_idle(max_ticks=500)
+        owner = srv.snapshots.shard_of(srv.tickets[first].prefix_key)
+        assert owner is not None
+        # later forks of the same prefix hit the cache and admit on
+        # the owning shard (free lanes exist everywhere)
+        forks = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=3, horizon=24.0,
+                prefix={"horizon": 8.0},
+                overrides={"global": {"volume": 1.2 + 0.1 * i}},
+            ))
+            for i in range(2)
+        ]
+        srv.run_until_idle(max_ticks=500)
+        for rid in forks:
+            t = srv.tickets[rid]
+            assert t.status == DONE
+            assert t.shard == owner
+        c = srv.metrics()["counters"]
+        assert c["prefix_hits"] == 2
+        srv.close()
+
+    def test_per_shard_gauges(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=2, window=8, mesh=4,
+        )
+        for s in range(8):
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=8.0,
+            ))
+        srv.run_until_idle(max_ticks=500)
+        snap = srv.metrics()
+        assert len(snap["shards"]) == 4
+        assert snap["quarantined_devices"] == 0
+        for k, row in enumerate(snap["shards"]):
+            assert row["shard"] == k
+            assert row["lanes_total"] == 2
+            assert not row["quarantined"]
+            assert row["windows"] >= 1  # every shard served something
+            assert {
+                "occupancy", "diverged", "snapshot_bytes",
+                "snapshots_resident", "device",
+            } <= set(row)
+        # the same gauges ride status() for any request
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=99, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=200)
+        assert len(srv.status(rid)["server"]["shards"]) == 4
+        srv.close()
+
+    def test_check_finite_quarantines_one_lane_not_the_device(self):
+        """Lane quarantine and device quarantine compose: a NaN lane
+        on shard k fails only its request; the shard keeps serving."""
+        faults = FaultPlan([
+            {"kind": "nan", "request": "req-000001", "after_steps": 8},
+        ])
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=2, window=8, mesh=2,
+            check_finite="window", faults=faults,
+        )
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=32.0,
+            ))
+            for s in range(4)
+        ]
+        srv.run_until_idle(max_ticks=500)
+        statuses = [srv.status(r)["status"] for r in rids]
+        assert statuses.count(FAILED) == 1
+        assert statuses.count(DONE) == 3
+        snap = srv.metrics()
+        assert snap["quarantined_devices"] == 0
+        assert snap["counters"]["diverged"] == 1
+        assert sum(s["diverged"] for s in snap["shards"]) == 1
+        srv.close()
+
+
+@needs_mesh
+class TestDeviceFailover:
+    def test_kill_one_device_drill(self):
+        """The headline: a device declared down mid-load loses no
+        requests — displaced work re-queues under original ids onto
+        survivors and streams bytes bitwise equal to no-fault solo
+        runs."""
+        horizon = 24.0
+        seeds = list(range(8))
+        ref = _solo_oracle(seeds, horizon)
+        faults = FaultPlan([
+            {"kind": "device_down", "shard": 1, "occurrence": 2},
+        ])
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=2, window=8, mesh=4,
+            faults=faults,
+        )
+        rids = {
+            s: srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=horizon,
+            ))
+            for s in seeds
+        }
+        srv.run_until_idle(max_ticks=1000)
+        snap = srv.metrics()
+        assert snap["quarantined_devices"] == 1
+        assert snap["shards"][1]["quarantined"]
+        assert snap["counters"]["requeued"] >= 1
+        assert snap["lanes_total"] == 6  # dead shard's 2 lanes gone
+        for s, rid in rids.items():
+            assert srv.status(rid)["status"] == DONE
+            assert srv.tickets[rid].shard != 1
+            _assert_bitwise(srv.result(rid), ref[s], f"seed {s}")
+        # the drained device never schedules again
+        more = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=77, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=200)
+        assert srv.tickets[more].shard != 1
+        srv.close()
+
+    def test_retry_after_excludes_quarantined_lanes(self):
+        """A half-dead mesh must not advertise capacity it cannot
+        schedule: the backpressure hint re-derives from surviving
+        lanes only."""
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8, mesh=2,
+            pipeline="off",
+        )
+        for s in range(6):
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=64.0,
+            ))
+        srv.tick()  # both lanes busy, 4 queued
+        srv.tick()  # a measured window rate exists
+        assert srv.metrics()["lanes_total"] == 2
+        healthy = srv._retry_after()
+        srv.quarantine_device(1, reason="test")
+        assert srv.metrics()["lanes_total"] == 1
+        assert srv.metrics()["quarantined_devices"] == 1
+        # same backlog, half the lanes: the hint must grow
+        assert srv._retry_after() > healthy
+        srv.run_until_idle(max_ticks=1000)
+        srv.close()
+
+    def test_device_watchdog_quarantines_hung_shard(self):
+        """A shard whose window output never polls ready within
+        device_watchdog_s is quarantined and its request completes on
+        a survivor. (Pipelined: the synchronous path blocks through
+        every window inline, so it has no un-observed dispatches for
+        the watchdog to time — by construction.)"""
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8, mesh=2,
+            device_watchdog_s=0.05,
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=64.0,
+        ))
+        srv.tick()  # dispatches on some shard
+        victim = srv.tickets[rid].shard
+        # simulate the hang: the completion poll never turns ready
+        srv._window_ready = lambda shard: False
+        time.sleep(0.06)
+        srv.tick()  # watchdog fires, device quarantined, requeued
+        assert srv.metrics()["quarantined_devices"] == 1
+        assert victim in srv._quarantined
+        del srv._window_ready  # the survivor is healthy
+        srv.run_until_idle(max_ticks=500)
+        assert srv.status(rid)["status"] == DONE
+        assert srv.tickets[rid].shard != victim
+        srv.close()
+
+    def test_hold_rehydrates_from_spill_onto_survivor(self, tmp_path):
+        """A held snapshot whose device dies rehydrates from its
+        durable spill onto a surviving device; the resubmit chain
+        stays bitwise (stochastic composite, so equality means the
+        exact bits came back)."""
+        def chain(out, wal, down):
+            srv = SimServer.single_bucket(
+                "hybrid_cell", capacity=8, lanes=1, window=4, mesh=4,
+                out_dir=str(out), sink="log", recover_dir=str(wal),
+            )
+            parent = srv.submit(ScenarioRequest(
+                composite="hybrid_cell", seed=3, horizon=8.0,
+                hold_state=True,
+            ))
+            srv.run_until_idle(max_ticks=300)
+            pt = srv.tickets[parent]
+            if down:
+                owner = srv.snapshots.shard_of(pt.held_key)
+                srv.quarantine_device(owner, reason="test")
+                assert srv.snapshots.shard_of(pt.held_key) != owner
+            cont = srv.resubmit(parent, 8.0)
+            srv.run_until_idle(max_ticks=300)
+            assert srv.status(cont)["status"] == DONE
+            data = {
+                os.path.basename(p): open(p, "rb").read()
+                for p in glob.glob(os.path.join(str(out), "*.lens"))
+            }
+            srv.close()
+            return data
+
+        ref = chain(tmp_path / "ref", tmp_path / "ref_wal", down=False)
+        got = chain(tmp_path / "cr", tmp_path / "cr_wal", down=True)
+        assert got == ref
+
+    def test_hold_without_spill_is_lost_descriptively(self):
+        """No recover_dir = no spill: quarantining the owner loses the
+        held bits, and resubmit refuses instead of recomputing
+        silently-different state."""
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8, mesh=2,
+        )
+        parent = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=3, horizon=8.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=300)
+        owner = srv.snapshots.shard_of(srv.tickets[parent].held_key)
+        srv.quarantine_device(owner, reason="test")
+        with pytest.raises(ValueError, match="no final state"):
+            srv.resubmit(parent, 8.0)
+        assert srv.snapshots.refs_total() == 0
+        srv.close()
+
+    def test_displaced_continuation_rearms_from_rehydrated_spill(
+        self, tmp_path
+    ):
+        """Kill the device while a continuation is RUNNING on it: the
+        continuation re-queues, re-pins the rehydrated spill, and the
+        chain ends bitwise equal to an undisturbed one."""
+        def chain(out, wal, down):
+            srv = SimServer.single_bucket(
+                "hybrid_cell", capacity=8, lanes=1, window=4, mesh=2,
+                out_dir=str(out), sink="log", recover_dir=str(wal),
+                pipeline="off",
+            )
+            parent = srv.submit(ScenarioRequest(
+                composite="hybrid_cell", seed=5, horizon=8.0,
+                hold_state=True,
+            ))
+            srv.run_until_idle(max_ticks=300)
+            cont = srv.resubmit(parent, 16.0)
+            srv.tick()  # continuation admitted + one window ran
+            ct = srv.tickets[cont]
+            if down:
+                assert ct.status == "running"
+                srv.quarantine_device(ct.shard, reason="test")
+            srv.run_until_idle(max_ticks=300)
+            assert srv.status(cont)["status"] == DONE
+            data = {
+                os.path.basename(p): open(p, "rb").read()
+                for p in glob.glob(os.path.join(str(out), "*.lens"))
+            }
+            srv.close()
+            return data
+
+        ref = chain(tmp_path / "ref", tmp_path / "ref_wal", down=False)
+        got = chain(tmp_path / "cr", tmp_path / "cr_wal", down=True)
+        assert got == ref
+
+    def test_prefix_forks_survive_owner_death(self):
+        """Forks whose cached prefix died with its device (no spill)
+        re-resolve: a fresh prefix run on a survivor, same bytes."""
+        horizon, prefix_h = 24.0, 8.0
+        mk = lambda seed_off: ScenarioRequest(
+            composite="toggle_colony", seed=3, horizon=horizon,
+            prefix={"horizon": prefix_h},
+            overrides={"global": {"volume": 1.1 + 0.1 * seed_off}},
+        )
+        # reference: no faults, single device
+        ref_srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8,
+        )
+        refs = {}
+        for i in range(3):
+            rid = ref_srv.submit(mk(i))
+            ref_srv.run_until_idle(max_ticks=500)
+            refs[i] = ref_srv.result(rid)
+        ref_srv.close()
+
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8, mesh=2,
+        )
+        first = srv.submit(mk(0))
+        srv.run_until_idle(max_ticks=500)
+        _assert_bitwise(srv.result(first), refs[0], "first fork")
+        owner = srv.snapshots.shard_of(srv.tickets[first].prefix_key)
+        srv.quarantine_device(owner, reason="test")
+        later = [srv.submit(mk(i)) for i in (1, 2)]
+        srv.run_until_idle(max_ticks=500)
+        for i, rid in zip((1, 2), later):
+            assert srv.status(rid)["status"] == DONE
+            _assert_bitwise(srv.result(rid), refs[i], f"fork {i}")
+        # the re-run prefix was a MISS (the cached tree died)
+        assert srv.metrics()["counters"]["prefix_misses"] == 2
+        srv.close()
+
+    def test_all_devices_down_fails_fast(self):
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=1, window=8, mesh=2,
+            pipeline="off",
+        )
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=64.0,
+            ))
+            for s in range(3)
+        ]
+        srv.tick()
+        srv.quarantine_device(0, reason="test")
+        srv.quarantine_device(1, reason="test")
+        srv.run_until_idle(max_ticks=50)
+        for rid in rids:
+            st = srv.status(rid)
+            assert st["status"] == FAILED
+            assert "quarantined" in st["error"]
+        with pytest.raises(ValueError, match="quarantined"):
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=9, horizon=8.0,
+            ))
+        srv.close()
+
+
+@needs_mesh
+@pytest.mark.slow
+class TestKillOneDeviceExhaustive:
+    """The exhaustive drill: every victim device, several kill times,
+    under load — every request completes, bytes pinned against the
+    solo oracle."""
+
+    @pytest.mark.parametrize("victim", [0, 1, 2, 3])
+    @pytest.mark.parametrize("occurrence", [1, 2, 4])
+    def test_down_any_device_any_time(self, victim, occurrence):
+        horizon = 24.0
+        seeds = list(range(8))
+        ref = _solo_oracle(seeds, horizon)
+        srv = SimServer.single_bucket(
+            "toggle_colony", capacity=16, lanes=2, window=8, mesh=4,
+            faults=FaultPlan([{
+                "kind": "device_down", "shard": victim,
+                "occurrence": occurrence,
+            }]),
+        )
+        rids = {
+            s: srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=horizon,
+            ))
+            for s in seeds
+        }
+        srv.run_until_idle(max_ticks=1000)
+        assert srv.metrics()["quarantined_devices"] == 1
+        for s, rid in rids.items():
+            assert srv.status(rid)["status"] == DONE
+            _assert_bitwise(
+                srv.result(rid), ref[s],
+                f"victim {victim} occ {occurrence} seed {s}",
+            )
+        srv.close()
+
+
+# -- subprocess drills: real processes, real SIGKILLs, own env -----------
+
+
+def _run_cli(args, cwd, expect_kill=False, timeout=300):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "lens_tpu", "serve", *args],
+        cwd=cwd, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    else:
+        assert proc.returncode == 0, (
+            f"rc={proc.returncode}\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}"
+        )
+    return proc
+
+
+def _lens_bytes(out_dir):
+    return {
+        os.path.basename(p): open(p, "rb").read()
+        for p in glob.glob(os.path.join(str(out_dir), "*.lens"))
+    }
+
+
+@pytest.fixture(scope="module")
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+_MESH_REQS = [
+    {"seed": 1, "horizon": 24.0, "hold_state": True},
+    {"seed": 2, "horizon": 24.0, "prefix": {"horizon": 8.0},
+     "overrides": {"global": {"volume": 1.1}}},
+    {"seed": 3, "horizon": 16.0},
+    {"seed": 4, "horizon": 16.0},
+]
+
+
+def _mesh_kill_roundtrip(tmp_path, repo_root, seam, composite,
+                         extra_flags=()):
+    """SIGKILL a real 4-device serve process at ``seam``, recover over
+    the same dir, and return (reference bytes, recovered bytes)."""
+    reqs = tmp_path / "reqs.json"
+    reqs.write_text(json.dumps(_MESH_REQS))
+    base = [
+        "--composite", composite, "--capacity", "8", "--lanes", "1",
+        "--window", "4", "--mesh", "4", "--requests", str(reqs),
+        *extra_flags,
+    ]
+    tag = seam.replace(".", "_")
+    ref_out = tmp_path / f"ref_{tag}"
+    _run_cli(
+        base + ["--out-dir", str(ref_out),
+                "--recover-dir", str(tmp_path / f"ref_wal_{tag}")],
+        repo_root,
+    )
+    out = tmp_path / f"out_{tag}"
+    wal = tmp_path / f"wal_{tag}"
+    faults = tmp_path / f"faults_{tag}.json"
+    faults.write_text(json.dumps([{"kind": "kill", "at": seam}]))
+    _run_cli(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal),
+                "--faults", str(faults)],
+        repo_root, expect_kill=True,
+    )
+    # the killed multi-shard server left per-shard WALs to merge
+    assert os.path.exists(str(wal / "serve.wal"))
+    _run_cli(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal)],
+        repo_root,
+    )
+    return _lens_bytes(ref_out), _lens_bytes(out)
+
+
+@pytest.mark.slow
+class TestMultiShardRecovery:
+    """A SIGKILLed MULTI-SHARD server recovers from its merged
+    per-shard WALs byte-equal to an uninterrupted run. Slow tier:
+    three real CLI subprocesses (~a minute of jax startups) — the
+    quick signal for the same machinery is the in-process failover
+    drills above plus test_recovery's single-WAL SIGKILL roundtrip;
+    the WAL merge ordering itself is unit-pinned in TestShardedWal."""
+
+    def test_sigkill_mesh_recovers_bitwise(self, tmp_path, repo_root):
+        ref, got = _mesh_kill_roundtrip(
+            tmp_path, repo_root, "retired.walled", "toggle_colony"
+        )
+        assert ref, "reference run produced no logs?"
+        assert set(ref) <= set(got)
+        for name, data in ref.items():
+            assert got[name] == data, f"{name} differs after recovery"
+
+
+@pytest.mark.slow
+class TestMultiShardRecoveryExhaustive:
+    """SIGKILL the 4-device server at every CLI-reachable kill seam,
+    stochastic composite — the mesh extension of the round-12 sweep."""
+
+    @pytest.mark.parametrize(
+        "seam",
+        ["submit.walled", "admitted", "window.dispatched",
+         "hold.spilled", "streamed.walled"],
+    )
+    def test_kill_everywhere_recovers_bitwise(
+        self, tmp_path, repo_root, seam
+    ):
+        ref, got = _mesh_kill_roundtrip(
+            tmp_path, repo_root, seam, "hybrid_cell",
+            extra_flags=("--check-finite", "window"),
+        )
+        assert ref, "reference run produced no logs?"
+        for name, data in ref.items():
+            assert got[name] == data, f"{name} differs after {seam}"
