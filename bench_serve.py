@@ -31,6 +31,12 @@ wraps. Three kinds of record, written to ``BENCH_SERVE_CPU_r10.json``
    sweep A/B: the same trial list through ``lens_tpu.sweep`` with and
    without the spec's ``warmup`` block.
 
+4. **Observability A/B** (``--trace`` mode, round 14, written to
+   ``BENCH_OBS_CPU_r14.json``): the same saturated round with span
+   tracing + every-tick metrics sampling on vs off — the overhead
+   contract of docs/observability.md (on <= 2%, off bitwise equal,
+   pinned by a byte-equal request on both servers).
+
 Composite: ``toggle_colony`` (config-1 cell; deterministic, light
 biology) — the point is to measure the SERVING machinery, not the
 biology, so the cheapest real composite gives the most sensitive
@@ -629,6 +635,123 @@ def run_prefix_bench(args) -> int:
     return 0
 
 
+def trace_ab(
+    composite: str, capacity: int, lanes: int, window: int,
+    emit_every: int, horizon_steps: int, fill_rounds: int, reps: int,
+    tmp_root: str,
+):
+    """Round-14 observability overhead A/B at one lane count: the same
+    saturated round through two warmed servers — ``off`` (no tracing,
+    the bitwise round-13 path) and ``trace`` (``trace_dir`` span
+    tracing + ``metrics_interval_s=0`` sampling every tick, the
+    worst-case observability load). Interleaved min-of-reps; the
+    overhead column is the acceptance bar (docs/observability.md
+    pins <= 2%). A bitwise pin rides along: one request served on each
+    server must produce identical bytes — tracing observes, never
+    perturbs."""
+    import os
+
+    n = fill_rounds * lanes
+    trace_dir = os.path.join(tmp_root, f"trace_{lanes}")
+    servers = {
+        "off": SimServer.single_bucket(
+            composite, capacity=capacity, lanes=lanes, window=window,
+            emit_every=emit_every, queue_depth=max(2 * n, 16),
+        ),
+        "trace": SimServer.single_bucket(
+            composite, capacity=capacity, lanes=lanes, window=window,
+            emit_every=emit_every, queue_depth=max(2 * n, 16),
+            trace_dir=trace_dir, metrics_interval_s=0.0,
+        ),
+    }
+    for srv in servers.values():
+        _warm(srv, composite, lanes, window)
+
+    # bitwise pin: the same request on both servers, byte-equal
+    pin = {}
+    for mode, srv in servers.items():
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=77,
+            horizon=float(horizon_steps),
+        ))
+        srv.run_until_idle(max_ticks=10_000)
+        pin[mode] = _flat_bytes(srv.result(rid))
+        srv.reset_samples()
+    bitwise = pin["off"] == pin["trace"]
+
+    walls = {mode: float("inf") for mode in servers}
+    for rep in range(reps):
+        for mode, srv in servers.items():
+            wall = _serve_round(
+                srv, composite, n, horizon_steps,
+                seed0=100 + rep * len(servers) * n,
+            )
+            walls[mode] = min(walls[mode], wall)
+    events = servers["trace"].trace.events_emitted
+    retraces = max(s.metrics()["retraces"] for s in servers.values())
+    for srv in servers.values():
+        srv.close()
+    ring = os.path.join(trace_dir, "metrics.jsonl")
+    samples = sum(1 for _ in open(ring)) if os.path.exists(ring) else 0
+    return {
+        "lanes": lanes,
+        "n_requests": n,
+        "horizon_steps": horizon_steps,
+        "walls_s": {m: round(w, 4) for m, w in walls.items()},
+        "served_row_steps_s": {
+            m: round(n * horizon_steps * capacity / w)
+            for m, w in walls.items()
+        },
+        "trace_overhead": round(walls["trace"] / walls["off"] - 1, 4),
+        "trace_events": events,
+        "metrics_samples": samples,
+        "bitwise_off_equals_traced": bool(bitwise),
+        "retraces": retraces,
+    }
+
+
+def run_trace_bench(args) -> int:
+    import tempfile
+
+    horizon_steps = args.horizon_windows * args.window
+    record = {
+        "bench": "serve_trace",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "reps": args.reps,
+        "protocol": "interleaved min-of-reps across two warmed "
+        "servers (tracing+metrics-sampling off vs on, sampling every "
+        "tick); overhead vs the off server; one request pinned "
+        "byte-equal across both (tracing observes, never perturbs)",
+        "trace_ab": [],
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        for lanes in args.lanes:
+            row = trace_ab(
+                args.composite, args.capacity, lanes, args.window,
+                args.emit_every, horizon_steps, args.fill_rounds,
+                args.reps, tmp,
+            )
+            record["trace_ab"].append(row)
+            print(json.dumps(row), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    worst = max(e["trace_overhead"] for e in record["trace_ab"])
+    ok = all(
+        e["bitwise_off_equals_traced"] for e in record["trace_ab"]
+    )
+    print(
+        f"worst tracing+metrics overhead: {worst * 100:.1f}% "
+        f"(acceptance <= 2%); bitwise pins green: {ok}"
+    )
+    return 0 if ok else 1
+
+
 def _flat_bytes(tree):
     """A result tree as {joined-path: bytes} for bitwise pins."""
     from lens_tpu.utils.dicts import flatten_paths
@@ -896,6 +1019,13 @@ def main() -> int:
         "--out is given; --lanes sets lanes PER SHARD (default 2)",
     )
     p.add_argument(
+        "--trace", action="store_true",
+        help="run the round-14 observability overhead A/B (span "
+        "tracing + every-tick metrics sampling, on vs off, per lane "
+        "count, with a byte-equal pin; writes BENCH_OBS_CPU_r14.json "
+        "unless --out is given)",
+    )
+    p.add_argument(
         "--prefix-frac", type=float, default=0.75,
         help="shared-prefix fraction of the horizon (fork A/B), "
         "snapped to whole windows",
@@ -914,12 +1044,18 @@ def main() -> int:
 
     # per-mode defaults (None = not explicitly passed)
     if sum(
-        1 for m in (args.prefix, args.faults, args.mesh is not None)
+        1 for m in (args.prefix, args.faults, args.mesh is not None,
+                    args.trace)
         if m
     ) > 1:
         raise SystemExit(
-            "--prefix / --faults / --mesh are separate modes"
+            "--prefix / --faults / --mesh / --trace are separate modes"
         )
+    if args.trace:
+        args.out = args.out or "BENCH_OBS_CPU_r14.json"
+        args.lanes = args.lanes or [2, 4, 8]
+        args.horizon_windows = args.horizon_windows or 6
+        return run_trace_bench(args)
     if args.mesh is not None:
         args.mesh = args.mesh or [2, 4, 8]
         args.out = args.out or "BENCH_MESH_CPU_r13.json"

@@ -2,7 +2,7 @@
 
 Replaces the reference's control/boot command surface
 (``python -m lens.actor.control experiment --number N ...``, boot scripts;
-reconstructed SURVEY.md §1 L5, §3.1) with seven commands against the
+reconstructed SURVEY.md §1 L5, §3.1) with eight commands against the
 experiment layer:
 
 - ``run``     start an experiment from a composite name + JSON config
@@ -14,6 +14,9 @@ experiment layer:
   spec: grid/random/LHS spaces, scalar objectives, successive-halving
   early stopping, crash-safe ledger resume (lens_tpu.sweep; see
   docs/sweeps.md)
+- ``trace``   convert a serve span log (``serve --trace-dir``) to
+  Chrome/Perfetto trace-event JSON (lens_tpu.obs; see
+  docs/observability.md)
 - ``list``    show registered composites, processes, emitters
 - ``demo``    step ONE process standalone and plot it (the reference's
   per-process ``__main__`` dev harness)
@@ -272,6 +275,40 @@ def _build_parser() -> argparse.ArgumentParser:
         '"req-000001", "after_steps": 16}, ...]} — deterministic '
         "chaos for tests/CI (docs/serving.md, 'Fault injection')",
     )
+    serve.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="span tracing: append every request stage (queue wait, "
+        "admission, window dispatch, device compute, streamer flush, "
+        "retire, prefix resolution, spills, quarantines) to "
+        "DIR/serve.trace; convert with 'python -m lens_tpu trace DIR "
+        "--out trace.json' for Perfetto (docs/observability.md). "
+        "Default: tracing off (the bitwise-identical fast path)",
+    )
+    serve.add_argument(
+        "--metrics-interval", type=float, default=None,
+        metavar="SECONDS",
+        help="sample server counters/gauges/latency histograms into a "
+        "metrics.jsonl time-series ring (in --trace-dir, else "
+        "--out-dir) every this many wall seconds; default: no "
+        "sampling",
+    )
+
+    trace = sub.add_parser(
+        "trace",
+        help="convert a serve span log (--trace-dir) to Chrome/"
+        "Perfetto trace-event JSON (docs/observability.md)",
+    )
+    trace.add_argument(
+        "trace",
+        help="the --trace-dir a server wrote (or the serve.trace file "
+        "inside it)",
+    )
+    trace.add_argument(
+        "--out", default=None, metavar="JSON",
+        help="output path for the Chrome trace-event JSON (default: "
+        "trace.json beside the span log); load it at "
+        "https://ui.perfetto.dev or chrome://tracing",
+    )
 
     sweep = sub.add_parser(
         "sweep",
@@ -466,6 +503,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         faults=faults,
         mesh=args.mesh,
         device_watchdog_s=args.device_watchdog,
+        trace_dir=args.trace_dir,
+        metrics_interval_s=args.metrics_interval,
     )
     with server:
         if server.recovered or any(
@@ -569,6 +608,56 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print(f"meta:    {args.out_dir}/server_meta.json")
         if args.recover_dir:
             print(f"wal:     {args.recover_dir}/serve.wal")
+        if args.trace_dir:
+            print(
+                f"trace:   {args.trace_dir}/serve.trace (render: "
+                f"python -m lens_tpu trace {args.trace_dir})"
+            )
+        if args.metrics_interval is not None:
+            print(
+                f"metrics: "
+                f"{args.trace_dir or args.out_dir}/metrics.jsonl"
+            )
+    return 0
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """Convert a serve span log to Chrome trace-event JSON (jax-free:
+    the span log is framed JSON, the converter pure Python)."""
+    import os
+
+    from lens_tpu.obs.trace import TRACE_NAME, chrome_trace, read_trace
+
+    path = args.trace
+    if os.path.isdir(path):
+        path = os.path.join(path, TRACE_NAME)
+    if not os.path.exists(path):
+        print(
+            f"no span log at {path!r} (serve with --trace-dir to "
+            f"produce one)",
+            file=sys.stderr,
+        )
+        return 2
+    events = read_trace(path)
+    out = args.out or os.path.join(os.path.dirname(path), "trace.json")
+    rendered = chrome_trace(events)
+    with open(out, "w") as f:
+        json.dump(rendered, f)
+    spans = sum(1 for e in events if e.get("ev") == "span")
+    names: dict = {}
+    for e in events:
+        names[e.get("name")] = names.get(e.get("name"), 0) + 1
+    wall = max((e.get("ts", 0.0) + e.get("dur", 0.0) for e in events),
+               default=0.0)
+    top = ", ".join(
+        f"{n}x{c}"
+        for n, c in sorted(names.items(), key=lambda kv: -kv[1])[:8]
+    )
+    print(
+        f"{len(events)} events ({spans} spans) over {wall:.3f}s: {top}"
+    )
+    print(f"chrome trace: {out}")
+    print("view: https://ui.perfetto.dev (open trace file)")
     return 0
 
 
@@ -694,6 +783,9 @@ def main(argv=None) -> int:
 
     if args.command == "serve":
         return _cmd_serve(args)
+
+    if args.command == "trace":
+        return _cmd_trace(args)
 
     if args.command == "sweep":
         return _cmd_sweep(args)

@@ -253,11 +253,42 @@ class JsonFrameLog:
     serve WAL's group-commit policy — one fsync per scheduler tick
     covers every append since the last, and because appends are
     sequential a sync always makes a clean PREFIX durable).
+
+    ``buffered=True`` drops even the per-append flush: frames sit in
+    the user-space stdio buffer until it fills, :meth:`sync`, or
+    :meth:`close` (which flushes). The observability policy — the serve
+    span tracer (``lens_tpu.obs.trace``) rides this: a trace must not
+    tax the hot path for durability it does not need, a kill loses at
+    most the buffered tail, and the framing's truncation tolerance
+    makes the survivors readable. Durable logs (the ledger, the WAL)
+    must NOT set it.
+
+    ``retain=False`` makes the log WRITE-ONLY: appends are framed to
+    disk but not accumulated in ``events`` — without it a long-running
+    emitter (the span tracer again) would grow one retained dict per
+    event for the process lifetime. ``truncate=True`` starts the file
+    fresh instead of replaying + appending (the tracer's policy: a
+    trace describes ONE server run; replaying a prior run's events
+    into RAM to append after them would be both a leak and a lie).
+    Durable replayed logs keep the defaults.
     """
 
-    def __init__(self, path: str, fsync_every: bool = True):
+    def __init__(
+        self, path: str, fsync_every: bool = True,
+        buffered: bool = False, retain: bool = True,
+        truncate: bool = False,
+    ):
         self.path = path
         self.fsync_every = bool(fsync_every)
+        self.buffered = bool(buffered)
+        self.retain = bool(retain)
+        if self.buffered and self.fsync_every:
+            raise ValueError(
+                "buffered=True contradicts fsync_every=True: a log "
+                "cannot both defer flushes and fsync per append"
+            )
+        if truncate and os.path.exists(path):
+            os.remove(path)
         self.events: List[Dict[str, Any]] = []
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
@@ -288,10 +319,12 @@ class JsonFrameLog:
         event = dict(event)
         payload = json.dumps(event, sort_keys=True, default=float).encode()
         self._file.write(frame(payload))
-        self._file.flush()
-        if self.fsync_every:
-            os.fsync(self._file.fileno())
-        self.events.append(event)
+        if not self.buffered:
+            self._file.flush()
+            if self.fsync_every:
+                os.fsync(self._file.fileno())
+        if self.retain:
+            self.events.append(event)
         return event
 
     def sync(self) -> None:

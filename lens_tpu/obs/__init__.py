@@ -1,0 +1,57 @@
+"""lens_tpu.obs: tracing + metrics for the serving stack.
+
+Two halves, one goal — turn "the server finished and here is a number"
+into "here is what every request did, when, on which device, and here
+is every health gauge as history":
+
+- :mod:`lens_tpu.obs.trace` — structured span events on the repo's
+  framed-JSON log discipline, emitted by the serve pipeline when
+  ``trace_dir`` is set, converted to Chrome/Perfetto trace-event JSON
+  by :func:`chrome_trace` / ``python -m lens_tpu trace``.
+- :mod:`lens_tpu.obs.metrics` — counter/gauge/histogram instruments
+  (:class:`MetricsRegistry`), a ``metrics.jsonl`` time-series ring
+  (:class:`MetricsRing`), and Prometheus text exposition.
+
+See docs/observability.md for the span taxonomy, event schema, and the
+overhead contract (off = bitwise identical, on = within noise).
+"""
+
+from lens_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsRing,
+    percentiles,
+)
+from lens_tpu.obs.trace import (
+    REQUEST_TRACK,
+    SCHED_TRACK,
+    STREAM_TRACK,
+    SWEEP_TRACK,
+    TRACE_NAME,
+    NullTracer,
+    Tracer,
+    chrome_trace,
+    device_track,
+    read_trace,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsRing",
+    "NullTracer",
+    "REQUEST_TRACK",
+    "SCHED_TRACK",
+    "STREAM_TRACK",
+    "SWEEP_TRACK",
+    "TRACE_NAME",
+    "Tracer",
+    "chrome_trace",
+    "device_track",
+    "percentiles",
+    "read_trace",
+]

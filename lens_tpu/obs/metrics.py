@@ -1,0 +1,346 @@
+"""Metrics instruments: counters, gauges, histograms, a sampled ring.
+
+Before round 14 the serving metrics were a hand-rolled pile of ints and
+lists inside ``ServerMetrics`` — readable only as one point-in-time
+snapshot, mutated from two threads with no lock, and exportable only as
+the JSON blob ``snapshot()`` happened to build. This module factors the
+pile into the three standard instrument kinds every metrics system
+(Prometheus, OpenTelemetry) converges on, plus the two read surfaces
+the repo needs:
+
+- :class:`MetricsRegistry` — named :class:`Counter` (monotonic),
+  :class:`Gauge` (set or computed-at-read), and :class:`Histogram`
+  (locked sample buffer with percentile reads) instruments.
+  ``sample()`` renders one time-series point; ``prometheus_text()``
+  renders the standard text exposition format for a pull scraper.
+- :class:`MetricsRing` — an append-only ``metrics.jsonl`` file with
+  ring semantics (bounded records, oldest rewritten away), giving
+  occupancy/queue-depth/latency HISTORY instead of one final number:
+  ``jq``-able, plottable, tailable while the server runs.
+
+Everything here is host-side plain Python; nothing imports jax.
+Thread-safety: counters are single-writer-per-name by convention (the
+scheduler), histograms lock internally (the stream thread observes
+latency samples while the scheduler reads percentiles — the round-14
+fix for the ``reset_samples``-vs-``tick`` race), gauges are reads of
+single attributes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+def percentiles(
+    samples, points=(50.0, 95.0, 99.0)
+) -> Dict[str, Optional[float]]:
+    """{"p50": ..., "p95": ..., "p99": ...} by linear interpolation —
+    tiny and dependency-free so metrics never import numpy for three
+    numbers. Empty input yields ``None`` entries (a server that served
+    nothing has no latency, not a zero latency)."""
+    out: Dict[str, Optional[float]] = {}
+    ordered = sorted(samples)
+    for p in points:
+        key = f"p{p:g}"
+        if not ordered:
+            out[key] = None
+            continue
+        rank = (len(ordered) - 1) * (p / 100.0)
+        lo = int(rank)
+        hi = min(lo + 1, len(ordered) - 1)
+        out[key] = ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
+    return out
+
+
+class Counter:
+    """A monotonic counter. One writer (the scheduler) by convention;
+    int increments are atomic enough under the GIL for the read side,
+    and the registry's sample/export paths only read."""
+
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0
+
+    def inc(self, by: int = 1) -> None:
+        self.value += by
+
+
+class Gauge:
+    """A point-in-time value: either ``set()`` by the owner or computed
+    at read time from a callable (``fn``) — the "recompute at call"
+    semantics ``SimServer.metrics()`` promises (a gauge read mid-run
+    reflects NOW, not the last tick)."""
+
+    __slots__ = ("name", "help", "fn", "_value")
+
+    def __init__(
+        self, name: str, help: str = "",
+        fn: Optional[Callable[[], Any]] = None,
+    ):
+        self.name = name
+        self.help = help
+        self.fn = fn
+        self._value: Any = 0
+
+    def set(self, value: Any) -> None:
+        self._value = value
+
+    def read(self) -> Any:
+        if self.fn is not None:
+            return self.fn()
+        return self._value
+
+
+class Histogram:
+    """A locked sample buffer with list-ish ergonomics.
+
+    Writers ``observe()`` (``append`` is an alias — the pre-round-14
+    call sites read naturally); readers take consistent copies
+    (``values()``) or percentile summaries; ``clear()`` drops samples
+    atomically. The internal lock is the round-14 fix for the
+    ``reset_samples()``-vs-concurrent-``tick()``/stream-thread race:
+    every mutation and every percentile read holds it, so a mid-reset
+    reader sees either the old buffer or the empty one, never a
+    half-cleared list mid-sort.
+    """
+
+    __slots__ = ("name", "help", "_samples", "_lock", "_sum", "_count")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._samples: List[float] = []
+        self._lock = threading.Lock()
+        self._sum = 0.0
+        self._count = 0  # lifetime observations (survives clear())
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._samples.append(value)
+            self._sum += value
+            self._count += 1
+
+    append = observe
+
+    def clear(self) -> None:
+        """Drop buffered samples (lifetime count/sum stay — they are
+        the monotonic export; the buffer is the percentile window)."""
+        with self._lock:
+            self._samples.clear()
+
+    def values(self) -> List[float]:
+        with self._lock:
+            return list(self._samples)
+
+    def tail(self, n: int) -> List[float]:
+        with self._lock:
+            return self._samples[-n:]
+
+    def percentiles(self, points=(50.0, 95.0, 99.0)):
+        with self._lock:
+            ordered = list(self._samples)
+        return percentiles(ordered, points)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def __iter__(self):
+        return iter(self.values())
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+
+class MetricsRegistry:
+    """Named instruments + the two export surfaces.
+
+    ``namespace`` prefixes every exported metric name
+    (``lens_serve_submitted_total``). Instrument factories are
+    idempotent by name — asking twice returns the same instrument, a
+    kind clash raises (one name, one meaning).
+    """
+
+    def __init__(self, namespace: str = "lens"):
+        self.namespace = namespace
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def _claim(self, name: str, kind: Dict[str, Any]) -> None:
+        for pool in (self.counters, self.gauges, self.histograms):
+            if pool is not kind and name in pool:
+                raise ValueError(
+                    f"metric {name!r} already registered as a "
+                    f"different instrument kind"
+                )
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        self._claim(name, self.counters)
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name, help)
+        return c
+
+    def gauge(
+        self, name: str, help: str = "",
+        fn: Optional[Callable[[], Any]] = None,
+    ) -> Gauge:
+        self._claim(name, self.gauges)
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name, help, fn)
+        elif fn is not None:
+            g.fn = fn
+        return g
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        self._claim(name, self.histograms)
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(name, help)
+        return h
+
+    def sample(self) -> Dict[str, Any]:
+        """One time-series point: every counter's value, every gauge
+        read NOW, every histogram's count/sum + buffered percentiles.
+        The ``metrics.jsonl`` record shape (plus the caller's
+        timestamp)."""
+        return {
+            "counters": {
+                name: c.value for name, c in self.counters.items()
+            },
+            "gauges": {
+                name: g.read() for name, g in self.gauges.items()
+            },
+            "histograms": {
+                name: {
+                    "count": h.count,
+                    "sum": h.sum,
+                    "buffered": len(h),
+                    **h.percentiles(),
+                }
+                for name, h in self.histograms.items()
+            },
+        }
+
+    def prometheus_text(self) -> str:
+        """The Prometheus text exposition format (version 0.0.4) —
+        what a scraper GETs. Counters export as ``_total``, histograms
+        as summaries (quantile series + ``_count``/``_sum``). Gauges
+        whose read is not a number are skipped (device names, shard
+        dicts — those belong to the JSON surfaces)."""
+        ns = self.namespace
+        lines: List[str] = []
+        for name, c in sorted(self.counters.items()):
+            full = f"{ns}_{name}_total"
+            if c.help:
+                lines.append(f"# HELP {full} {c.help}")
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {c.value}")
+        for name, g in sorted(self.gauges.items()):
+            value = g.read()
+            if isinstance(value, bool) or not isinstance(
+                value, (int, float)
+            ):
+                continue
+            full = f"{ns}_{name}"
+            if g.help:
+                lines.append(f"# HELP {full} {g.help}")
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {value}")
+        for name, h in sorted(self.histograms.items()):
+            full = f"{ns}_{name}"
+            if h.help:
+                lines.append(f"# HELP {full} {h.help}")
+            lines.append(f"# TYPE {full} summary")
+            for point, value in h.percentiles().items():
+                if value is None:
+                    continue
+                q = float(point[1:]) / 100.0
+                lines.append(f'{full}{{quantile="{q:g}"}} {value}')
+            lines.append(f"{full}_count {h.count}")
+            lines.append(f"{full}_sum {h.sum}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsRing:
+    """``metrics.jsonl``: one JSON object per line, ring-bounded.
+
+    Append-only on the hot path (one line + flush per sample — the
+    sampling CADENCE, not the tick rate, so seconds apart); when the
+    file exceeds ``2 * max_records`` lines it is compacted in place to
+    the newest ``max_records`` (tmp + rename, so a reader never sees a
+    torn file). JSONL over the framed-log format on purpose: metrics
+    history is for humans and ``jq``/pandas, not for crash recovery —
+    greppability beats CRC framing here.
+    """
+
+    def __init__(self, path: str, max_records: int = 4096):
+        if max_records < 1:
+            raise ValueError(
+                f"max_records={max_records} must be >= 1"
+            )
+        self.path = path
+        self.max_records = int(max_records)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._count = 0
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                self._count = sum(1 for _ in f)
+        self._file = open(path, "a")
+
+    def append(self, record: Dict[str, Any]) -> None:
+        self._file.write(json.dumps(record, default=float) + "\n")
+        self._file.flush()
+        self._count += 1
+        if self._count > 2 * self.max_records:
+            self._compact()
+
+    def _compact(self) -> None:
+        self._file.close()
+        with open(self.path) as f:
+            lines = f.readlines()[-self.max_records:]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.writelines(lines)
+        os.replace(tmp, self.path)
+        self._count = len(lines)
+        self._file = open(self.path, "a")
+
+    def records(self) -> List[Dict[str, Any]]:
+        """Read the ring back (skips a torn final line, if the process
+        died mid-append)."""
+        self._file.flush()
+        out: List[Dict[str, Any]] = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail from a kill mid-append
+        return out
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None

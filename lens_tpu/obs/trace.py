@@ -1,0 +1,333 @@
+"""Span tracing for the serve pipeline: what happened, when, where.
+
+The serving stack went production-shaped — pipelined windows, prefix
+forking, WAL recovery, mesh failover — with only end-of-run numbers to
+show for it: a ``ServerMetrics`` snapshot and bench columns. Those
+answer "how fast on average", never "why was THIS request slow" or
+"what did the scheduler do while device 1 died". The inference stacks
+this repo borrows its serving shape from treat per-request span traces
+as the substrate for every scheduling and SLO decision; this module is
+that substrate for simulation serving.
+
+A :class:`Tracer` appends small structured events to a framed-JSON log
+(the same :class:`~lens_tpu.emit.log.JsonFrameLog` discipline as the
+WAL and the sweep ledger — magic + CRC framing, a torn tail is lost
+cleanly, replay is just reading). Two event shapes:
+
+- **span**: a named interval ``{ev: "span", name, track, ts, dur,
+  args}`` — a window's device compute, a sink flush, an admission
+  scatter, a hold spill. ``ts`` is seconds since the tracer's epoch,
+  ``dur`` seconds. Spans carrying an ``aid`` (async id) may overlap
+  freely on one track (a request's queue wait, a sweep trial); plain
+  spans on one track are emitted by one thread and nest.
+- **instant**: a named point ``{ev: "instant", name, track, ts,
+  args}`` — a retirement, a prefix-cache hit, a device quarantine, an
+  injected fault.
+
+Correlation rides ``args``: every serve event carries the request id
+(``rid``), scheduler tick (``tick``), and device shard (``shard``)
+that apply, so a timeline groks "this request waited 3 windows behind
+that one's streamer backpressure on shard 2".
+
+Overhead contract (docs/observability.md): tracing OFF is a
+:class:`NullTracer` — falsy, every method a no-op — and the traced
+code paths are written to compute nothing extra behind ``if tracer:``
+guards, so the untraced server is the round-13 server bit for bit.
+Tracing ON costs one dict + one JSON encode + one buffered write per
+event, a handful of events per window — pinned ≤2% on ``bench_serve
+--trace`` (BENCH_OBS_CPU_r14.json). The trace file is buffered
+(no per-event flush/fsync): observability must never tax the serving
+hot path for durability it does not need — a crash loses at most the
+buffered tail, and the WAL (not the trace) is the recovery record.
+
+Conversion: :func:`chrome_trace` renders a span log as Chrome
+trace-event JSON — load it in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing`` to see the depth-2 pipeline, streamer
+backpressure, and a kill-one-device drill on a real timeline.
+``python -m lens_tpu trace <dir> --out trace.json`` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional
+
+from lens_tpu.emit.log import JsonFrameLog, iter_frames
+
+#: The span log's file name inside a ``--trace-dir``.
+TRACE_NAME = "serve.trace"
+
+#: Track names the serve pipeline emits on (docs/observability.md).
+#: A track is a horizontal lane on the rendered timeline: one per
+#: logical actor, so concurrent actors never visually nest.
+SCHED_TRACK = "scheduler"      # the tick loop's own work
+STREAM_TRACK = "streamer"      # background sink slicing/appends
+REQUEST_TRACK = "requests"     # per-request async spans (queue wait)
+SWEEP_TRACK = "sweep"          # per-trial spans (sweep driver)
+
+
+def device_track(shard: int) -> str:
+    """The per-device-shard track (window compute + host copy)."""
+    return f"device:{int(shard)}"
+
+
+class NullTracer:
+    """The tracing-off tracer: falsy, every method a no-op.
+
+    Handed out wherever a real :class:`Tracer` could go, so
+    instrumented code never branches on ``is None`` — it either calls
+    cheap no-ops or guards genuinely extra work behind ``if tracer:``.
+    """
+
+    enabled = False
+
+    def __bool__(self) -> bool:
+        return False
+
+    @staticmethod
+    def now() -> float:
+        return 0.0
+
+    def emit_span(self, name: str, t0: float, t1: float, **kw) -> None:
+        pass
+
+    def instant(self, name: str, **kw) -> None:
+        pass
+
+    @contextmanager
+    def span(self, name: str, **kw):
+        yield
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class Tracer:
+    """Thread-safe span/instant emitter over one framed-JSON file.
+
+    ``path`` is the span log (conventionally ``<trace_dir>/serve.trace``).
+    Events are framed + buffered (no per-event flush); ``flush()``
+    pushes to the OS, ``close()`` flushes and closes. All timestamps
+    are ``time.perf_counter()`` seconds, stored relative to the
+    tracer's construction epoch — callers pass absolute perf_counter
+    values (the clock the server already stamps everything with) and
+    the tracer normalizes.
+
+    Thread safety: the scheduler thread, the stream thread, and the
+    log-writer threads may all emit; one lock serializes appends (an
+    event is one small frame — contention is negligible next to the
+    JSON encode each caller pays outside any lock... the encode happens
+    inside ``JsonFrameLog.append``, so it is under the lock; at tens of
+    events per window this is nanoseconds against a millisecond
+    window).
+    """
+
+    enabled = True
+
+    def __init__(self, path: str):
+        self.path = path
+        # write-only + fresh file: a trace describes ONE server run,
+        # and a long-lived traced server must not retain (or replay)
+        # an unbounded event list in RAM — the on-disk log is the
+        # record, read back by read_trace()/the trace CLI
+        self._log = JsonFrameLog(
+            path, fsync_every=False, buffered=True,
+            retain=False, truncate=True,
+        )
+        self._lock = threading.Lock()
+        self.t0 = time.perf_counter()
+        self.events_emitted = 0
+
+    def __bool__(self) -> bool:
+        return True
+
+    @staticmethod
+    def now() -> float:
+        return time.perf_counter()
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if self._log is None:
+                return  # closed: late stream-thread events are dropped
+            self._log.append(event)
+            self.events_emitted += 1
+
+    def emit_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        track: str = SCHED_TRACK,
+        aid: Optional[str] = None,
+        **args,
+    ) -> None:
+        """One completed interval. ``t0``/``t1`` are absolute
+        perf_counter stamps; ``aid`` marks the span async (it may
+        overlap others on its track — rendered as a Chrome async event
+        keyed by the id). Extra keyword args become the span's
+        correlation payload (rid, tick, shard, lane, ...)."""
+        event: Dict[str, Any] = {
+            "ev": "span",
+            "name": name,
+            "track": track,
+            "ts": t0 - self.t0,
+            "dur": max(t1 - t0, 0.0),
+        }
+        if aid is not None:
+            event["aid"] = str(aid)
+        if args:
+            event["args"] = _jsonable(args)
+        self._emit(event)
+
+    def instant(
+        self, name: str, track: str = SCHED_TRACK, **args
+    ) -> None:
+        """One point event, stamped now."""
+        event: Dict[str, Any] = {
+            "ev": "instant",
+            "name": name,
+            "track": track,
+            "ts": time.perf_counter() - self.t0,
+        }
+        if args:
+            event["args"] = _jsonable(args)
+        self._emit(event)
+
+    @contextmanager
+    def span(self, name: str, track: str = SCHED_TRACK, **args):
+        """Context manager form: times the with-block."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit_span(name, t0, time.perf_counter(), track, **args)
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.sync()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+
+
+def _jsonable(args: Dict[str, Any]) -> Dict[str, Any]:
+    """Span args as plain JSON scalars (numpy ints, tuples, and the
+    odd object all flatten to something a reader can grep)."""
+    out: Dict[str, Any] = {}
+    for k, v in args.items():
+        if v is None or isinstance(v, (bool, int, float, str)):
+            out[k] = v
+        else:
+            try:
+                out[k] = json.loads(json.dumps(v, default=str))
+            except (TypeError, ValueError):
+                out[k] = str(v)
+    return out
+
+
+def read_trace(path: str) -> List[Dict[str, Any]]:
+    """Replay a span log into its event list (torn tail dropped
+    cleanly, same contract as every framed log in the repo)."""
+    events: List[Dict[str, Any]] = []
+    for payload in iter_frames(path):
+        events.append(json.loads(payload.decode()))
+    return events
+
+
+# -- Chrome trace-event conversion ------------------------------------------
+
+#: Synthetic pid for the whole server process in the rendered trace.
+_PID = 1
+
+
+def chrome_trace(
+    events: Iterable[Dict[str, Any]], label: str = "lens_tpu serve"
+) -> Dict[str, Any]:
+    """Render span-log events as a Chrome trace-event JSON object
+    (the ``{"traceEvents": [...]}`` object form; load in Perfetto or
+    chrome://tracing).
+
+    Mapping:
+
+    - each ``track`` becomes one named thread (tid) under one process;
+      tracks are ordered scheduler, devices, streamer, requests, sweep,
+      then first-seen;
+    - plain spans -> complete events (``ph: "X"``, ``ts``/``dur`` in
+      microseconds);
+    - ``aid``-carrying spans -> async begin/end pairs (``ph: "b"``/
+      ``"e"``) keyed by the id, so overlapping per-request waits render
+      as parallel bars instead of bogus nesting;
+    - instants -> ``ph: "i"`` with thread scope;
+    - ``args`` pass through untouched (rid/tick/shard correlation is
+      clickable in the viewer).
+    """
+    events = list(events)
+    order = {SCHED_TRACK: 0, STREAM_TRACK: 100, REQUEST_TRACK: 200,
+             SWEEP_TRACK: 300}
+    seen: List[str] = []
+    for e in events:
+        t = str(e.get("track", SCHED_TRACK))
+        if t not in seen:
+            seen.append(t)
+
+    def track_rank(t: str) -> tuple:
+        if t.startswith("device:"):
+            try:
+                return (10, int(t.split(":", 1)[1]))
+            except ValueError:
+                return (10, 0)
+        return (order.get(t, 400), seen.index(t))
+
+    tids = {t: i + 1 for i, t in enumerate(sorted(seen, key=track_rank))}
+
+    out: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+        "args": {"name": label},
+    }]
+    for t, tid in tids.items():
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
+            "args": {"name": t},
+        })
+        out.append({
+            "name": "thread_sort_index", "ph": "M", "pid": _PID,
+            "tid": tid, "args": {"sort_index": tid},
+        })
+    for e in events:
+        tid = tids[str(e.get("track", SCHED_TRACK))]
+        ts_us = float(e.get("ts", 0.0)) * 1e6
+        base = {
+            "name": str(e.get("name", "?")),
+            "cat": str(e.get("track", SCHED_TRACK)),
+            "pid": _PID,
+            "tid": tid,
+            "args": dict(e.get("args") or {}),
+        }
+        if e.get("ev") == "span":
+            dur_us = float(e.get("dur", 0.0)) * 1e6
+            aid = e.get("aid")
+            if aid is not None:
+                # async pair: overlapping spans on one track render in
+                # parallel, keyed by the id (Perfetto draws one row per
+                # concurrent id)
+                out.append({**base, "ph": "b", "id": str(aid),
+                            "ts": ts_us})
+                out.append({**base, "ph": "e", "id": str(aid),
+                            "ts": ts_us + dur_us})
+            else:
+                out.append({**base, "ph": "X", "ts": ts_us,
+                            "dur": dur_us})
+        else:
+            out.append({**base, "ph": "i", "ts": ts_us, "s": "t"})
+    out.sort(key=lambda e: (e.get("ts", 0.0), e["ph"] != "b"))
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -168,6 +168,25 @@ class Ticket:
     cancel_requested: bool = False
     emit_count: int = 0  # emitted records streamed so far (pre-filter)
     result_path: Optional[str] = None
+    # -- observability marks (round 14, docs/observability.md) --
+    # first_window_at / streamed_at: lifecycle wall stamps feeding the
+    # server_meta.json per-request timing table (queued/admitted come
+    # from submitted_at/admitted_at above, retired from finished_at).
+    # stage / stage_tick: the request's last COMPLETED pipeline stage
+    # and the scheduler tick it completed on — what WatchdogTimeout /
+    # SimulationDiverged messages quote so a bounded-time failure
+    # names where progress stopped.
+    first_window_at: Optional[float] = None
+    streamed_at: Optional[float] = None
+    stage: str = "created"
+    stage_tick: int = 0
+    stage_info: Optional[tuple] = None
+    # device-failover re-queue marks: the queue.wait span of a
+    # re-admission must start at the requeue, not the original submit
+    # (the time in between was spent RUNNING on the dead device), and
+    # each admission attempt needs its own async-span id
+    requeued_at: Optional[float] = None
+    requeues: int = 0
     # -- continuation / fork plumbing (hold_state, resubmit, prefix) --
     # carry_state: a state pytree to scatter at admission instead of
     # building one from seed+overrides (set when a coalesced prefix
@@ -201,6 +220,30 @@ class Ticket:
         return (
             self.request.deadline is not None
             and now - self.submitted_at > self.request.deadline
+        )
+
+    def mark_stage(self, stage: str, tick: int, info=None) -> None:
+        """Record the last completed pipeline stage (and the scheduler
+        tick it completed on) — the breadcrumb failure messages quote.
+        ``info`` carries the stage's raw detail fields; formatting is
+        deferred to :meth:`stage_note` so the per-window hot path
+        stores a tuple, not an f-string."""
+        self.stage = stage
+        self.stage_tick = int(tick)
+        self.stage_info = info
+
+    def stage_note(self) -> str:
+        """The human form of the breadcrumb, for error messages."""
+        stage = self.stage
+        if stage == "window dispatched" and self.stage_info is not None:
+            step, total, shard = self.stage_info
+            stage = (
+                f"window dispatched (through step {step} of {total}, "
+                f"shard {shard})"
+            )
+        return (
+            f"last completed stage: {stage!r} "
+            f"(tick {self.stage_tick})"
         )
 
 

@@ -120,6 +120,11 @@ class FaultPlan:
         self.seed = int(seed)
         self._rng = np.random.default_rng(self.seed)
         self._lock = threading.Lock()
+        # a span tracer (lens_tpu.obs) the owning server installs:
+        # every FIRED fault becomes an instant on the timeline, so a
+        # chaos run's injections line up visually with the quarantines
+        # and requeues they caused. None / NullTracer = no emission.
+        self.trace: Any = None
         self.faults: List[Fault] = []
         for i, f in enumerate(faults or []):
             f = dict(f)
@@ -249,6 +254,16 @@ class FaultPlan:
                 if f.occurrence:
                     f._done = True
                 out.append(f)
+        if out and self.trace:
+            # outside the lock: the tracer serializes internally, and
+            # a kill fault's instant may be lost with the buffered
+            # tail — the injection is visible via its WAL/quarantine
+            # consequences either way
+            for f in out:
+                self.trace.instant(
+                    "fault.injected", kind=f.kind, seam=seam,
+                    rid=request_id, shard=shard,
+                )
         return out
 
     # -- seam helpers (what the server/streamer actually call) ---------------

@@ -137,6 +137,12 @@ class LanePool:
             )
         self.sim = sim
         self.ensemble = Ensemble(sim, n_lanes)
+        # a span tracer (lens_tpu.obs) the owning server installs:
+        # first-call compiles of the per-structure admission builders
+        # are the serve path's only legitimate mid-flight stalls, and
+        # the timeline should show them as compiles, not mystery gaps.
+        # None / NullTracer = no emission, zero extra work.
+        self.trace: Any = None
         self.n_lanes = int(n_lanes)
         self.window_steps = int(window_steps)
         self.timestep = float(timestep)
@@ -285,7 +291,8 @@ class LanePool:
         )
         key = (na_key, structure)
         builder = self._solo_builders.get(key)
-        if builder is None:
+        fresh = builder is None
+        if fresh:
             paths = [path for path, _ in leaves]
 
             def build(prng, values):
@@ -298,9 +305,15 @@ class LanePool:
 
             builder = jax.jit(build)
             self._solo_builders[key] = builder
-        return builder(
-            jax.random.PRNGKey(int(seed)), [v for _, v in leaves]
-        )
+        args = (jax.random.PRNGKey(int(seed)), [v for _, v in leaves])
+        if fresh and self.trace:
+            # the first call traces + compiles this override
+            # structure's builder — a one-off stall worth naming
+            with self.trace.span(
+                "compile.admit_builder", override_paths=len(leaves)
+            ):
+                return builder(*args)
+        return builder(*args)
 
     def _zero_agents(self):
         """The 'no live rows' n_agents for this sim form."""
@@ -499,7 +512,8 @@ class LanePool:
         cached compile per override structure (values are traced)."""
         leaves, key = _override_leaves(overrides)
         program = self._fork_admits.get(key)
-        if program is None:
+        fresh = program is None
+        if fresh:
             paths = [path for path, _ in leaves]
             donate = jax.default_backend() != "cpu"
 
@@ -517,7 +531,7 @@ class LanePool:
                 fork, donate_argnums=(0, 1) if donate else ()
             )
             self._fork_admits[key] = program
-        self.states, self.remaining = program(
+        args = (
             self.states,
             self.remaining,
             jnp.int32(lane),
@@ -525,6 +539,13 @@ class LanePool:
             jnp.int32(steps),
             [v for _, v in leaves],
         )
+        if fresh and self.trace:
+            with self.trace.span(
+                "compile.fork_admit", override_paths=len(leaves)
+            ):
+                self.states, self.remaining = program(*args)
+        else:
+            self.states, self.remaining = program(*args)
         self.remaining_host[lane] = int(steps)
 
     def lane_state_device(self, lane: int):

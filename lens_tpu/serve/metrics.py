@@ -1,4 +1,4 @@
-"""Serving counters: the numbers that tell you whether the server is keeping up.
+"""Serving metrics: the numbers that tell you whether the server is keeping up.
 
 The reference lineage has no serving tier to observe; the inference
 stacks this subsystem borrows its shape from (continuous-batching LLM
@@ -8,36 +8,64 @@ the serve layer carries the same set from day one. Everything here is
 host-side Python (incremented by the scheduler loop between device
 dispatches); nothing touches the jitted window program.
 
-``ServerMetrics.snapshot()`` is the one read surface: the CLI summary,
-the ``server_meta.json`` sidecar, tests, and ``bench_serve.py`` all
-consume it.
+Since round 14 the internals are a real instrument registry
+(:class:`lens_tpu.obs.metrics.MetricsRegistry`) instead of bare ints
+and lists, which buys three things the snapshot-only form could not:
+
+- **time series** — ``sample_point()`` renders one compact record per
+  wall-clock sampling tick; the server appends them to a
+  ``metrics.jsonl`` ring (``metrics_interval_s``), so occupancy, queue
+  depth, stream lag, and per-shard health exist as HISTORY, not just a
+  final number;
+- **pull exposition** — ``prometheus_text()`` renders the standard
+  Prometheus text format for a scraper (the ``status()``-style pull
+  surface: no push loop, no daemon — the caller asks);
+- **thread safety** — latency/wait/window samples live in locked
+  histograms, fixing the ``reset_samples()``-vs-concurrent-``tick()``
+  race (the stream thread observes a completion while a bench warmup
+  resets: the old list could be read half-cleared mid-percentile).
+
+``ServerMetrics.snapshot()`` remains the one JSON read surface: the CLI
+summary, the ``server_meta.json`` sidecar, tests, and ``bench_serve.py``
+all consume it, with the same keys as before the refactor.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
+from lens_tpu.obs.metrics import MetricsRegistry, percentiles
 
-def percentiles(samples: List[float], points=(50.0, 95.0, 99.0)) -> Dict[str, Optional[float]]:
-    """{"p50": ..., "p95": ..., "p99": ...} by linear interpolation —
-    tiny and dependency-free so metrics never import numpy for three
-    numbers. Empty input yields ``None`` entries (a server that served
-    nothing has no latency, not a zero latency)."""
-    out: Dict[str, Optional[float]] = {}
-    ordered = sorted(samples)
-    for p in points:
-        key = f"p{p:g}"
-        if not ordered:
-            out[key] = None
-            continue
-        rank = (len(ordered) - 1) * (p / 100.0)
-        lo = int(rank)
-        hi = min(lo + 1, len(ordered) - 1)
-        out[key] = ordered[lo] + (ordered[hi] - ordered[lo]) * (rank - lo)
-    return out
+__all__ = ["ServerMetrics", "percentiles", "write_server_meta"]
+
+#: help strings for the exported counters (the docstring below is the
+#: narrative; this is what a scraper's HELP line shows)
+_COUNTER_HELP = {
+    "submitted": "client submits accepted into the queue",
+    "rejected": "submits refused by bounded-queue backpressure",
+    "admitted": "requests scattered into a lane",
+    "retired": "horizons run to completion",
+    "resubmitted": "continuation tickets from SimServer.resubmit",
+    "timeouts": "deadline expiries (queued or mid-run)",
+    "cancelled": "explicit cancels (queued or mid-run)",
+    "failed": "requests failed (admission errors, divergence, faults)",
+    "ticks": "scheduler iterations",
+    "windows": "device window programs dispatched",
+    "lane_windows_busy": "occupied lane-windows (occupancy numerator)",
+    "lane_windows_total": "total lane-windows (occupancy denominator)",
+    "prefix_hits": "prefix submits resolved from the snapshot store",
+    "prefix_misses": "prefix submits that launched a prefix run",
+    "prefix_coalesced": "prefix submits attached to an in-flight run",
+    "prefix_forks": "lanes seeded by scattering a cached snapshot",
+    "snapshot_evictions": "snapshot-store entries dropped to budget",
+    "diverged": "lanes quarantined by the per-window finite check",
+    "recovered": "unfinished WAL requests re-admitted at startup",
+    "requeued": "requests displaced from a quarantined device",
+}
 
 
 class ServerMetrics:
@@ -89,31 +117,17 @@ class ServerMetrics:
       count — both refreshed by the server alongside queue depth.
     """
 
-    _COUNTERS = (
-        "submitted",
-        "rejected",
-        "admitted",
-        "retired",
-        "resubmitted",
-        "timeouts",
-        "cancelled",
-        "failed",
-        "ticks",
-        "windows",
-        "lane_windows_busy",
-        "lane_windows_total",
-        "prefix_hits",
-        "prefix_misses",
-        "prefix_coalesced",
-        "prefix_forks",
-        "snapshot_evictions",
-        "diverged",
-        "recovered",
-        "requeued",
-    )
+    _COUNTERS = tuple(_COUNTER_HELP)
 
     def __init__(self) -> None:
-        self.counters: Dict[str, int] = {k: 0 for k in self._COUNTERS}
+        reg = self.registry = MetricsRegistry(namespace="lens_serve")
+        self._counters = {
+            name: reg.counter(name, help)
+            for name, help in _COUNTER_HELP.items()
+        }
+        # gauges: plain attributes the server refreshes, registered as
+        # computed-at-read so the Prometheus exposition and the
+        # metrics.jsonl sampler always see the live value
         self.queue_depth = 0
         self.lanes_busy = 0
         self.lanes_total = 0
@@ -127,44 +141,121 @@ class ServerMetrics:
         # snapshot_bytes) + the quarantined-device count
         self.shards: List[Dict[str, Any]] = []
         self.quarantined_devices = 0
+        for name, help, fn in (
+            ("queue_depth", "requests waiting for a lane",
+             lambda: self.queue_depth),
+            ("lanes_busy", "occupied lanes now",
+             lambda: self.lanes_busy),
+            ("lanes_total", "schedulable lanes (quarantined excluded)",
+             lambda: self.lanes_total),
+            ("retraces", "window-program compiles beyond the first",
+             lambda: self.retraces),
+            ("occupancy", "mean lane occupancy (busy/total windows)",
+             self.occupancy),
+            ("snapshots_resident", "snapshot-store entries resident",
+             lambda: self.snapshots_resident),
+            ("snapshot_bytes", "snapshot-store resident bytes",
+             lambda: self.snapshot_bytes),
+            ("quarantined_devices", "device shards quarantined",
+             lambda: self.quarantined_devices),
+            ("device_busy_fraction",
+             "fraction of the streamed span with a window in flight",
+             self.device_busy_fraction),
+            ("stream_stalls", "scheduler stalls on stream backpressure",
+             lambda: self.stalls),
+            ("stream_stall_seconds",
+             "scheduler seconds lost to stream backpressure",
+             lambda: self.stall_seconds),
+        ):
+            reg.gauge(name, help, fn=fn)
         self._t0 = time.perf_counter()
-        # per finished request: wall seconds submit->admit and submit->done
-        self.wait_seconds: List[float] = []
-        self.latency_seconds: List[float] = []
-        self.window_seconds: List[float] = []
+        # per finished request: wall seconds submit->admit and
+        # submit->done; per window: wall seconds through the pipe.
+        # Locked histograms (lens_tpu.obs.metrics.Histogram): the
+        # stream thread observes while the scheduler reads/resets.
+        self.wait_seconds = reg.histogram(
+            "wait_seconds", "request wall seconds submit->admit"
+        )
+        self.latency_seconds = reg.histogram(
+            "latency_seconds", "request wall seconds submit->done"
+        )
+        self.window_seconds = reg.histogram(
+            "window_seconds", "per-window incremental wall seconds"
+        )
+        reg.gauge(
+            "uptime_seconds", "seconds since server construction",
+            fn=lambda: time.perf_counter() - self._t0,
+        )
         # per streamed window: (dispatched_at, ready_at, streamed_at) —
         # dispatch is when the scheduler enqueued the window program,
         # ready is when its trajectory finished landing host-side, and
         # streamed is when the last sink append for it returned. The
         # pipeline gauges below (device busy fraction, host gap,
         # stream lag) are all derived from these three timestamps.
-        self.stream_samples: List[Tuple[float, float, float]] = []
+        # A locked plain list (tuples, not scalars — no Histogram).
+        self._stream_lock = threading.Lock()
+        self._stream_samples: List[Tuple[float, float, float]] = []
         # scheduler seconds blocked on streamer backpressure (the
         # bounded queue full — host streaming is the bottleneck)
         self.stall_seconds = 0.0
         self.stalls = 0
 
+    # -- writers -------------------------------------------------------------
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Plain-dict view of the counter values (the historical read
+        surface; writers go through :meth:`inc`)."""
+        return {name: c.value for name, c in self._counters.items()}
+
     def inc(self, name: str, by: int = 1) -> None:
-        self.counters[name] += by
+        self._counters[name].inc(by)
 
     def observe_request(self, wait_s: float, total_s: float) -> None:
-        self.wait_seconds.append(float(wait_s))
-        self.latency_seconds.append(float(total_s))
+        self.wait_seconds.observe(wait_s)
+        self.latency_seconds.observe(total_s)
 
     def observe_window(self, wall_s: float) -> None:
-        self.window_seconds.append(float(wall_s))
+        self.window_seconds.observe(wall_s)
 
     def observe_stream(
         self, dispatched_at: float, ready_at: float, streamed_at: float
     ) -> None:
-        self.stream_samples.append(
-            (float(dispatched_at), float(ready_at), float(streamed_at))
-        )
+        with self._stream_lock:
+            self._stream_samples.append(
+                (float(dispatched_at), float(ready_at),
+                 float(streamed_at))
+            )
 
     def observe_stall(self, seconds: float) -> None:
         if seconds > 0:
             self.stall_seconds += float(seconds)
             self.stalls += 1
+
+    def reset_samples(self) -> None:
+        """Drop accumulated latency/wait/window/stream samples
+        (counters stay) — benchmark hygiene after a warmup round, so
+        compile-time outliers never dilute the measured percentiles.
+        Each buffer clears atomically under its own lock, so an
+        observation racing in from the stream thread lands wholly
+        before or wholly after the reset — never into a half-cleared
+        list (the round-14 race fix; the server still drains the
+        streamer first so in-flight windows don't re-sample later)."""
+        self.latency_seconds.clear()
+        self.wait_seconds.clear()
+        self.window_seconds.clear()
+        with self._stream_lock:
+            self._stream_samples.clear()
+        self.stall_seconds = 0.0
+        self.stalls = 0
+
+    # -- derived reads -------------------------------------------------------
+
+    @property
+    def stream_samples(self) -> List[Tuple[float, float, float]]:
+        """A consistent copy of the per-window stream timestamps."""
+        with self._stream_lock:
+            return list(self._stream_samples)
 
     def device_busy_fraction(self) -> Optional[float]:
         """Fraction of the streamed span the device had a window in
@@ -206,18 +297,20 @@ class ServerMetrics:
         """Recent mean window wall time — the unit the backpressure
         retry-after hint is quoted in. Falls back to ``default`` before
         the first window has run (cold server, nothing measured)."""
-        recent = self.window_seconds[-32:]
+        recent = self.window_seconds.tail(32)
         return sum(recent) / len(recent) if recent else default
 
     def occupancy(self) -> Optional[float]:
-        total = self.counters["lane_windows_total"]
+        total = self._counters["lane_windows_total"].value
         if total == 0:
             return None
-        return self.counters["lane_windows_busy"] / total
+        return self._counters["lane_windows_busy"].value / total
+
+    # -- export surfaces -----------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
         return {
-            "counters": dict(self.counters),
+            "counters": self.counters,
             "queue_depth": self.queue_depth,
             "lanes_busy": self.lanes_busy,
             "lanes_total": self.lanes_total,
@@ -229,10 +322,11 @@ class ServerMetrics:
             "quarantined_devices": self.quarantined_devices,
             "uptime_seconds": time.perf_counter() - self._t0,
             "avg_window_seconds": (
-                self.avg_window_seconds() if self.window_seconds else None
+                self.avg_window_seconds() if len(self.window_seconds)
+                else None
             ),
-            "latency_seconds": percentiles(self.latency_seconds),
-            "wait_seconds": percentiles(self.wait_seconds),
+            "latency_seconds": self.latency_seconds.percentiles(),
+            "wait_seconds": self.wait_seconds.percentiles(),
             "device_busy_fraction": self.device_busy_fraction(),
             "host_gap_seconds": percentiles(self.host_gap_seconds()),
             "stream_lag_seconds": percentiles(self.stream_lag_seconds()),
@@ -240,17 +334,97 @@ class ServerMetrics:
             "stream_stalls": self.stalls,
         }
 
+    def sample_point(self) -> Dict[str, Any]:
+        """One ``metrics.jsonl`` record: a wall-clock stamp (seconds
+        since server construction) plus the registry's full sample —
+        every counter, every gauge read now, every histogram's
+        count/sum/percentiles. Appended by the server on the
+        ``metrics_interval_s`` cadence; the stream-derived pipeline
+        gauges ride along so stream lag exists as history too."""
+        point = {"t": time.perf_counter() - self._t0}
+        point.update(self.registry.sample())
+        lag = self.stream_lag_seconds()
+        gap = self.host_gap_seconds()
+        point["stream"] = {
+            "windows": len(lag),
+            "lag": percentiles(lag),
+            "host_gap": percentiles(gap),
+        }
+        if self.shards:
+            point["shards"] = [dict(s) for s in self.shards]
+        return point
+
+    def prometheus_text(self) -> str:
+        """The Prometheus text exposition format for this server's
+        instruments — the pull surface (``SimServer.prometheus_
+        metrics()`` refreshes gauges first, same discipline as
+        ``metrics()``). Per-shard gauges export with a ``shard``
+        label."""
+        text = self.registry.prometheus_text()
+        lines = [text.rstrip("\n")]
+        if self.shards:
+            ns = self.registry.namespace
+            lines.append(f"# TYPE {ns}_shard_lanes_busy gauge")
+            lines.append(f"# TYPE {ns}_shard_windows gauge")
+            lines.append(f"# TYPE {ns}_shard_quarantined gauge")
+            for s in self.shards:
+                label = f'{{shard="{s.get("shard", 0)}"}}'
+                lines.append(
+                    f"{ns}_shard_lanes_busy{label} "
+                    f"{s.get('lanes_busy', 0)}"
+                )
+                lines.append(
+                    f"{ns}_shard_windows{label} {s.get('windows', 0)}"
+                )
+                lines.append(
+                    f"{ns}_shard_quarantined{label} "
+                    f"{int(bool(s.get('quarantined')))}"
+                )
+        return "\n".join(lines) + "\n"
+
+
+def request_timing_row(ticket, t0: float) -> Dict[str, Any]:
+    """One per-request row of the ``server_meta.json`` timing table:
+    the request's lifecycle wall times (seconds since server
+    construction, ``None`` where a stage never happened), derived from
+    the span marks the scheduler stamps on the ticket. Replaces the
+    ad-hoc "read the latency percentile and guess" workflow: the
+    sidecar now names when each request queued, admitted, first hit a
+    device, finished streaming, and retired."""
+
+    def rel(at: Optional[float]) -> Optional[float]:
+        return None if at is None else round(at - t0, 6)
+
+    return {
+        "rid": ticket.request_id,
+        "status": ticket.status,
+        "shard": ticket.shard,
+        "steps_done": ticket.steps_done,
+        "queued": rel(ticket.submitted_at),
+        "admitted": rel(ticket.admitted_at),
+        "first_window": rel(ticket.first_window_at),
+        "last_streamed": rel(ticket.streamed_at),
+        "retired": rel(ticket.finished_at),
+        "error": ticket.error,
+    }
+
 
 def write_server_meta(
-    out_dir: str, config: Mapping[str, Any], metrics: ServerMetrics
+    out_dir: str,
+    config: Mapping[str, Any],
+    metrics: ServerMetrics,
+    requests: Optional[List[Dict[str, Any]]] = None,
 ) -> str:
     """The ``server_meta.json`` sidecar: serving config + final counter
-    snapshot, beside the per-request result logs — the serve analogue of
-    the run path's ``colony_meta.json`` (provenance that is not
-    recoverable from the data files themselves)."""
+    snapshot + (round 14) the per-request timing table, beside the
+    per-request result logs — the serve analogue of the run path's
+    ``colony_meta.json`` (provenance that is not recoverable from the
+    data files themselves)."""
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, "server_meta.json")
     payload = {"config": dict(config), **metrics.snapshot()}
+    if requests is not None:
+        payload["requests"] = requests
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=2, default=str)

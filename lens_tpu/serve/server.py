@@ -49,6 +49,15 @@ environments, LLM continuous batching):
   deterministic ``FaultPlan`` (serve/faults.py) injects all three
   failure classes at named seams for tests/CI.
 
+- the whole pipeline is observable (round 14, docs/observability.md):
+  ``trace_dir`` arms a span tracer (lens_tpu.obs) that timestamps
+  every stage of every request's life onto a framed span log —
+  convertible to a Chrome/Perfetto timeline — and
+  ``metrics_interval_s`` samples the metrics registry into a
+  ``metrics.jsonl`` time-series ring, with Prometheus text exposition
+  via :meth:`SimServer.prometheus_metrics`. Both off by default: the
+  untraced server is the round-13 serve path bit for bit.
+
 Determinism contract (pinned in tests/test_serve.py): a request's
 emitted trajectory is BITWISE identical served solo or co-batched with
 arbitrary other requests, across admission orders — per-request PRNG
@@ -92,9 +101,23 @@ from lens_tpu.serve.batcher import (
     ScenarioRequest,
     Ticket,
 )
+from lens_tpu.obs.metrics import MetricsRing
+from lens_tpu.obs.trace import (
+    REQUEST_TRACK,
+    SCHED_TRACK,
+    STREAM_TRACK,
+    TRACE_NAME,
+    NullTracer,
+    Tracer,
+    device_track,
+)
 from lens_tpu.serve.faults import FaultPlan
 from lens_tpu.serve.lanes import LanePool
-from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
+from lens_tpu.serve.metrics import (
+    ServerMetrics,
+    request_timing_row,
+    write_server_meta,
+)
 from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
 from lens_tpu.serve.streamer import (
     LaneSlice,
@@ -465,6 +488,26 @@ class SimServer:
         its requests re-queued onto surviving devices (``None`` =
         off). The fail-stop companion to ``FaultPlan`` ``device_down``
         declarations and operator :meth:`quarantine_device` calls.
+    trace_dir:
+        Arm span tracing (docs/observability.md): every stage of every
+        request's life — queue wait, admission scatter, window
+        dispatch, device compute, streamer flush, retirement, prefix
+        resolution, hold spills, recovery replay, device quarantine
+        and requeues, injected faults — is appended as a structured
+        span/instant event to ``<trace_dir>/serve.trace`` (framed
+        JSON, buffered — observability never taxes the hot path for
+        durability). Convert to a Chrome/Perfetto timeline with
+        ``python -m lens_tpu trace <trace_dir> --out trace.json``.
+        ``None`` (default): a no-op NullTracer — the round-13 serve
+        path bit for bit.
+    metrics_interval_s:
+        Sample the metrics registry (counters, gauges, latency/stream
+        histograms, per-shard health) into a ``metrics.jsonl`` ring on
+        this wall-clock cadence — occupancy and queue depth as
+        HISTORY, not just a close-time number. The ring lives in
+        ``trace_dir`` (falling back to ``out_dir``); ``0`` samples
+        every tick (tests). ``None`` (default): no sampling. Pull-style
+        exposition is always available via :meth:`prometheus_metrics`.
     """
 
     def __init__(
@@ -484,6 +527,8 @@ class SimServer:
         faults: Optional[FaultPlan] = None,
         mesh: Any = None,
         device_watchdog_s: Optional[float] = None,
+        trace_dir: Optional[str] = None,
+        metrics_interval_s: Optional[float] = None,
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -511,6 +556,33 @@ class SimServer:
             raise ValueError(
                 f"device_watchdog_s={device_watchdog_s} must be > 0"
             )
+        if metrics_interval_s is not None:
+            if metrics_interval_s < 0:
+                raise ValueError(
+                    f"metrics_interval_s={metrics_interval_s} must "
+                    f"be >= 0"
+                )
+            if not (trace_dir or out_dir):
+                raise ValueError(
+                    "metrics_interval_s needs trace_dir or out_dir "
+                    "(somewhere for metrics.jsonl to live)"
+                )
+        # tracing first: buckets/pools/streamer/store all hang spans
+        # off it. NullTracer when off — falsy, every call a no-op, the
+        # round-13 code path bit for bit.
+        self.trace_dir = trace_dir
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            self.trace: Any = Tracer(os.path.join(trace_dir, TRACE_NAME))
+        else:
+            self.trace = NullTracer()
+        self.metrics_interval_s = metrics_interval_s
+        self._metrics_ring: Optional[MetricsRing] = None
+        self._next_sample = 0.0
+        if metrics_interval_s is not None:
+            self._metrics_ring = MetricsRing(
+                os.path.join(trace_dir or out_dir, "metrics.jsonl")
+            )
         self.devices = serve_devices(mesh)
         self.n_shards = len(self.devices)
         self.device_watchdog_s = device_watchdog_s
@@ -519,6 +591,10 @@ class SimServer:
             name: _Bucket(name, dict(cfg or {}), self.devices)
             for name, cfg in buckets.items()
         }
+        if self.trace:
+            for b in self.buckets.values():
+                for s in b.shards:
+                    s.pool.trace = self.trace
         self.queue = RequestQueue(queue_depth)
         self._metrics = ServerMetrics()
         self._metrics.lanes_total = sum(
@@ -532,11 +608,14 @@ class SimServer:
         self.check_finite = check_finite
         self.watchdog_s = watchdog_s
         self.faults = faults if faults is not None else FaultPlan(None)
+        if self.trace:
+            self.faults.trace = self.trace
         self._streamer: Optional[Streamer] = (
             Streamer(max_inflight=int(stream_queue),
                      metrics=self._metrics,
                      watchdog_s=watchdog_s,
-                     faults=self.faults)
+                     faults=self.faults,
+                     trace=self.trace)
             if pipeline == "on"
             else None
         )
@@ -545,6 +624,12 @@ class SimServer:
             if snapshot_budget_mb is None
             else int(float(snapshot_budget_mb) * 2**20)
         )
+        if self.trace:
+            self.snapshots.trace = self.trace
+        # scheduler tick sequence: the correlation coordinate every
+        # span/instant and every stage breadcrumb carries (counters
+        # track it too; this mirror avoids a dict build per event)
+        self._ticks = 0
         # in-flight prefix coalescing: snapshot key -> fork tickets
         # waiting for the (single) internal prefix run computing it
         self._pending_prefix: Dict[Any, List[Ticket]] = {}
@@ -575,7 +660,11 @@ class SimServer:
                  for n, b in self.buckets.items()},
             )
             if had_events:
-                self._recover()
+                with self.trace.span(
+                    "recovery.replay", track=SCHED_TRACK,
+                    events=len(self._wal.events),
+                ):
+                    self._recover()
 
     @classmethod
     def single_bucket(cls, composite: str, **kwargs) -> "SimServer":
@@ -588,6 +677,7 @@ class SimServer:
             "flush_every", "pipeline", "stream_queue",
             "snapshot_budget_mb", "check_finite", "watchdog_s",
             "recover_dir", "faults", "mesh", "device_watchdog_s",
+            "trace_dir", "metrics_interval_s",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -678,6 +768,7 @@ class SimServer:
     def _register(self, ticket: Ticket) -> None:
         """Post-push bookkeeping shared by ``submit`` and recovery."""
         self._metrics.inc("submitted")
+        ticket.mark_stage("queued", self._ticks)
         self.tickets[ticket.request_id] = ticket
         if ticket.prefix_key is not None:
             self._resolve_prefix(
@@ -809,14 +900,23 @@ class SimServer:
             self.snapshots.acquire(key)
             t.carry_key = key
             self._metrics.inc("prefix_hits")
+            self.trace.instant(
+                "prefix.hit", rid=t.request_id, tick=self._ticks
+            )
             return
         waiters = self._pending_prefix.get(key)
         if waiters is not None:
             waiters.append(t)
             t.waiting = True
             self._metrics.inc("prefix_coalesced")
+            self.trace.instant(
+                "prefix.coalesced", rid=t.request_id, tick=self._ticks
+            )
             return
         self._metrics.inc("prefix_misses")
+        self.trace.instant(
+            "prefix.miss", rid=t.request_id, tick=self._ticks
+        )
         t.waiting = True
         req = t.request
         warm = ScenarioRequest(
@@ -944,6 +1044,7 @@ class SimServer:
         self.snapshots.acquire(parent.held_key)
         self._metrics.inc("resubmitted")
         self._metrics.queue_depth = len(self.queue)
+        ticket.mark_stage("queued", self._ticks)
         self.tickets[ticket.request_id] = ticket
         if self._wal is not None:
             self._wal.append({
@@ -1006,6 +1107,17 @@ class SimServer:
         self._refresh_gauges()
         return self._metrics.snapshot()
 
+    def prometheus_metrics(self) -> str:
+        """The Prometheus text exposition format for this server's
+        live instruments — the pull-style scrape surface
+        (docs/observability.md): gauges recompute at call exactly like
+        :meth:`metrics`, counters are the monotonic lifetime values,
+        histograms export summary quantiles. No HTTP server is bundled
+        — an operator embeds this behind whatever endpoint their
+        deployment already has (the front door of ROADMAP item 5)."""
+        self._refresh_gauges()
+        return self._metrics.prometheus_text()
+
     def _gauges(self) -> Dict[str, Any]:
         """The small live-health dict embedded in ``status()``."""
         self._refresh_gauges()
@@ -1032,15 +1144,13 @@ class SimServer:
     def reset_samples(self) -> None:
         """Drop accumulated latency/wait/window samples (counters stay).
         Benchmark hygiene: called after a warmup round so compile-time
-        outliers never dilute the measured percentiles."""
+        outliers never dilute the measured percentiles. The buffers
+        clear atomically (each under its lock — see
+        ``ServerMetrics.reset_samples``), so a stream-thread
+        observation racing this call can never be read half-cleared."""
         if self._streamer is not None:
             self._streamer.drain()  # in-flight windows would re-sample
-        self._metrics.latency_seconds.clear()
-        self._metrics.wait_seconds.clear()
-        self._metrics.window_seconds.clear()
-        self._metrics.stream_samples.clear()
-        self._metrics.stall_seconds = 0.0
-        self._metrics.stalls = 0
+        self._metrics.reset_samples()
 
     def _refresh_gauges(self) -> None:
         self._metrics.queue_depth = len(self.queue)
@@ -1150,7 +1260,7 @@ class SimServer:
                                 f"result({request_id}) made no "
                                 f"stream progress for "
                                 f"{self.watchdog_s}s waiting for its "
-                                f"completion"
+                                f"completion; {t.stage_note()}"
                             )
                         token = now_token
                         waited = 0.0
@@ -1198,10 +1308,24 @@ class SimServer:
             # durable before the scheduler acts on any of it (one
             # fsync per tick, not per event — appends were already
             # flushed to the OS, so a SIGKILL loses nothing either way)
-            self._wal.sync()
+            if self.trace:
+                with self.trace.span("wal.sync", tick=self._ticks):
+                    self._wal.sync()
+            else:
+                self._wal.sync()
         now = time.perf_counter()
         self._metrics.inc("ticks")
+        self._ticks += 1
         did_work = False
+
+        # wall-clock metrics sampling (metrics_interval_s): one
+        # time-series point into the metrics.jsonl ring. Sampled at
+        # tick granularity — an idle server stops sampling too, which
+        # is the honest shape (nothing changed).
+        if self._metrics_ring is not None and now >= self._next_sample:
+            self._next_sample = now + (self.metrics_interval_s or 0.0)
+            self._refresh_gauges()
+            self._metrics_ring.append(self._metrics.sample_point())
 
         # 0a. device watchdog: a shard whose dispatched window never
         #     completed within device_watchdog_s is declared dead and
@@ -1390,6 +1514,29 @@ class SimServer:
             if t.prefix_key is not None
             else None
         )
+        if self.trace:
+            # the request's queue wait as an async span (they overlap
+            # freely across requests), closing the moment a lane is
+            # chosen; the scatter itself is timed below. A re-admission
+            # after device failover waits from its REQUEUE (the time
+            # before that was spent running on the dead device) and
+            # gets its own async id, so the attempts render as
+            # separate bars instead of bogus nesting.
+            wait_t0 = (
+                t.requeued_at if t.requeued_at is not None
+                else t.submitted_at
+            )
+            aid = (
+                t.request_id if not t.requeues
+                else f"{t.request_id}#r{t.requeues}"
+            )
+            self.trace.emit_span(
+                "queue.wait", wait_t0, now,
+                track=REQUEST_TRACK, aid=aid,
+                rid=t.request_id, tick=self._ticks,
+                internal=t.internal,
+            )
+            admit_t0 = time.perf_counter()
         try:
             if t.carry_key is not None:
                 shard.pool.admit_state(
@@ -1423,12 +1570,22 @@ class SimServer:
             self._finish(t, FAILED)
             self._metrics.inc("failed")
             return
+        if self.trace:
+            self.trace.emit_span(
+                "admit", admit_t0, time.perf_counter(),
+                track=SCHED_TRACK, rid=t.request_id,
+                tick=self._ticks, shard=shard.index, lane=lane,
+                fork=t.prefix_key is not None,
+                continuation=t.parent is not None
+                and t.prefix_key is None,
+            )
         if t.prefix_key is not None:
             self._metrics.inc("prefix_forks")
         t.status = RUNNING
         t.lane = lane
         t.shard = shard.index
         t.admitted_at = now
+        t.mark_stage("admitted", self._ticks)
         shard.assignments[lane] = t
         if not t.internal:
             self._results[t.request_id] = self._make_sink(t)
@@ -1518,9 +1675,14 @@ class SimServer:
             f"within the window "
             f"ending at step {step_after} (t={step_after * dt:g}); "
             f"the request failed and its lane was reclaimed — "
-            f"co-batched requests are unaffected"
+            f"co-batched requests are unaffected; {t.stage_note()}, "
+            f"detected at tick {self._ticks}"
         )
         self._metrics.inc("diverged")
+        self.trace.instant(
+            "lane.quarantined", rid=t.request_id, tick=self._ticks,
+            shard=shard.index, lane=lane, step=step_after,
+        )
         shard.diverged += 1
         if t.status == RUNNING and shard.assignments.get(lane) is t:
             shard.pool.release(lane)
@@ -1619,6 +1781,10 @@ class SimServer:
             except WatchdogTimeout:
                 pass
         self._quarantined.add(shard)
+        self.trace.instant(
+            "device.quarantined", shard=shard, tick=self._ticks,
+            reason=reason,
+        )
         displaced: List[Ticket] = []
         for bucket in self.buckets.values():
             s = bucket.shards[shard]
@@ -1779,6 +1945,14 @@ class SimServer:
         t.error = None
         t.steps_done = t.steps_base
         t.emit_count = t.steps_base // bucket.pool.emit_every
+        # the timing table reports the run that produced the surviving
+        # records — the dead device's window/stream stamps are void —
+        # and the re-admission's queue.wait span starts here, not at
+        # the original submit
+        t.first_window_at = None
+        t.streamed_at = None
+        t.requeued_at = time.perf_counter()
+        t.requeues += 1
         t.carry_state = None
         t.carry_shard = None
         t.waiting = False
@@ -1819,8 +1993,15 @@ class SimServer:
         # off the client backpressure bound would drop accepted
         # requests
         self.queue.push(t, retry_after=0.0, force=True)
+        t.mark_stage(
+            f"requeued off quarantined device {dead}", self._ticks
+        )
         if not t.internal:
             self._metrics.inc("requeued")
+            self.trace.instant(
+                "request.requeued", rid=t.request_id,
+                tick=self._ticks, shard=dead,
+            )
         if parent is not None and not t.internal:
             t.carry_key = parent.held_key
             self.snapshots.acquire(parent.held_key)
@@ -1857,6 +2038,28 @@ class SimServer:
         t0 = time.perf_counter()
         remaining_before, traj = pool.run_window()
         shard.windows += 1
+        if self.trace:
+            # the dispatch itself (enqueue + host bookkeeping window;
+            # first call of a bucket includes its trace/compile) —
+            # device compute is timed separately from the async-copy
+            # completion (window.device)
+            self.trace.emit_span(
+                "window.dispatch", t0, time.perf_counter(),
+                track=SCHED_TRACK, tick=self._ticks,
+                shard=shard.index, bucket=bucket.name,
+                lanes_busy=len(shard.assignments),
+            )
+        for t in shard.assignments.values():
+            if t.first_window_at is None:
+                t.first_window_at = t0
+            # raw fields only — stage_note() formats lazily, so the
+            # per-lane-per-window cost is one tuple, not an f-string
+            t.mark_stage(
+                "window dispatched", self._ticks,
+                (min(t.steps_done + pool.window_steps,
+                     t.horizon_steps),
+                 t.horizon_steps, shard.index),
+            )
         if self.device_watchdog_s is not None and shard.watch is None:
             # device watchdog arm: time THIS window against its own
             # output handle (a [L] int32 — negligible to keep alive);
@@ -1946,6 +2149,19 @@ class SimServer:
             done = time.perf_counter()
             self._metrics.observe_window(done - t0)
             self._metrics.observe_stream(t0, ready, done)
+            if self.trace:
+                # same two spans the streamer emits pipelined, so a
+                # sync trace renders on the same tracks (serialized)
+                self.trace.emit_span(
+                    "window.device", t0, ready,
+                    track=device_track(shard.index),
+                    shard=shard.index, tick=self._ticks,
+                )
+                self.trace.emit_span(
+                    "window.stream", ready, done, track=STREAM_TRACK,
+                    shard=shard.index, tick=self._ticks,
+                    requests=len(slices),
+                )
 
         for lane, t in retiring:
             if t.internal or t.request.hold_state:
@@ -1995,7 +2211,10 @@ class SimServer:
 
         if pipelined:
             stall = self._streamer.submit(
-                WindowItem(traj, slices, dispatched_at=t0)
+                WindowItem(
+                    traj, slices, dispatched_at=t0,
+                    shard=shard.index, tick=self._ticks,
+                )
             )
             self._metrics.observe_stall(stall)
             # window wall (dispatch -> trajectory host-side) is
@@ -2045,7 +2264,14 @@ class SimServer:
         from lens_tpu.checkpoint import save_tree
 
         name = spill_name(key)
+        t0 = time.perf_counter()
         save_tree(os.path.join(self.recover_dir, SPILL_DIR, name), snap)
+        if self.trace:
+            self.trace.emit_span(
+                "hold.spill", t0, time.perf_counter(),
+                track=SCHED_TRACK, rid=t.request_id,
+                tick=self._ticks, shard=t.shard or 0,
+            )
         self._wal.append({
             "event": HOLD,
             "rid": t.request_id,
@@ -2060,6 +2286,8 @@ class SimServer:
         request's log instead of re-running it. Called from the stream
         thread (pipelined) or the scheduler (sync) — the WAL is
         thread-safe."""
+        t.streamed_at = time.perf_counter()
+        t.mark_stage("streamed", self._ticks)
         if self._wal is not None and not t.internal:
             self._wal.append(
                 {"event": STREAMED, "rid": t.request_id},
@@ -2091,6 +2319,13 @@ class SimServer:
     def _finish(self, t: Ticket, status: str) -> None:
         t.status = status
         t.finished_at = time.perf_counter()
+        t.mark_stage(f"retired {status}", self._ticks)
+        if not t.internal:
+            self.trace.instant(
+                "retire", rid=t.request_id, tick=self._ticks,
+                status=status, shard=t.shard,
+                steps=t.steps_done,
+            )
         if self._wal is not None and not t.internal:
             # terminal fact first (a kill right after must see the
             # status); DONE completeness is attested separately by the
@@ -2341,6 +2576,19 @@ class SimServer:
         self.queue.push(ticket, retry_after=0.0, force=True)
         self._register(ticket)
 
+    def _request_table(self) -> List[Dict[str, Any]]:
+        """The ``server_meta.json`` per-request timing table: one row
+        per client request (internal prefix runs excluded) with its
+        lifecycle wall times — queued, admitted, first window on a
+        device, last streamed, retired — derived from the span marks
+        the scheduler stamps on each ticket. Rows are in request-id
+        order (= submission order)."""
+        return [
+            request_timing_row(t, self._metrics._t0)
+            for rid, t in sorted(self.tickets.items())
+            if not t.internal
+        ]
+
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
@@ -2411,10 +2659,24 @@ class SimServer:
                     self.out_dir,
                     {name: b.cfg for name, b in self.buckets.items()},
                     self._metrics,
+                    requests=self._request_table(),
                 )
             except BaseException as e:
                 # never let a failed meta write mask the root cause
                 first_error = first_error or e
+        if self._metrics_ring is not None:
+            try:
+                # one terminal sample so the ring always ends with the
+                # final counters, then release the file handle
+                self._refresh_gauges()
+                self._metrics_ring.append(self._metrics.sample_point())
+                self._metrics_ring.close()
+            except BaseException as e:
+                first_error = first_error or e
+        try:
+            self.trace.close()
+        except BaseException as e:
+            first_error = first_error or e
         if self._wal is not None:
             try:
                 self._wal.close()

@@ -140,6 +140,11 @@ class SnapshotStore:
         self.budget_bytes = budget_bytes
         self._entries: Dict[SnapshotKey, _Entry] = {}
         self._clock = 0
+        # a span tracer (lens_tpu.obs) the owning server installs:
+        # inserts and budget evictions become timeline instants (a
+        # thrashing store is a scheduling story, not just a counter).
+        # None / NullTracer = no emission.
+        self.trace: Any = None
 
     # -- reads ---------------------------------------------------------------
 
@@ -258,6 +263,11 @@ class SnapshotStore:
             shard=int(shard),
         )
         self._entries[key] = entry
+        if self.trace:
+            self.trace.instant(
+                "snapshot.put", bytes=entry.nbytes, pinned=bool(pin),
+                shard=int(shard),
+            )
         # LRU eviction may consume the new entry itself (it is the
         # newest, so only after every older evictable is gone): an
         # unpinned snapshot that cannot fit is simply not retained —
@@ -319,6 +329,8 @@ class SnapshotStore:
             evicted += 1
         # excess > 0 here means everything left is pinned: the budget
         # cannot bind (pinned inserts always land)
+        if evicted and self.trace:
+            self.trace.instant("snapshot.evicted", count=evicted)
         return evicted
 
     def clear(self) -> None:

@@ -46,6 +46,7 @@ import jax
 import numpy as np
 
 from lens_tpu.emit.log import SEP
+from lens_tpu.obs.trace import STREAM_TRACK, device_track
 from lens_tpu.utils.dicts import flatten_paths, set_path
 
 
@@ -116,11 +117,16 @@ class LaneSlice:
 class WindowItem:
     """One dispatched window handed to the stream thread: the device
     trajectory (async host copy already started) plus every occupied
-    lane's slice. ``traj is None`` for pure control items (closes)."""
+    lane's slice. ``traj is None`` for pure control items (closes).
+    ``shard``/``tick`` are correlation context for the span tracer
+    (which device ran the window, which scheduler tick dispatched
+    it)."""
 
     traj: Any
     slices: List[LaneSlice] = field(default_factory=list)
     dispatched_at: float = 0.0
+    shard: int = 0
+    tick: int = 0
 
 
 def process_window(
@@ -173,6 +179,7 @@ class Streamer:
         metrics: Any = None,
         watchdog_s: Optional[float] = None,
         faults: Any = None,
+        trace: Any = None,
     ):
         if max_inflight < 1:
             raise ValueError(
@@ -184,10 +191,12 @@ class Streamer:
         self.watchdog_s = watchdog_s
         self._faults = faults
         self._metrics = metrics
+        self._trace = trace  # a Tracer/NullTracer (None = no tracing)
         self._queue: List[WindowItem] = []
         self._cond = threading.Condition()
         self._inflight = 0  # real windows queued or being processed
         self._busy = False  # an item popped but not yet finished
+        self._busy_rids: List[str] = []  # requests in the busy item
         self._prev_done = None  # previous window's streamed_at
         self._stop = False
         self._error: Optional[BaseException] = None
@@ -247,6 +256,7 @@ class Streamer:
                         f"with {self._inflight}/{self.max_inflight} "
                         f"windows in flight — a sink append or the "
                         f"device window fetch is hung"
+                        f"{self._stuck_note()}"
                     )
                 stalled = time.perf_counter() - t0
                 if self._error is not None:
@@ -303,6 +313,7 @@ class Streamer:
                         f"({pending[0]} queued, {pending[1]} in "
                         f"flight) — a sink append or the device "
                         f"window fetch is hung"
+                        f"{self._stuck_note()}"
                     )
                 # progress happened (slower than the watchdog period
                 # per item is fine) — keep waiting
@@ -324,6 +335,15 @@ class Streamer:
             )
         self.check()
 
+    def _stuck_note(self) -> str:
+        """Name the requests whose window the stream thread is stuck
+        on (caller holds ``_cond``) — a bounded-time failure should
+        say where progress stopped, not just that it did."""
+        rids = [r for r in self._busy_rids if r]
+        if not rids:
+            return ""
+        return f"; currently streaming window for request(s) {rids}"
+
     # -- stream thread -------------------------------------------------------
 
     def _run(self) -> None:
@@ -334,6 +354,9 @@ class Streamer:
                     return  # stopped and drained
                 item = self._queue.pop(0)
                 self._busy = True
+                self._busy_rids = [
+                    s.request_id for s in item.slices
+                ]
             try:
                 self._process(item)
             except BaseException as e:
@@ -344,12 +367,14 @@ class Streamer:
                     self._queue.clear()
                     self._inflight = 0
                     self._busy = False
+                    self._busy_rids = []
                     self._cond.notify_all()
                 return
             with self._cond:
                 if item.traj is not None:
                     self._inflight -= 1
                 self._busy = False
+                self._busy_rids = []
                 self._cond.notify_all()
 
     def _process(self, item: WindowItem) -> None:
@@ -363,6 +388,21 @@ class Streamer:
             host = jax.device_get(item.traj)
         ready = time.perf_counter()
         process_window(host, item.slices, faults=self._faults)
+        if self._trace and item.traj is not None:
+            # the two pipelined halves of one window on the timeline:
+            # device compute + async copy (dispatch -> host-side), then
+            # the streamer's slicing/filtering/sink appends
+            done_t = time.perf_counter()
+            self._trace.emit_span(
+                "window.device", item.dispatched_at, ready,
+                track=device_track(item.shard),
+                shard=item.shard, tick=item.tick,
+            )
+            self._trace.emit_span(
+                "window.stream", ready, done_t, track=STREAM_TRACK,
+                shard=item.shard, tick=item.tick,
+                requests=len(item.slices),
+            )
         if item.traj is not None:
             done = time.perf_counter()
             if self._metrics is not None:

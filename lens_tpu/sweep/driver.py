@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
+from lens_tpu.obs.trace import SWEEP_TRACK
 from lens_tpu.sweep.ledger import (
     LEDGER_NAME,
     TABLE_NAME,
@@ -288,6 +289,14 @@ class _ServerSweep:
         self._backoff_rng = np.random.default_rng(
             np.random.SeedSequence([int(spec.seed), 0xB0FF])
         )
+        # per-trial spans (docs/observability.md): when the server is
+        # tracing (trace_dir — inherited through the backend dict like
+        # every other serve knob), each trial becomes an async span
+        # from its first submit to its terminal ledger event, plus a
+        # rung instant per ASHA promotion cut — so a sweep's timeline
+        # shows trials racing across lanes, not just requests.
+        self.trace = getattr(server, "trace", None)
+        self._trial_t0: Dict[int, float] = {}
         self.warmup = (
             dict(spec.warmup) if spec.warmup is not None else None
         )
@@ -385,6 +394,13 @@ class _ServerSweep:
             prefix=prefix,
         )
 
+    def _trial_submitted(self, index: int) -> None:
+        """Span mark: a trial's FIRST leg just submitted (rung
+        promotions keep the original start — the span is the trial's
+        whole life, not one leg's)."""
+        if self.trace and index not in self._trial_t0:
+            self._trial_t0[index] = time.perf_counter()
+
     def _record_done(self, index, objective, status, steps, on_trial):
         if self.ledger.terminal(index):
             return  # replay idempotence: never double-record a trial
@@ -397,6 +413,14 @@ class _ServerSweep:
             "steps": steps,
         }
         self.ledger.append(event)
+        if self.trace:
+            now = time.perf_counter()
+            self.trace.emit_span(
+                "trial", self._trial_t0.pop(index, now), now,
+                track=SWEEP_TRACK, aid=f"trial-{index}",
+                trial=index, status=status, objective=objective,
+                steps=steps,
+            )
         if on_trial is not None:
             on_trial(index, event)
 
@@ -429,6 +453,7 @@ class _ServerSweep:
                 rid = self._submit(
                     self._request(t, self.spec.horizon, hold=False)
                 )
+                self._trial_submitted(t.index)
                 inflight[rid] = t
                 k += 1
             self.server.tick()
@@ -518,6 +543,7 @@ class _ServerSweep:
                         rid_of[i] = self._submit(
                             self._request(self.trials[i], t_r, hold=True)
                         )
+                        self._trial_submitted(i)
                         in_flight.add(i)
                 self.server.tick()
                 for i in list(need):
@@ -539,6 +565,11 @@ class _ServerSweep:
                                 _concat_ts(segments[i]), up_to_time=t_r
                             ),
                         })
+                        if self.trace:
+                            self.trace.instant(
+                                "trial.rung", track=SWEEP_TRACK,
+                                trial=i, rung=r,
+                            )
                     elif status in (FAILED, TIMEOUT, CANCELLED):
                         in_flight.discard(i)
                         self._record_done(i, None, FAILED_S, 0, on_trial)
@@ -567,6 +598,15 @@ class _ServerSweep:
                             "rung": r,
                             "objective": values[i],
                         })
+                        if self.trace:
+                            now = time.perf_counter()
+                            self.trace.emit_span(
+                                "trial",
+                                self._trial_t0.pop(i, now), now,
+                                track=SWEEP_TRACK, aid=f"trial-{i}",
+                                trial=i, status="stopped", rung=r,
+                                objective=values[i],
+                            )
                     if i in rid_of:
                         self.server.release_state(rid_of[i])
                     if i in segments:

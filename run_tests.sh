@@ -43,6 +43,8 @@ tests/test_snapshots.py
 tests/test_faults.py
 tests/test_recovery.py
 tests/test_sweep.py
+tests/test_metrics.py
+tests/test_obs.py
 "
 
 # Full-suite batches. Grouping rationale: each line stays well under
@@ -61,7 +63,7 @@ BATCHES=(
   "tests/test_adi.py"
   "tests/test_parallel.py tests/test_distributed.py"
   "tests/test_multispecies.py tests/test_ensemble.py"
-  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_faults.py tests/test_recovery.py"
+  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_faults.py tests/test_recovery.py tests/test_metrics.py tests/test_obs.py"
   "tests/test_sweep.py tests/test_cli.py"
   "tests/test_experiment.py"
   "tests/test_bridge.py"

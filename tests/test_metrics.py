@@ -1,0 +1,305 @@
+"""serve/metrics.py in isolation (round-14 satellite).
+
+Before round 14 ``ServerMetrics`` was exercised only incidentally
+through ``test_serve.py``'s end-to-end flows; these are the direct
+contracts — percentile edge cases, gauge recompute-at-call semantics,
+per-shard aggregation pass-through, the reset-vs-observe race, and the
+registry/export surfaces — that the serving and bench layers lean on.
+No jax, no server: plain objects.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lens_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    percentiles,
+)
+from lens_tpu.serve.batcher import ScenarioRequest, Ticket
+from lens_tpu.serve.metrics import (
+    ServerMetrics,
+    request_timing_row,
+    write_server_meta,
+)
+
+
+class TestPercentiles:
+    def test_empty_yields_none_not_zero(self):
+        out = percentiles([])
+        assert out == {"p50": None, "p95": None, "p99": None}
+
+    def test_single_sample_is_every_percentile(self):
+        out = percentiles([0.25])
+        assert out["p50"] == out["p95"] == out["p99"] == 0.25
+
+    def test_two_samples_interpolate(self):
+        out = percentiles([0.0, 1.0])
+        assert out["p50"] == pytest.approx(0.5)
+        assert out["p95"] == pytest.approx(0.95)
+
+    def test_order_independent(self):
+        a = percentiles([3.0, 1.0, 2.0])
+        b = percentiles([1.0, 2.0, 3.0])
+        assert a == b
+        assert a["p50"] == 2.0
+
+
+class TestInstruments:
+    def test_counter_monotone(self):
+        c = Counter("x")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+
+    def test_gauge_set_vs_computed(self):
+        g = Gauge("g")
+        g.set(3)
+        assert g.read() == 3
+        box = {"v": 0}
+        g2 = Gauge("g2", fn=lambda: box["v"])
+        box["v"] = 7
+        assert g2.read() == 7  # recomputed at call, not at set time
+        box["v"] = 9
+        assert g2.read() == 9
+
+    def test_histogram_list_ergonomics(self):
+        h = Histogram("h")
+        for v in (3.0, 1.0, 2.0):
+            h.observe(v)
+        assert len(h) == 3
+        assert sorted(h) == [1.0, 2.0, 3.0]
+        assert h.tail(2) == [1.0, 2.0]
+        assert h.percentiles()["p50"] == 2.0
+        assert h.count == 3 and h.sum == 6.0
+        h.clear()
+        assert len(h) == 0
+        assert h.count == 3  # lifetime count survives the reset
+        assert h.percentiles()["p50"] is None
+
+    def test_registry_idempotent_and_kind_clash(self):
+        reg = MetricsRegistry()
+        assert reg.counter("a") is reg.counter("a")
+        reg.histogram("h")
+        with pytest.raises(ValueError, match="different instrument"):
+            reg.counter("h")
+
+    def test_registry_sample_shape(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(2)
+        reg.gauge("g", fn=lambda: 1.5)
+        reg.histogram("h").observe(0.1)
+        point = reg.sample()
+        assert point["counters"] == {"c": 2}
+        assert point["gauges"] == {"g": 1.5}
+        assert point["histograms"]["h"]["count"] == 1
+        assert point["histograms"]["h"]["p50"] == pytest.approx(0.1)
+
+    def test_prometheus_exposition_format(self):
+        reg = MetricsRegistry(namespace="t")
+        reg.counter("jobs", "jobs done").inc(3)
+        reg.gauge("depth", fn=lambda: 4)
+        reg.gauge("label", fn=lambda: "not-a-number")
+        h = reg.histogram("lat")
+        h.observe(1.0)
+        text = reg.prometheus_text()
+        assert "# TYPE t_jobs_total counter" in text
+        assert "t_jobs_total 3" in text
+        assert "t_depth 4" in text
+        assert "t_label" not in text  # non-numeric gauges stay out
+        assert 't_lat{quantile="0.5"} 1.0' in text
+        assert "t_lat_count 1" in text
+
+
+class TestServerMetrics:
+    def test_counters_property_is_a_copy(self):
+        m = ServerMetrics()
+        m.inc("submitted", 2)
+        snap = m.counters
+        snap["submitted"] = 999
+        assert m.counters["submitted"] == 2
+
+    def test_occupancy_none_until_first_window(self):
+        m = ServerMetrics()
+        assert m.occupancy() is None
+        m.inc("lane_windows_busy", 3)
+        m.inc("lane_windows_total", 4)
+        assert m.occupancy() == pytest.approx(0.75)
+
+    def test_gauges_recompute_at_call(self):
+        """The metrics() contract: a gauge read reflects NOW — the
+        registry gauge and the snapshot both track the live
+        attribute."""
+        m = ServerMetrics()
+        m.queue_depth = 5
+        assert m.registry.gauges["queue_depth"].read() == 5
+        assert m.snapshot()["queue_depth"] == 5
+        m.queue_depth = 1
+        assert m.registry.gauges["queue_depth"].read() == 1
+
+    def test_avg_window_seconds_default_then_windowed(self):
+        m = ServerMetrics()
+        assert m.avg_window_seconds(default=0.3) == 0.3
+        for _ in range(40):
+            m.observe_window(1.0)
+        m.observe_window(3.0)  # inside the 32-sample tail
+        assert 1.0 < m.avg_window_seconds() < 1.1
+
+    def test_per_shard_gauges_pass_through(self):
+        m = ServerMetrics()
+        m.shards = [
+            {"shard": 0, "lanes_busy": 2, "windows": 7,
+             "quarantined": False},
+            {"shard": 1, "lanes_busy": 0, "windows": 3,
+             "quarantined": True},
+        ]
+        m.quarantined_devices = 1
+        snap = m.snapshot()
+        assert snap["quarantined_devices"] == 1
+        assert [s["shard"] for s in snap["shards"]] == [0, 1]
+        # the snapshot's rows are copies, not aliases
+        snap["shards"][0]["lanes_busy"] = 99
+        assert m.shards[0]["lanes_busy"] == 2
+        text = m.prometheus_text()
+        assert 'lens_serve_shard_windows{shard="0"} 7' in text
+        assert 'lens_serve_shard_quarantined{shard="1"} 1' in text
+
+    def test_stream_sample_derived_gauges(self):
+        m = ServerMetrics()
+        assert m.device_busy_fraction() is None
+        # two back-to-back windows, device busy the whole span
+        m.observe_stream(0.0, 1.0, 1.2)
+        m.observe_stream(1.0, 2.0, 2.2)
+        assert m.device_busy_fraction() == pytest.approx(2.0 / 2.2)
+        assert m.host_gap_seconds() == pytest.approx([0.2, 0.2])
+        assert m.stream_lag_seconds() == pytest.approx([1.2, 1.2])
+
+    def test_reset_keeps_counters_drops_samples(self):
+        m = ServerMetrics()
+        m.inc("retired", 3)
+        m.observe_request(0.1, 0.5)
+        m.observe_window(0.2)
+        m.observe_stream(0.0, 0.1, 0.2)
+        m.observe_stall(0.05)
+        m.reset_samples()
+        assert m.counters["retired"] == 3
+        assert m.snapshot()["latency_seconds"]["p50"] is None
+        assert len(m.window_seconds) == 0
+        assert m.stream_samples == []
+        assert m.stalls == 0
+
+    def test_reset_races_concurrent_observers_safely(self):
+        """The round-14 race fix: percentile reads and resets are
+        atomic against stream-thread observations — hammer all three
+        from threads and every read must be well-formed."""
+        m = ServerMetrics()
+        stop = threading.Event()
+        errors = []
+
+        def observe():
+            while not stop.is_set():
+                m.observe_request(0.01, 0.02)
+                m.observe_stream(0.0, 0.1, 0.2)
+
+        def churn():
+            try:
+                for _ in range(300):
+                    m.reset_samples()
+                    snap = m.snapshot()
+                    lat = snap["latency_seconds"]["p50"]
+                    assert lat is None or lat == pytest.approx(0.02)
+                    busy = snap["device_busy_fraction"]
+                    assert busy is None or 0.0 <= busy <= 1.0
+            except BaseException as e:  # surfaced to the main thread
+                errors.append(e)
+            finally:
+                stop.set()
+
+        workers = [threading.Thread(target=observe) for _ in range(2)]
+        reader = threading.Thread(target=churn)
+        for t in workers:
+            t.start()
+        reader.start()
+        reader.join()
+        for t in workers:
+            t.join(timeout=5)
+        assert not errors
+
+    def test_snapshot_keys_are_the_stable_surface(self):
+        # bench_serve / the CLI / server_meta all index these keys; a
+        # rename is an API break and must be deliberate
+        snap = ServerMetrics().snapshot()
+        assert {
+            "counters", "queue_depth", "lanes_busy", "lanes_total",
+            "occupancy", "retraces", "snapshots_resident",
+            "snapshot_bytes", "shards", "quarantined_devices",
+            "uptime_seconds", "avg_window_seconds", "latency_seconds",
+            "wait_seconds", "device_busy_fraction", "host_gap_seconds",
+            "stream_lag_seconds", "stream_stall_seconds",
+            "stream_stalls",
+        } <= set(snap)
+
+
+class TestRequestTimingRows:
+    def _ticket(self, **kw):
+        t = Ticket(
+            request_id="req-000007",
+            request=ScenarioRequest(composite="x", horizon=8.0),
+        )
+        for k, v in kw.items():
+            setattr(t, k, v)
+        return t
+
+    def test_row_relativizes_against_t0(self):
+        t = self._ticket(
+            status="done", shard=1, steps_done=8,
+            submitted_at=10.0, admitted_at=10.5, first_window_at=10.6,
+            streamed_at=11.0, finished_at=10.9,
+        )
+        row = request_timing_row(t, t0=10.0)
+        assert row["rid"] == "req-000007"
+        assert row["queued"] == 0.0
+        assert row["admitted"] == 0.5
+        assert row["first_window"] == pytest.approx(0.6)
+        assert row["last_streamed"] == 1.0
+        assert row["retired"] == pytest.approx(0.9)
+        assert row["shard"] == 1 and row["steps_done"] == 8
+
+    def test_never_admitted_rows_carry_nones(self):
+        row = request_timing_row(
+            self._ticket(status="failed", error="boom",
+                         submitted_at=3.0),
+            t0=1.0,
+        )
+        assert row["queued"] == 2.0
+        assert row["admitted"] is None
+        assert row["first_window"] is None
+        assert row["last_streamed"] is None
+        assert row["error"] == "boom"
+
+    def test_write_server_meta_embeds_the_table(self, tmp_path):
+        m = ServerMetrics()
+        m.inc("retired")
+        rows = [request_timing_row(
+            self._ticket(status="done", submitted_at=time.perf_counter()),
+            t0=m._t0,
+        )]
+        path = write_server_meta(
+            str(tmp_path), {"bucket": {}}, m, requests=rows
+        )
+        meta = json.load(open(path))
+        assert meta["counters"]["retired"] == 1
+        assert meta["requests"][0]["rid"] == "req-000007"
+        assert os.path.basename(path) == "server_meta.json"
+
+    def test_write_server_meta_without_table_stays_compatible(
+        self, tmp_path
+    ):
+        path = write_server_meta(str(tmp_path), {}, ServerMetrics())
+        assert "requests" not in json.load(open(path))

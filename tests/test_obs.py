@@ -1,0 +1,495 @@
+"""Tracing + metrics time-series (round 14, docs/observability.md).
+
+Pins for the observability tentpole:
+
+- the :class:`~lens_tpu.obs.trace.Tracer` round-trips span/instant
+  events through the framed log, is thread-safe, and converts to
+  structurally valid Chrome trace-event JSON;
+- a served workload with ``trace_dir`` produces a span log covering
+  EVERY request stage — queue wait, admission, window dispatch, device
+  compute, streamer flush, retirement — including a prefix fork, a
+  hold spill, a FaultPlan-injected device quarantine with its
+  requeues, and a WAL recovery replay;
+- tracing is purely observational: traced results are bitwise equal to
+  untraced results, and a server without ``trace_dir`` writes nothing;
+- bounded-time failure messages (``SimulationDiverged``,
+  ``WatchdogTimeout`` via ``result``) name the failing request's last
+  completed stage and tick;
+- ``metrics_interval_s`` samples the registry into a ``metrics.jsonl``
+  ring, ``prometheus_metrics()`` exposes the pull format, and
+  ``server_meta.json`` carries the per-request timing table.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from lens_tpu.obs import (
+    MetricsRing,
+    NullTracer,
+    TRACE_NAME,
+    Tracer,
+    chrome_trace,
+    read_trace,
+)
+from lens_tpu.serve import (
+    DONE,
+    FaultPlan,
+    ScenarioRequest,
+    SimServer,
+    SimulationDiverged,
+)
+
+
+def _toggle_server(**kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+class TestTracer:
+    def test_span_instant_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.trace")
+        tr = Tracer(path)
+        t0 = tr.now()
+        tr.emit_span("work", t0, t0 + 0.5, track="scheduler",
+                     rid="req-0", tick=3)
+        tr.instant("mark", track="scheduler", shard=1)
+        with tr.span("ctx", track="scheduler", tick=4):
+            pass
+        tr.close()
+        events = read_trace(path)
+        assert [e["ev"] for e in events] == ["span", "instant", "span"]
+        span = events[0]
+        assert span["name"] == "work"
+        assert span["dur"] == pytest.approx(0.5)
+        assert span["args"] == {"rid": "req-0", "tick": 3}
+        assert events[1]["args"]["shard"] == 1
+        assert events[2]["name"] == "ctx"
+
+    def test_buffered_until_flush(self, tmp_path):
+        # the hot path never flushes per event; flush() makes the
+        # events visible without closing
+        path = str(tmp_path / "t.trace")
+        tr = Tracer(path)
+        tr.instant("a")
+        tr.flush()
+        assert len(read_trace(path)) == 1
+        tr.close()
+
+    def test_thread_safety(self, tmp_path):
+        path = str(tmp_path / "t.trace")
+        tr = Tracer(path)
+
+        def emit(k):
+            for i in range(100):
+                tr.instant(f"t{k}", i=i)
+
+        threads = [
+            threading.Thread(target=emit, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tr.close()
+        events = read_trace(path)
+        assert len(events) == 400  # no torn frames, no lost events
+        assert tr.events_emitted == 400
+
+    def test_null_tracer_is_falsy_noop(self):
+        tr = NullTracer()
+        assert not tr
+        tr.emit_span("x", 0.0, 1.0)
+        tr.instant("y")
+        with tr.span("z"):
+            pass
+        tr.flush()
+        tr.close()
+
+    def test_emits_after_close_are_dropped(self, tmp_path):
+        # the stream thread may race close(); late events must neither
+        # crash nor corrupt the file
+        tr = Tracer(str(tmp_path / "t.trace"))
+        tr.close()
+        tr.instant("late")
+        tr.emit_span("late", 0.0, 1.0)
+
+
+class TestChromeConversion:
+    def _events(self):
+        return [
+            {"ev": "span", "name": "window.device", "track": "device:0",
+             "ts": 0.0, "dur": 0.01, "args": {"tick": 1}},
+            {"ev": "span", "name": "queue.wait", "track": "requests",
+             "ts": 0.001, "dur": 0.5, "aid": "req-0",
+             "args": {"rid": "req-0"}},
+            {"ev": "span", "name": "queue.wait", "track": "requests",
+             "ts": 0.002, "dur": 0.4, "aid": "req-1",
+             "args": {"rid": "req-1"}},
+            {"ev": "instant", "name": "retire", "track": "scheduler",
+             "ts": 0.6, "args": {"rid": "req-0"}},
+        ]
+
+    def test_structure_is_valid_trace_event_json(self):
+        out = chrome_trace(self._events())
+        assert set(out) == {"traceEvents", "displayTimeUnit"}
+        json.dumps(out)  # serializable
+        phases = {}
+        for e in out["traceEvents"]:
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] != "M":
+                assert "ts" in e
+            phases[e["ph"]] = phases.get(e["ph"], 0) + 1
+        # one X complete event, two async pairs, one instant, metadata
+        assert phases["X"] == 1
+        assert phases["b"] == 2 and phases["e"] == 2  # balanced pairs
+        assert phases["i"] == 1
+        assert phases["M"] >= 4  # process + thread names
+
+    def test_tracks_become_named_threads(self):
+        out = chrome_trace(self._events())
+        names = {
+            e["args"]["name"]
+            for e in out["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert {"device:0", "requests", "scheduler"} <= names
+
+    def test_timestamps_are_microseconds(self):
+        out = chrome_trace(self._events())
+        x = next(e for e in out["traceEvents"] if e["ph"] == "X")
+        assert x["ts"] == pytest.approx(0.0)
+        assert x["dur"] == pytest.approx(10_000)  # 0.01 s
+
+
+class TestServeTracing:
+    def test_trace_covers_every_request_stage(self, tmp_path):
+        """The acceptance workload: plain requests, a shared-prefix
+        fork pair (miss + coalesce + hit), and a hold_state spill under
+        recover_dir — every stage named in the span taxonomy appears,
+        and the log converts to valid Chrome JSON."""
+        d = str(tmp_path / "obs")
+        srv = _toggle_server(
+            out_dir=d, sink="log", trace_dir=d,
+            recover_dir=str(tmp_path / "wal"),
+        )
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        # two concurrent submitters of one prefix: miss + coalesce
+        fork = dict(
+            composite="toggle_colony", seed=2, horizon=24.0,
+            prefix={"horizon": 8.0},
+        )
+        srv.submit(ScenarioRequest(**fork))
+        srv.submit(ScenarioRequest(
+            **{**fork, "overrides": {"global": {"volume": 1.2}}}
+        ))
+        srv.run_until_idle(max_ticks=300)
+        # a third prefix submit AFTER the snapshot landed: a hit
+        srv.submit(ScenarioRequest(
+            **{**fork, "overrides": {"global": {"volume": 1.4}}}
+        ))
+        # a hold_state request: retirement spills under recover_dir
+        hold = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=3, horizon=16.0,
+            hold_state=True,
+        ))
+        srv.run_until_idle(max_ticks=300)
+        assert srv.status(hold)["status"] == DONE
+        srv.close()
+
+        events = read_trace(os.path.join(d, TRACE_NAME))
+        names = {e["name"] for e in events}
+        assert {
+            "queue.wait", "admit", "window.dispatch", "window.device",
+            "window.stream", "retire", "prefix.miss",
+            "prefix.coalesced", "prefix.hit", "hold.spill",
+            "snapshot.put", "wal.sync",
+        } <= names
+        # correlation payload: every queue.wait names its request and
+        # is an async span (aid) so overlapping waits render correctly
+        waits = [e for e in events if e["name"] == "queue.wait"]
+        assert all("rid" in e["args"] and e["aid"] for e in waits)
+        out = chrome_trace(events)
+        json.dumps(out)
+        assert any(e["ph"] == "b" for e in out["traceEvents"])
+        assert any(e["ph"] == "X" for e in out["traceEvents"])
+
+    def test_trace_quarantine_and_requeue(self, tmp_path):
+        """A FaultPlan device_down drill on a 2-device mesh leaves the
+        quarantine, the injected fault, and every displaced request's
+        requeue on the timeline — and every request still completes."""
+        d = str(tmp_path / "obs")
+        srv = _toggle_server(
+            lanes=2, mesh=2, trace_dir=d,
+            faults=FaultPlan([{"kind": "device_down", "shard": 1}]),
+        )
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=16.0,
+            ))
+            for s in range(4)
+        ]
+        srv.run_until_idle(max_ticks=300)
+        statuses = [srv.status(r)["status"] for r in rids]
+        assert statuses == [DONE] * 4
+        assert srv.metrics()["counters"]["requeued"] >= 1
+        srv.close()
+        events = read_trace(os.path.join(d, TRACE_NAME))
+        names = {e["name"] for e in events}
+        assert {"fault.injected", "device.quarantined",
+                "request.requeued"} <= names
+        q = next(e for e in events if e["name"] == "device.quarantined")
+        assert q["args"]["shard"] == 1
+        # the requeued requests' device spans name the surviving shard
+        rq = [e for e in events if e["name"] == "request.requeued"]
+        assert all(e["args"]["shard"] == 1 for e in rq)
+
+    def test_recovery_replay_span(self, tmp_path):
+        """A server recovering a WAL emits a recovery.replay span."""
+        wal = str(tmp_path / "wal")
+        out = str(tmp_path / "out")
+        srv = _toggle_server(out_dir=out, sink="log", recover_dir=wal)
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        # close with the request still queued: the WAL knows it,
+        # nothing retired it — recovery must re-queue it
+        srv.close()
+        d = str(tmp_path / "trace2")
+        srv2 = _toggle_server(
+            out_dir=out, sink="log", recover_dir=wal, trace_dir=d,
+        )
+        assert srv2.recovered == 1
+        srv2.run_until_idle(max_ticks=200)
+        srv2.close()
+        events = read_trace(os.path.join(d, TRACE_NAME))
+        replay = [e for e in events if e["name"] == "recovery.replay"]
+        assert len(replay) == 1 and replay[0]["ev"] == "span"
+
+    def test_traced_bitwise_equals_untraced(self, tmp_path):
+        """Tracing + metrics sampling observe, never perturb: the
+        streamed results are byte-identical with both armed."""
+        req = dict(composite="toggle_colony", seed=9, horizon=24.0)
+        plain = _toggle_server()
+        r0 = plain.submit(ScenarioRequest(**req))
+        plain.run_until_idle(max_ticks=200)
+        want = plain.result(r0)
+        plain.close()
+        traced = _toggle_server(
+            trace_dir=str(tmp_path / "t"), metrics_interval_s=0.0,
+        )
+        r1 = traced.submit(ScenarioRequest(**req))
+        traced.run_until_idle(max_ticks=200)
+        got = traced.result(r1)
+        traced.close()
+        assert _leaves_equal(want, got)
+
+    def test_sync_pipeline_traces_the_same_tracks(self, tmp_path):
+        """pipeline="off" emits the same window.device/window.stream
+        spans from the scheduler thread, so a sync trace renders on
+        the same timeline tracks as a pipelined one."""
+        d = str(tmp_path / "obs")
+        srv = _toggle_server(pipeline="off", trace_dir=d)
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.close()
+        events = read_trace(os.path.join(d, TRACE_NAME))
+        names = {e["name"] for e in events}
+        assert {"window.device", "window.stream", "retire"} <= names
+        tracks = {e["track"] for e in events}
+        assert "device:0" in tracks and "streamer" in tracks
+
+    def test_no_trace_dir_writes_nothing(self, tmp_path):
+        srv = _toggle_server(out_dir=str(tmp_path), sink="log")
+        assert not srv.trace
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.close()
+        assert not os.path.exists(str(tmp_path / TRACE_NAME))
+        assert not os.path.exists(str(tmp_path / "metrics.jsonl"))
+
+    def test_diverged_error_names_stage_and_tick(self):
+        """Satellite: a bounded-time failure says where progress
+        stopped — the SimulationDiverged message carries the ticket's
+        last completed stage and the detection tick."""
+        srv = _toggle_server(
+            check_finite="window",
+            faults=FaultPlan([{
+                "kind": "nan", "request": "req-000000",
+            }]),
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=32.0,
+        ))
+        srv.run_until_idle(max_ticks=200)
+        with pytest.raises(SimulationDiverged) as err:
+            srv.result(rid)
+        msg = str(err.value)
+        assert "last completed stage" in msg
+        assert "window dispatched" in msg
+        assert "detected at tick" in msg
+        srv.close()
+
+
+class TestMetricsTimeSeries:
+    def test_metrics_jsonl_ring_sampling(self, tmp_path):
+        d = str(tmp_path / "obs")
+        srv = _toggle_server(trace_dir=d, metrics_interval_s=0.0)
+        for s in range(3):
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=16.0,
+            ))
+        srv.run_until_idle(max_ticks=200)
+        srv.close()
+        path = os.path.join(d, "metrics.jsonl")
+        points = [json.loads(l) for l in open(path) if l.strip()]
+        assert len(points) >= 2
+        ts = [p["t"] for p in points]
+        assert ts == sorted(ts)
+        # counters are monotone through the series; the close-time
+        # point carries the final values
+        retired = [p["counters"]["retired"] for p in points]
+        assert retired == sorted(retired)
+        assert retired[-1] == 3
+        last = points[-1]
+        assert "queue_depth" in last["gauges"]
+        assert "latency_seconds" in last["histograms"]
+        assert "lag" in last["stream"]
+
+    def test_metrics_interval_needs_somewhere_to_write(self):
+        with pytest.raises(ValueError, match="metrics_interval_s"):
+            _toggle_server(metrics_interval_s=1.0)
+
+    def test_prometheus_pull(self):
+        srv = _toggle_server()
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        text = srv.prometheus_metrics()
+        srv.close()
+        assert "# TYPE lens_serve_submitted_total counter" in text
+        assert "lens_serve_submitted_total 1" in text
+        assert "# TYPE lens_serve_queue_depth gauge" in text
+        assert "# TYPE lens_serve_latency_seconds summary" in text
+        assert 'quantile="0.5"' in text
+        assert rid is not None
+
+    def test_ring_rotation_bounds_the_file(self, tmp_path):
+        ring = MetricsRing(str(tmp_path / "m.jsonl"), max_records=10)
+        for i in range(35):
+            ring.append({"i": i})
+        recs = ring.records()
+        ring.close()
+        assert len(recs) <= 20  # never more than 2x the bound
+        assert recs[-1]["i"] == 34  # newest always survives
+        assert recs[0]["i"] >= 15  # oldest rewritten away
+
+
+class TestRequestTimingTable:
+    def test_server_meta_gains_per_request_rows(self, tmp_path):
+        out = str(tmp_path / "serve")
+        srv = _toggle_server(out_dir=out, sink="log")
+        rids = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=s, horizon=16.0,
+            ))
+            for s in range(2)
+        ]
+        srv.run_until_idle(max_ticks=100)
+        srv.close()
+        meta = json.load(open(os.path.join(out, "server_meta.json")))
+        rows = {r["rid"]: r for r in meta["requests"]}
+        assert set(rows) == set(rids)
+        for r in rows.values():
+            assert r["status"] == DONE
+            # lifecycle order: queued <= admitted <= first window <=
+            # last streamed; retired is bookkeeping and may precede
+            # the final stream under the pipeline
+            assert r["queued"] <= r["admitted"] <= r["first_window"]
+            assert r["first_window"] <= r["last_streamed"]
+            assert r["retired"] is not None
+            assert r["steps_done"] == 16
+
+    def test_internal_prefix_runs_stay_out_of_the_table(self, tmp_path):
+        out = str(tmp_path / "serve")
+        srv = _toggle_server(out_dir=out, sink="log")
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=24.0,
+            prefix={"horizon": 8.0},
+        ))
+        srv.run_until_idle(max_ticks=200)
+        srv.close()
+        meta = json.load(open(os.path.join(out, "server_meta.json")))
+        assert [r["rid"] for r in meta["requests"]] == [rid]
+
+
+class TestTraceCli:
+    def test_trace_subcommand_renders_chrome_json(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        d = str(tmp_path / "obs")
+        srv = _toggle_server(trace_dir=d)
+        srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        srv.close()
+        out = str(tmp_path / "trace.json")
+        assert main(["trace", d, "--out", out]) == 0
+        rendered = json.load(open(out))
+        assert rendered["traceEvents"]
+        stdout = capsys.readouterr().out
+        assert "chrome trace" in stdout
+
+    def test_trace_subcommand_missing_log(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        assert main(["trace", str(tmp_path)]) == 2
+        assert "no span log" in capsys.readouterr().err
+
+
+class TestSweepTrialSpans:
+    def test_sweep_emits_per_trial_spans(self, tmp_path):
+        from lens_tpu.sweep import run_sweep
+
+        d = str(tmp_path / "obs")
+        spec = {
+            "composite": "toggle_colony",
+            "space": {"kind": "grid", "params": {
+                "global/volume": {"grid": [1.0, 1.2, 1.4]},
+            }},
+            "horizon": 16.0,
+            "objective": {"path": "global/volume",
+                          "reduce": "final_mean"},
+            "backend": {"kind": "server", "lanes": 2, "window": 8,
+                        "trace_dir": d},
+        }
+        result = run_sweep(spec)
+        assert all(r["status"] == "done" for r in result.table)
+        events = read_trace(os.path.join(d, TRACE_NAME))
+        trials = [e for e in events if e["name"] == "trial"]
+        assert {e["args"]["trial"] for e in trials} == {0, 1, 2}
+        assert all(e["aid"] == f"trial-{e['args']['trial']}"
+                   for e in trials)
+        assert all(e["args"]["status"] == "done" for e in trials)
