@@ -37,6 +37,20 @@ wraps. Three kinds of record, written to ``BENCH_SERVE_CPU_r10.json``
    contract of docs/observability.md (on <= 2%, off bitwise equal,
    pinned by a byte-equal request on both servers).
 
+5. **Front-door load + chaos** (``--frontdoor`` mode, round 15,
+   written to ``BENCH_FRONTDOOR_CPU_r15.json``): the HTTP layer under
+   1000 concurrent keep-alive clients split across 3 tenants — one
+   interactive ("gold", weight 2), one batch ("silver"), one FLOODING
+   ("flood": rate-limited + quota'd, submitting with 429-honoring
+   retries) — recording per-tenant p50/p95/p99 submit→first-byte and
+   submit→done over the SSE record stream, plus reject/throttle
+   counts (the pushback must land on the flooding tenant ONLY). A
+   second CHAOS row repeats the load on a mesh=2 server with a
+   ``device_down`` + sink ``io_error`` FaultPlan injected mid-flight:
+   the SLO is that every non-faulted request completes and every
+   completed request's streamed bytes equal its on-disk log
+   (docs/serving.md, "Front door").
+
 Composite: ``toggle_colony`` (config-1 cell; deterministic, light
 biology) — the point is to measure the SERVING machinery, not the
 biology, so the cheapest real composite gives the most sensitive
@@ -50,6 +64,15 @@ import json
 import os
 import sys
 import time
+
+if "--frontdoor" in sys.argv and "xla_force_host_platform_device_" \
+        "count" not in os.environ.get("XLA_FLAGS", ""):
+    # the front-door chaos row runs mesh=2 (device_down failover
+    # under HTTP load); simulate the devices on CPU
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
 
 if "--mesh" in sys.argv and "xla_force_host_platform_device_count" \
         not in os.environ.get("XLA_FLAGS", ""):
@@ -970,6 +993,391 @@ def run_mesh_bench(args) -> int:
     return 0 if ok else 1
 
 
+# -- front-door load + chaos (round 15) -------------------------------------
+
+
+class _FdClient:
+    """Minimal asyncio HTTP/1.1 keep-alive client for the front-door
+    bench: 1000 of these share one event loop, which is the cheapest
+    way to BE 1000 concurrent clients on a small CPU box."""
+
+    def __init__(self, host, port, headers=None):
+        self.host = host
+        self.port = port
+        self.headers = dict(headers or {})
+        self.reader = None
+        self.writer = None
+
+    async def connect(self):
+        import asyncio
+
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+
+    async def _read_head(self):
+        status = int(
+            (await self.reader.readline()).split(b" ", 2)[1]
+        )
+        headers = {}
+        while True:
+            line = await self.reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode().partition(":")
+            headers[name.strip().lower()] = value.strip()
+        return status, headers
+
+    async def request(self, method, path, body=None):
+        payload = b"" if body is None else json.dumps(body).encode()
+        head = [f"{method} {path} HTTP/1.1",
+                f"Host: {self.host}:{self.port}"]
+        head += [f"{k}: {v}" for k, v in self.headers.items()]
+        if payload:
+            head.append(f"Content-Length: {len(payload)}")
+        self.writer.write(
+            ("\r\n".join(head) + "\r\n\r\n").encode() + payload
+        )
+        await self.writer.drain()
+        status, headers = await self._read_head()
+        body_bytes = await self.reader.readexactly(
+            int(headers.get("content-length", 0))
+        )
+        try:
+            parsed = json.loads(body_bytes)
+        except (ValueError, UnicodeDecodeError):
+            parsed = body_bytes
+        return status, parsed, headers
+
+    async def stream(self, path):
+        """Open an SSE record stream; returns (t_first_record, body
+        bytes) — first-record wall stamp taken the moment the chunk
+        carrying the first ``record`` event lands."""
+        head = [f"GET {path} HTTP/1.1",
+                f"Host: {self.host}:{self.port}"]
+        head += [f"{k}: {v}" for k, v in self.headers.items()]
+        self.writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+        await self.writer.drain()
+        status, headers = await self._read_head()
+        assert status == 200, status
+        body = b""
+        t_first = None
+        while True:
+            size_line = await self.reader.readline()
+            n = int(size_line.strip() or b"0", 16)
+            if n == 0:
+                await self.reader.readline()  # trailing CRLF
+                return t_first, body
+            chunk = await self.reader.readexactly(n)
+            await self.reader.readexactly(2)  # CRLF
+            if t_first is None and b"event: record" in chunk:
+                t_first = time.perf_counter()
+            body += chunk
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
+
+
+async def _fd_one_client(host, port, key, body, out, max_attempts=12):
+    """One keep-alive client: submit (honoring 429 Retry-After with
+    bounded retries), then consume the whole SSE record stream."""
+    import asyncio
+
+    c = _FdClient(host, port, {"Authorization": f"Bearer {key}"})
+    row = {"ok": False, "throttled": 0, "rejected": 0, "rid": None,
+           "status": None, "first_byte_s": None, "done_s": None,
+           "raw": b""}
+    out.append(row)
+    try:
+        await c.connect()
+        t0 = time.perf_counter()
+        attempts = 0
+        while True:
+            status, payload, headers = await c.request(
+                "POST", "/v1/requests", body
+            )
+            if status == 202:
+                break
+            if status == 429:
+                # the honest-backpressure loop: sleep the hint, retry
+                row["throttled"] += 1
+                attempts += 1
+                if attempts >= max_attempts:
+                    row["status"] = "gave_up"
+                    return
+                await asyncio.sleep(
+                    min(float(headers.get("retry-after", 0.2)), 2.0)
+                )
+                continue
+            row["status"] = f"http_{status}"
+            row["rejected"] += 1
+            return
+        row["rid"] = payload["rid"]
+        t_first, body_bytes = await c.stream(
+            f"/v1/requests/{payload['rid']}/stream"
+        )
+        t_done = time.perf_counter()
+        from lens_tpu.frontdoor import decode_record_events
+
+        raw, end = decode_record_events(body_bytes)
+        row["status"] = end["status"]
+        row["ok"] = end["status"] == "done"
+        row["raw"] = raw
+        if t_first is not None:
+            row["first_byte_s"] = t_first - t0
+        row["done_s"] = t_done - t0
+    finally:
+        c.close()
+
+
+def _fd_run_load(fd, plan):
+    """Run one load plan ({tenant: (key, n_clients, request_body)})
+    with every client concurrent on one event loop; returns
+    {tenant: [rows]} and the wall seconds."""
+    import asyncio
+
+    results = {tenant: [] for tenant in plan}
+
+    async def run():
+        tasks = []
+        for tenant, (key, n, body) in plan.items():
+            for i in range(n):
+                req = dict(body)
+                req["seed"] = i
+                tasks.append(asyncio.wait_for(
+                    _fd_one_client(
+                        "127.0.0.1", fd.port, key, req,
+                        results[tenant],
+                    ),
+                    timeout=900,
+                ))
+        await asyncio.gather(*tasks)
+
+    t0 = time.perf_counter()
+    asyncio.run(run())
+    return results, time.perf_counter() - t0
+
+
+def _fd_tenant_summary(rows):
+    from lens_tpu.obs.metrics import percentiles
+
+    done = [r for r in rows if r["ok"]]
+    return {
+        "clients": len(rows),
+        "completed": len(done),
+        "throttled_429": sum(r["throttled"] for r in rows),
+        "gave_up": sum(
+            1 for r in rows if r["status"] == "gave_up"
+        ),
+        "first_byte_s": percentiles(
+            [r["first_byte_s"] for r in done
+             if r["first_byte_s"] is not None]
+        ),
+        "done_s": percentiles(
+            [r["done_s"] for r in done if r["done_s"] is not None]
+        ),
+        "streamed_bytes": sum(len(r["raw"]) for r in rows),
+    }
+
+
+def _fd_bytes_equal(out_dir, rows):
+    """Every completed request's streamed bytes vs its on-disk log."""
+    checked = mismatched = 0
+    for r in rows:
+        if not r["ok"]:
+            continue
+        path = os.path.join(out_dir, f"{r['rid']}.lens")
+        with open(path, "rb") as f:
+            disk = f.read()
+        checked += 1
+        if r["raw"] != disk:
+            mismatched += 1
+    return checked, mismatched
+
+
+def run_frontdoor_bench(args) -> int:
+    import shutil
+    import tempfile
+
+    from lens_tpu.frontdoor import FrontDoor
+    from lens_tpu.serve import FaultPlan
+
+    lanes = (args.lanes or [8])[0]
+    window = args.window
+    horizon = float(args.horizon_windows * window)
+    n_gold, n_silver, n_flood = args.frontdoor_clients
+    record = {
+        "bench": "frontdoor",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": window,
+        "lanes": lanes,
+        "horizon_steps": int(horizon),
+        "clients": {"gold": n_gold, "silver": n_silver,
+                    "flood": n_flood},
+        "stream_poll_s": 0.1,
+        "tenants": {
+            "gold": {"weight": 2.0, "priority": "interactive"},
+            "silver": {"weight": 1.0, "priority": "batch"},
+            "flood": {"weight": 1.0, "priority": "batch",
+                      "rate": args.flood_rate, "burst": 25,
+                      "max_inflight": 32, "queue_depth": 64},
+        },
+        "rows": [],
+    }
+
+    def tenant_table():
+        return [
+            {"name": "gold", "api_key": "gk", "weight": 2.0,
+             "default_priority": "interactive",
+             "queue_depth": 4096},
+            {"name": "silver", "api_key": "sk", "weight": 1.0,
+             "queue_depth": 4096},
+            {"name": "flood", "api_key": "fk", "weight": 1.0,
+             "rate": args.flood_rate, "burst": 25,
+             "max_inflight": 32, "queue_depth": 64},
+        ]
+
+    def one_row(label, n_clients, mesh, faults, io_victim=None):
+        out_dir = tempfile.mkdtemp(prefix=f"bench_fd_{label}_")
+        srv = SimServer.single_bucket(
+            args.composite,
+            capacity=args.capacity,
+            lanes=lanes,
+            window=window,
+            emit_every=args.emit_every,
+            queue_depth=64,
+            sink="log",
+            out_dir=out_dir,
+            sink_errors="request",
+            mesh=mesh,
+            faults=faults,
+        )
+        _warm(srv, args.composite, lanes, window)
+        fd = FrontDoor(
+            srv, tenants=tenant_table(), own_server=True,
+            stream_poll_s=0.1,
+        ).start()
+        try:
+            gold, silver, flood = n_clients
+            results, wall = _fd_run_load(fd, {
+                "gold": ("gk", gold, {"horizon": horizon}),
+                "silver": ("sk", silver, {"horizon": horizon}),
+                "flood": ("fk", flood, {"horizon": horizon}),
+            })
+            snap = srv.metrics()
+            row = {
+                "row": label,
+                "mesh": mesh,
+                "wall_s": round(wall, 3),
+                "req_s": round(
+                    sum(
+                        1 for rows in results.values()
+                        for r in rows if r["ok"]
+                    ) / wall, 2,
+                ),
+                "tenants": {
+                    t: _fd_tenant_summary(rows)
+                    for t, rows in results.items()
+                },
+                "server_tenants": snap["tenants"],
+                "counters": {
+                    k: snap["counters"][k]
+                    for k in ("submitted", "admitted", "retired",
+                              "failed", "rejected", "requeued",
+                              "sink_failed")
+                },
+                "quarantined_devices": snap["quarantined_devices"],
+            }
+            # pushback must land on the flooding tenant only
+            row["pushback_flood_only"] = (
+                row["tenants"]["flood"]["throttled_429"] > 0
+                and row["tenants"]["gold"]["throttled_429"] == 0
+                and row["tenants"]["silver"]["throttled_429"] == 0
+            )
+            checked = mismatched = 0
+            for rows in results.values():
+                c, m = _fd_bytes_equal(out_dir, rows)
+                checked += c
+                mismatched += m
+            row["bytes_checked"] = checked
+            row["bytes_mismatched"] = mismatched
+            if io_victim is not None:
+                # chaos SLO: the io_error victim fails alone; every
+                # OTHER submitted request completes (device_down
+                # displacements re-run to done on the survivor)
+                statuses = {
+                    r["rid"]: r["status"]
+                    for rows in results.values() for r in rows
+                    if r["rid"] is not None
+                }
+                victim_status = statuses.get(io_victim)
+                non_faulted = {
+                    rid: s for rid, s in statuses.items()
+                    if rid != io_victim
+                }
+                row["chaos"] = {
+                    "io_victim": io_victim,
+                    "io_victim_status": victim_status,
+                    "non_faulted": len(non_faulted),
+                    "non_faulted_completed": sum(
+                        1 for s in non_faulted.values() if s == "done"
+                    ),
+                    "slo_held": all(
+                        s == "done" for s in non_faulted.values()
+                    ) and victim_status == "failed",
+                }
+            return row
+        finally:
+            fd.close()
+            shutil.rmtree(out_dir, ignore_errors=True)
+
+    row = one_row("load", (n_gold, n_silver, n_flood), None, None)
+    record["rows"].append(row)
+    print(json.dumps(row), flush=True)
+
+    # chaos: device 1 dies shortly into the load (occurrence counts
+    # that shard's window DISPATCHES — one per tick, so keep it well
+    # under the load's dispatch count; warmup contributes ~2), one
+    # request's sink raises — under the same 3-tenant HTTP load on a
+    # mesh=2 server
+    gold_c, silver_c, flood_c = args.chaos_clients
+    victim = f"req-{lanes + (gold_c + silver_c) // 3:06d}"
+    plan = FaultPlan([
+        {"kind": "device_down", "shard": 1, "occurrence": 6},
+        {"kind": "io_error", "request": victim},
+    ])
+    row = one_row(
+        "chaos", (gold_c, silver_c, flood_c), 2, plan,
+        io_victim=victim,
+    )
+    record["rows"].append(row)
+    print(json.dumps(row), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    for row in record["rows"]:
+        g = row["tenants"]["gold"]["done_s"]
+        f_ = row["tenants"]["flood"]
+        print(
+            f"{row['row']}: wall={row['wall_s']}s "
+            f"gold p50/p99 done={g['p50']:.2f}/{g['p99']:.2f}s "
+            f"flood throttled={f_['throttled_429']} "
+            f"pushback_flood_only={row['pushback_flood_only']} "
+            f"bytes_mismatched={row['bytes_mismatched']}"
+            + (
+                f" slo_held={row['chaos']['slo_held']} "
+                f"quarantined={row['quarantined_devices']} "
+                f"requeued={row['counters']['requeued']}"
+                if "chaos" in row else ""
+            )
+        )
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--composite", default="toggle_colony")
@@ -977,8 +1385,17 @@ def main() -> int:
     # that the window's device work is representative (a 32-row bucket
     # measures Python dispatch, not serving — see the README of
     # BENCH_SERVE record for the overhead-dominated small-bucket point)
-    p.add_argument("--capacity", type=int, default=256)
-    p.add_argument("--window", type=int, default=64)
+    p.add_argument(
+        "--capacity", type=int, default=None,
+        help="bucket rows (default: 256; --frontdoor mode: 64 — the "
+        "front-door bench measures the HTTP/tenancy layer, so the "
+        "per-window device work stays small)",
+    )
+    p.add_argument(
+        "--window", type=int, default=None,
+        help="steps per scheduler tick (default: 64; --frontdoor "
+        "mode: 8)",
+    )
     p.add_argument("--emit-every", type=int, default=8)
     p.add_argument(
         "--lanes", type=int, nargs="+", default=None,
@@ -1026,6 +1443,34 @@ def main() -> int:
         "unless --out is given)",
     )
     p.add_argument(
+        "--frontdoor", action="store_true",
+        help="run the round-15 HTTP front-door bench: 1000 concurrent "
+        "keep-alive clients across 3 tenants (one flooding) with "
+        "per-tenant submit→first-byte / submit→done percentiles and "
+        "429 pushback counts, plus a mesh=2 chaos row (device_down + "
+        "sink io_error under load, SLO held). Writes "
+        "BENCH_FRONTDOOR_CPU_r15.json unless --out is given",
+    )
+    p.add_argument(
+        "--frontdoor-clients", type=int, nargs=3,
+        default=[300, 300, 400], metavar=("GOLD", "SILVER", "FLOOD"),
+        help="concurrent clients per tenant for the front-door load "
+        "row (gold=interactive, silver=batch, flood=rate-limited "
+        "batch)",
+    )
+    p.add_argument(
+        "--chaos-clients", type=int, nargs=3, default=[60, 60, 80],
+        metavar=("GOLD", "SILVER", "FLOOD"),
+        help="concurrent clients per tenant for the front-door chaos "
+        "row",
+    )
+    p.add_argument(
+        "--flood-rate", type=float, default=40.0,
+        help="the flooding tenant's token-bucket rate (requests/s) — "
+        "its 400 clients burst far past this, so the 429 pushback "
+        "is visible by construction",
+    )
+    p.add_argument(
         "--prefix-frac", type=float, default=0.75,
         help="shared-prefix fraction of the horizon (fork A/B), "
         "snapped to whole windows",
@@ -1045,12 +1490,21 @@ def main() -> int:
     # per-mode defaults (None = not explicitly passed)
     if sum(
         1 for m in (args.prefix, args.faults, args.mesh is not None,
-                    args.trace)
+                    args.trace, args.frontdoor)
         if m
     ) > 1:
         raise SystemExit(
-            "--prefix / --faults / --mesh / --trace are separate modes"
+            "--prefix / --faults / --mesh / --trace / --frontdoor "
+            "are separate modes"
         )
+    args.capacity = args.capacity or (
+        64 if args.frontdoor else 256
+    )
+    args.window = args.window or (8 if args.frontdoor else 64)
+    if args.frontdoor:
+        args.out = args.out or "BENCH_FRONTDOOR_CPU_r15.json"
+        args.horizon_windows = args.horizon_windows or 2
+        return run_frontdoor_bench(args)
     if args.trace:
         args.out = args.out or "BENCH_OBS_CPU_r14.json"
         args.lanes = args.lanes or [2, 4, 8]

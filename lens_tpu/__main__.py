@@ -10,6 +10,11 @@ experiment layer:
 - ``serve``   continuous-batching scenario server: many small requests
   multiplexed onto one resident jitted multi-lane program
   (lens_tpu.serve; see docs/serving.md)
+- ``frontdoor``  the same server behind an async HTTP front end:
+  submit / status / SSE record streaming / cancel with multi-tenant
+  fair-share admission, priority lanes, rate limits, and Prometheus
+  ``/metrics`` (lens_tpu.frontdoor; docs/serving.md, "Front door");
+  SIGTERM/SIGINT drain gracefully
 - ``sweep``   resumable parameter sweep / adaptive search from a JSON
   spec: grid/random/LHS spaces, scalar objectives, successive-halving
   early stopping, crash-safe ledger resume (lens_tpu.sweep; see
@@ -56,6 +61,142 @@ def _parse_mesh(value: str) -> dict:
         raise argparse.ArgumentTypeError(
             f"{value!r} is not AGENTSxSPACE (e.g. 4x2)"
         )
+
+
+def _add_bucket_args(p: argparse.ArgumentParser) -> None:
+    """The bucket knobs shared by ``serve`` and ``frontdoor`` (one
+    bucket per CLI invocation; the in-process SimServer API takes
+    arbitrary bucket maps)."""
+    p.add_argument(
+        "--composite", default="toggle_colony",
+        help="the bucket's composite (one bucket per invocation; "
+        "the in-process SimServer API takes arbitrary bucket maps)",
+    )
+    p.add_argument(
+        "--config", default="{}", help="composite config as JSON"
+    )
+    p.add_argument("--capacity", type=int, default=None)
+    p.add_argument(
+        "--lanes", type=int, default=4, help="resident lane count L"
+    )
+    p.add_argument(
+        "--window", type=int, default=32,
+        help="steps per scheduler tick (amortizes dispatch; coarsens "
+        "admission granularity)",
+    )
+    p.add_argument("--timestep", type=float, default=1.0)
+    p.add_argument("--emit-every", type=int, default=1)
+
+
+def _add_server_args(
+    p: argparse.ArgumentParser, frontdoor_defaults: bool = False
+) -> None:
+    """The SimServer knobs shared by ``serve`` and ``frontdoor``.
+    ``frontdoor_defaults`` flips the policies whose right default
+    differs for a multi-tenant network server (sink errors scoped to
+    one request instead of fatal)."""
+    p.add_argument(
+        "--queue-depth", type=int, default=64,
+        help="bounded admission queue; a full queue rejects with a "
+        "retry-after hint",
+    )
+    p.add_argument(
+        "--pipeline", choices=["on", "off"], default="on",
+        help="depth-2 serve pipeline: overlap device windows with "
+        "background host-side streaming (off = the synchronous "
+        "debugging path; results are bitwise identical either way)",
+    )
+    p.add_argument(
+        "--stream-queue", type=int, default=2,
+        help="max windows queued/processing on the background "
+        "streamer before the scheduler stalls (pipeline "
+        "backpressure depth)",
+    )
+    p.add_argument(
+        "--flush-every", type=int, default=1,
+        help="flush each request's result log every k-th window "
+        "append (batched flush; 1 = tightest tailing-reader "
+        "visibility)",
+    )
+    p.add_argument(
+        "--snapshot-budget-mb", type=float, default=256.0,
+        help="byte budget (MiB) for the content-addressed snapshot "
+        "store behind request prefix caching and hold_state "
+        "(unpinned prefix snapshots are evicted LRU-first past it; "
+        "see docs/serving.md, 'Prefix caching & forking')",
+    )
+    p.add_argument(
+        "--check-finite", choices=["off", "window"], default="off",
+        help="lane quarantine: per-window finite check over every "
+        "lane's state; a lane that goes NaN/Inf fails ONLY its "
+        "request (SimulationDiverged) and is reclaimed, co-batched "
+        "lanes untouched (docs/serving.md, 'Fault tolerance & "
+        "recovery'). off = the bitwise round-11 path",
+    )
+    p.add_argument(
+        "--watchdog", type=float, default=None, metavar="SECONDS",
+        help="expire a hung device-window/streamer handoff after this "
+        "many stalled seconds (WatchdogTimeout) instead of wedging "
+        "the scheduler forever; default: wait indefinitely",
+    )
+    p.add_argument(
+        "--sink-errors", choices=["fatal", "request"],
+        default="request" if frontdoor_defaults else "fatal",
+        help="what a failed result-sink append does: 'fatal' parks "
+        "the error on the stream pipe (single-operator batch "
+        "serving), 'request' fails only the owning request and "
+        "keeps serving everyone else (the multi-tenant policy; "
+        "docs/serving.md)",
+    )
+    p.add_argument(
+        "--recover-dir", default=None, metavar="DIR",
+        help="serve write-ahead log + held-snapshot spills live here; "
+        "if DIR already holds a WAL the server RECOVERS first "
+        "(finished requests keep their logs, unfinished ones re-run "
+        "bitwise) and the request list resumes past the requests "
+        "already recorded",
+    )
+    p.add_argument(
+        "--mesh", type=int, default=None, metavar="N",
+        help="shard the server across N devices (one resident lane "
+        "pool per device, a host scheduler ticking all shards; a "
+        "dead device quarantines and its requests fail over to the "
+        "survivors — docs/serving.md, 'Mesh serving & device "
+        "failover'). On CPU, simulate devices with "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=N. "
+        "Default: single default-device serving",
+    )
+    p.add_argument(
+        "--device-watchdog", type=float, default=None,
+        metavar="SECONDS",
+        help="quarantine a device whose dispatched window makes no "
+        "progress for this many seconds (whole-device fail-stop "
+        "detection; requests re-queue onto surviving devices)",
+    )
+    p.add_argument(
+        "--faults", default=None, metavar="JSON",
+        help="fault-injection plan (a JSON file, or '-' for stdin): "
+        '{"seed": 0, "faults": [{"kind": "nan", "request": '
+        '"req-000001", "after_steps": 16}, ...]} — deterministic '
+        "chaos for tests/CI (docs/serving.md, 'Fault injection')",
+    )
+    p.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="span tracing: append every request stage (queue wait, "
+        "admission, window dispatch, device compute, streamer flush, "
+        "retire, prefix resolution, spills, quarantines) to "
+        "DIR/serve.trace; convert with 'python -m lens_tpu trace DIR "
+        "--out trace.json' for Perfetto (docs/observability.md). "
+        "Default: tracing off (the bitwise-identical fast path)",
+    )
+    p.add_argument(
+        "--metrics-interval", type=float, default=None,
+        metavar="SECONDS",
+        help="sample server counters/gauges/latency histograms into a "
+        "metrics.jsonl time-series ring (in --trace-dir, else "
+        "--out-dir) every this many wall seconds; default: no "
+        "sampling",
+    )
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -170,30 +311,7 @@ def _build_parser() -> argparse.ArgumentParser:
         help="serve many scenario requests through one resident "
         "continuous-batching multi-lane program (docs/serving.md)",
     )
-    serve.add_argument(
-        "--composite", default="toggle_colony",
-        help="the bucket's composite (one bucket per serve invocation; "
-        "the in-process SimServer API takes arbitrary bucket maps)",
-    )
-    serve.add_argument(
-        "--config", default="{}", help="composite config as JSON"
-    )
-    serve.add_argument("--capacity", type=int, default=None)
-    serve.add_argument(
-        "--lanes", type=int, default=4, help="resident lane count L"
-    )
-    serve.add_argument(
-        "--window", type=int, default=32,
-        help="steps per scheduler tick (amortizes dispatch; coarsens "
-        "admission granularity)",
-    )
-    serve.add_argument("--timestep", type=float, default=1.0)
-    serve.add_argument("--emit-every", type=int, default=1)
-    serve.add_argument(
-        "--queue-depth", type=int, default=64,
-        help="bounded admission queue; a full queue rejects with a "
-        "retry-after hint",
-    )
+    _add_bucket_args(serve)
     serve.add_argument(
         "--requests", required=True,
         help="JSON file of request objects (or '-' for stdin): "
@@ -204,94 +322,44 @@ def _build_parser() -> argparse.ArgumentParser:
         "--out-dir", default="out/serve",
         help="per-request .lens result logs + server_meta.json land here",
     )
-    serve.add_argument(
-        "--pipeline", choices=["on", "off"], default="on",
-        help="depth-2 serve pipeline: overlap device windows with "
-        "background host-side streaming (off = the synchronous "
-        "debugging path; results are bitwise identical either way)",
+    _add_server_args(serve)
+
+    frontdoor = sub.add_parser(
+        "frontdoor",
+        help="expose the scenario server over an async HTTP front end "
+        "with multi-tenant fair-share admission and priority lanes "
+        "(docs/serving.md, 'Front door'); SIGTERM/SIGINT drain "
+        "gracefully",
     )
-    serve.add_argument(
-        "--stream-queue", type=int, default=2,
-        help="max windows queued/processing on the background "
-        "streamer before the scheduler stalls (pipeline "
-        "backpressure depth)",
+    _add_bucket_args(frontdoor)
+    frontdoor.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address (0.0.0.0 to accept remote clients)",
     )
-    serve.add_argument(
-        "--flush-every", type=int, default=1,
-        help="flush each request's result log every k-th window "
-        "append (batched flush; 1 = tightest tailing-reader "
-        "visibility)",
+    frontdoor.add_argument(
+        "--port", type=int, default=8080,
+        help="listen port (0 picks a free one, printed at startup)",
     )
-    serve.add_argument(
-        "--snapshot-budget-mb", type=float, default=256.0,
-        help="byte budget (MiB) for the content-addressed snapshot "
-        "store behind request prefix caching and hold_state "
-        "(unpinned prefix snapshots are evicted LRU-first past it; "
-        "see docs/serving.md, 'Prefix caching & forking')",
+    frontdoor.add_argument(
+        "--tenants", default=None, metavar="JSON",
+        help="tenant table — a JSON file path, or the JSON inline: "
+        "{'tenants': [{'name': ..., 'api_key': ..., "
+        "'weight': 2.0, 'rate': 50, 'burst': 100, 'max_inflight': 64, "
+        "'queue_depth': 256, 'default_priority': 'interactive'}, ...]} "
+        "— omit for one open unlimited 'default' tenant "
+        "(docs/serving.md, 'Front door')",
     )
-    serve.add_argument(
-        "--check-finite", choices=["off", "window"], default="off",
-        help="lane quarantine: per-window finite check over every "
-        "lane's state; a lane that goes NaN/Inf fails ONLY its "
-        "request (SimulationDiverged) and is reclaimed, co-batched "
-        "lanes untouched (docs/serving.md, 'Fault tolerance & "
-        "recovery'). off = the bitwise round-11 path",
+    frontdoor.add_argument(
+        "--out-dir", default="out/frontdoor",
+        help="per-request .lens result logs + server_meta.json land here",
     )
-    serve.add_argument(
-        "--watchdog", type=float, default=None, metavar="SECONDS",
-        help="expire a hung device-window/streamer handoff after this "
-        "many stalled seconds (WatchdogTimeout) instead of wedging "
-        "the scheduler forever; default: wait indefinitely",
+    frontdoor.add_argument(
+        "--drain-grace", type=float, default=None, metavar="SECONDS",
+        help="on SIGTERM/SIGINT, wait at most this long for queued + "
+        "in-flight requests to finish before closing anyway "
+        "(default: wait indefinitely; a second signal force-quits)",
     )
-    serve.add_argument(
-        "--recover-dir", default=None, metavar="DIR",
-        help="serve write-ahead log + held-snapshot spills live here; "
-        "if DIR already holds a WAL the server RECOVERS first "
-        "(finished requests keep their logs, unfinished ones re-run "
-        "bitwise) and the request list resumes past the requests "
-        "already recorded",
-    )
-    serve.add_argument(
-        "--mesh", type=int, default=None, metavar="N",
-        help="shard the server across N devices (one resident lane "
-        "pool per device, a host scheduler ticking all shards; a "
-        "dead device quarantines and its requests fail over to the "
-        "survivors — docs/serving.md, 'Mesh serving & device "
-        "failover'). On CPU, simulate devices with "
-        "XLA_FLAGS=--xla_force_host_platform_device_count=N. "
-        "Default: single default-device serving",
-    )
-    serve.add_argument(
-        "--device-watchdog", type=float, default=None,
-        metavar="SECONDS",
-        help="quarantine a device whose dispatched window makes no "
-        "progress for this many seconds (whole-device fail-stop "
-        "detection; requests re-queue onto surviving devices)",
-    )
-    serve.add_argument(
-        "--faults", default=None, metavar="JSON",
-        help="fault-injection plan (a JSON file, or '-' for stdin): "
-        '{"seed": 0, "faults": [{"kind": "nan", "request": '
-        '"req-000001", "after_steps": 16}, ...]} — deterministic '
-        "chaos for tests/CI (docs/serving.md, 'Fault injection')",
-    )
-    serve.add_argument(
-        "--trace-dir", default=None, metavar="DIR",
-        help="span tracing: append every request stage (queue wait, "
-        "admission, window dispatch, device compute, streamer flush, "
-        "retire, prefix resolution, spills, quarantines) to "
-        "DIR/serve.trace; convert with 'python -m lens_tpu trace DIR "
-        "--out trace.json' for Perfetto (docs/observability.md). "
-        "Default: tracing off (the bitwise-identical fast path)",
-    )
-    serve.add_argument(
-        "--metrics-interval", type=float, default=None,
-        metavar="SECONDS",
-        help="sample server counters/gauges/latency histograms into a "
-        "metrics.jsonl time-series ring (in --trace-dir, else "
-        "--out-dir) every this many wall seconds; default: no "
-        "sampling",
-    )
+    _add_server_args(frontdoor, frontdoor_defaults=True)
 
     trace = sub.add_parser(
         "trace",
@@ -442,12 +510,57 @@ def _experiment_config(args: argparse.Namespace) -> dict:
     }
 
 
+class _DrainSignals:
+    """SIGTERM/SIGINT → graceful drain for the serving commands.
+
+    The first signal flips ``draining`` (the command stops ACCEPTING —
+    ``serve`` submits nothing further from its list, ``frontdoor``
+    answers new submits 503) and the in-flight work runs to a clean
+    close (streamer drained, sinks closed, WAL/meta written) — where a
+    bare signal previously killed the process mid-window and left the
+    next invocation to crash-recover. A second signal raises
+    ``KeyboardInterrupt`` (the operator insists). Restores the prior
+    handlers on exit; main-thread only (a signal constraint)."""
+
+    def __init__(self, what: str = "serving"):
+        self.draining = False
+        self._what = what
+        self._prior: list = []
+
+    def __enter__(self) -> "_DrainSignals":
+        import signal as _signal
+
+        def handler(signum, frame):
+            if self.draining:
+                raise KeyboardInterrupt
+            self.draining = True
+            print(
+                f"drain: caught signal {signum} — no new work "
+                f"accepted; draining in-flight {self._what} "
+                f"(signal again to force quit)",
+                flush=True,
+            )
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            self._prior.append((sig, _signal.signal(sig, handler)))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import signal as _signal
+
+        for sig, prior in self._prior:
+            _signal.signal(sig, prior)
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     """Drive a SimServer over a JSON request list: submit (respecting
     backpressure by retrying after the hinted delay), run to idle,
     report. Results stream to per-request ``.lens`` logs while the
     scheduler is still running — tail them with
-    ``lens_tpu.emit.log.tail_records``."""
+    ``lens_tpu.emit.log.tail_records``. SIGTERM/SIGINT drain: no
+    further list entries are submitted, everything in flight finishes
+    and closes cleanly (the WAL, if armed, lets a rerun pick up the
+    skipped tail)."""
     import time
 
     from lens_tpu.serve import (
@@ -499,6 +612,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         snapshot_budget_mb=args.snapshot_budget_mb,
         check_finite=args.check_finite,
         watchdog_s=args.watchdog,
+        sink_errors=args.sink_errors,
         recover_dir=args.recover_dir,
         faults=faults,
         mesh=args.mesh,
@@ -506,7 +620,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         trace_dir=args.trace_dir,
         metrics_interval_s=args.metrics_interval,
     )
-    with server:
+    with server, _DrainSignals("requests") as drain:
         if server.recovered or any(
             not t.internal for t in server.tickets.values()
         ):
@@ -523,14 +637,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             )
             raw = raw[done_already:]
         ids = []
-        for req in raw:
+        skipped = 0
+        for i, req in enumerate(raw):
+            if drain.draining:
+                skipped = len(raw) - i
+                break
             req = dict(req)
             req.setdefault("composite", args.composite)
             try:
                 request = ScenarioRequest.from_mapping(req)
             except (ValueError, TypeError) as e:
                 raise SystemExit(f"bad request {req!r}: {e}")
-            while True:
+            while not drain.draining:
                 try:
                     ids.append(server.submit(request))
                     break
@@ -541,13 +659,31 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     time.sleep(min(e.retry_after, 0.05))
                 except ValueError as e:
                     raise SystemExit(f"bad request {req!r}: {e}")
+            else:
+                skipped = len(raw) - i
+                break
         # recovered re-queued requests report alongside fresh ones
         ids = [
             t.request_id
             for t in server.tickets.values()
             if not t.internal and t.request_id not in ids
         ] + ids
+        if skipped:
+            print(
+                f"drain: stopped accepting after {len(ids)} of "
+                f"{len(ids) + skipped} request(s); {skipped} never "
+                f"submitted"
+                + (
+                    " (rerun with the same --recover-dir to serve "
+                    "the rest)"
+                    if args.recover_dir else ""
+                ),
+                flush=True,
+            )
         server.run_until_idle()
+        if skipped:
+            print("drain: in-flight requests complete; closing "
+                  "cleanly", flush=True)
         snap = server.metrics()
         by_status: dict = {}
         for rid in ids:
@@ -619,6 +755,112 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"{args.trace_dir or args.out_dir}/metrics.jsonl"
             )
     return 0
+
+
+def _cmd_frontdoor(args: argparse.Namespace) -> int:
+    """Run the HTTP front door until a signal, then drain gracefully:
+    stop accepting (503 + Retry-After), finish queued + in-flight
+    requests, close streamer/WAL/sinks, write server_meta.json."""
+    import threading
+
+    from lens_tpu.frontdoor import FrontDoor
+    from lens_tpu.serve import FaultPlan, SimServer
+
+    faults = None
+    if args.faults is not None:
+        try:
+            faults = FaultPlan.from_spec(
+                json.load(sys.stdin) if args.faults == "-"
+                else args.faults
+            )
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            raise SystemExit(f"--faults: {e}")
+    try:
+        server = SimServer.single_bucket(
+            args.composite,
+            config=json.loads(args.config),
+            capacity=args.capacity,
+            lanes=args.lanes,
+            window=args.window,
+            timestep=args.timestep,
+            emit_every=args.emit_every,
+            queue_depth=args.queue_depth,
+            out_dir=args.out_dir,
+            sink="log",
+            pipeline=args.pipeline,
+            stream_queue=args.stream_queue,
+            flush_every=args.flush_every,
+            snapshot_budget_mb=args.snapshot_budget_mb,
+            check_finite=args.check_finite,
+            watchdog_s=args.watchdog,
+            sink_errors=args.sink_errors,
+            recover_dir=args.recover_dir,
+            faults=faults,
+            mesh=args.mesh,
+            device_watchdog_s=args.device_watchdog,
+            trace_dir=args.trace_dir,
+            metrics_interval_s=args.metrics_interval,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    try:
+        fd = FrontDoor(
+            server,
+            tenants=args.tenants,
+            host=args.host,
+            port=args.port,
+        ).start()
+    except (ValueError, OSError) as e:
+        server.close()
+        raise SystemExit(f"frontdoor: {e}")
+    with server:
+        base = f"http://{args.host}:{fd.port}"
+        tenant_note = (
+            f"{len(fd.tenants)} tenant(s): "
+            f"{', '.join(sorted(fd.tenants))}"
+            if args.tenants
+            else "open mode (single 'default' tenant; --tenants "
+                 "arms multi-tenancy)"
+        )
+        print(f"front door listening on {base}")
+        print(f"tenants: {tenant_note}")
+        print(f"bucket:  {args.composite} x{args.lanes} lanes "
+              f"(window {args.window})")
+        print(f"results: {args.out_dir}/<request-id>.lens")
+        print("endpoints: POST /v1/requests | GET /v1/requests/RID"
+              "[/stream] | DELETE /v1/requests/RID | /healthz | "
+              "/metrics | /v1/status")
+        print(
+            f"try:     curl -s {base}/v1/requests -d "
+            f"'{{\"seed\": 1, \"horizon\": 8.0}}'"
+        )
+        stop = threading.Event()
+        with _DrainSignals("HTTP requests") as drain:
+            while not stop.is_set() and not drain.draining:
+                stop.wait(0.2)
+            drained = fd.drain(timeout=args.drain_grace)
+        snap = server.metrics()
+        c = snap["counters"]
+        print(
+            f"drained: submitted={c['submitted']} "
+            f"retired={c['retired']} failed={c['failed']} "
+            f"cancelled={c['cancelled']} rejected={c['rejected']}"
+        )
+        for name, row in sorted(snap.get("tenants", {}).items()):
+            print(
+                f"tenant {name}: admitted={row['admitted']} "
+                f"rejected={row['rejected']} "
+                f"throttled={row['throttled']} "
+                f"streamed={row['streamed_bytes']}B"
+            )
+        if not drained:
+            print(
+                f"drain: grace ({args.drain_grace}s) expired with "
+                f"work still in flight; closed anyway",
+                file=sys.stderr,
+            )
+    print(f"meta:    {args.out_dir}/server_meta.json")
+    return 0 if drained else 1
 
 
 def _cmd_trace(args: argparse.Namespace) -> int:
@@ -783,6 +1025,9 @@ def main(argv=None) -> int:
 
     if args.command == "serve":
         return _cmd_serve(args)
+
+    if args.command == "frontdoor":
+        return _cmd_frontdoor(args)
 
     if args.command == "trace":
         return _cmd_trace(args)

@@ -392,6 +392,47 @@ def read_records(path: str) -> Iterator[Dict[str, Any]]:
         yield decode_record(payload)
 
 
+def tail_frames(
+    path: str, offset: int = 0
+) -> Tuple[List[bytes], int]:
+    """Incremental RAW read: complete frames (header + payload bytes,
+    exactly as they sit in the file) past ``offset``, plus the new
+    offset to resume from.
+
+    The byte-transparent layer under :func:`tail_records`, and what
+    the HTTP front door streams (``lens_tpu.frontdoor.streams``): the
+    concatenation of every frame this yields across a request's
+    lifetime is BYTE-IDENTICAL to the request's log file — the
+    record-stream-over-HTTP == log-file pin rides this. Same
+    reader-while-writer contract as :func:`tail_records`: a frame
+    whose header or payload has not fully landed is left alone (the
+    returned offset stops at the last complete frame), and a complete
+    frame with bad magic/CRC raises (corruption, not a writer race).
+    """
+    if offset < 0:
+        raise ValueError(f"offset must be >= 0, got {offset}")
+    frames: List[bytes] = []
+    with open(path, "rb") as f:
+        f.seek(offset)
+        good = offset
+        while True:
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                return frames, good  # header not fully written yet
+            magic, crc, length = _FRAME.unpack(head)
+            if magic != MAGIC:
+                raise ValueError(
+                    f"{path}: bad record magic {magic:#x} at offset {good}"
+                )
+            payload = f.read(length)
+            if len(payload) < length:
+                return frames, good  # payload still being appended
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise ValueError(f"{path}: CRC mismatch at offset {good}")
+            frames.append(head + payload)
+            good += _FRAME.size + length
+
+
 def tail_records(
     path: str, offset: int = 0
 ) -> Tuple[List[Dict[str, Any]], int]:
@@ -409,30 +450,13 @@ def tail_records(
     A complete frame with a bad magic or CRC is real corruption, not a
     race with the writer (records are appended front-to-back, so bytes
     before a complete frame's end are final) — raises ``ValueError``,
-    same as :func:`read_records`.
+    same as :func:`read_records`. Decoded form of :func:`tail_frames`.
     """
-    if offset < 0:
-        raise ValueError(f"offset must be >= 0, got {offset}")
-    records: List[Dict[str, Any]] = []
-    with open(path, "rb") as f:
-        f.seek(offset)
-        good = offset
-        while True:
-            head = f.read(_FRAME.size)
-            if len(head) < _FRAME.size:
-                return records, good  # header not fully written yet
-            magic, crc, length = _FRAME.unpack(head)
-            if magic != MAGIC:
-                raise ValueError(
-                    f"{path}: bad record magic {magic:#x} at offset {good}"
-                )
-            payload = f.read(length)
-            if len(payload) < length:
-                return records, good  # payload still being appended
-            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
-                raise ValueError(f"{path}: CRC mismatch at offset {good}")
-            records.append(decode_record(payload))
-            good += _FRAME.size + length
+    frames, good = tail_frames(path, offset)
+    return (
+        [decode_record(f[_FRAME.size:]) for f in frames],
+        good,
+    )
 
 
 def make_header(experiment_id: str, config: Mapping | None = None) -> Dict:

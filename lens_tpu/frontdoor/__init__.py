@@ -1,0 +1,50 @@
+"""lens_tpu.frontdoor: the multi-tenant async HTTP front door.
+
+A thin asyncio HTTP/1.1 layer (stdlib only) over a resident
+:class:`~lens_tpu.serve.server.SimServer`: submit / status / SSE
+record streaming / cancel plus ``/metrics``, ``/healthz`` and
+``/v1/status``, with per-tenant weighted fair-share admission,
+priority lanes, token-bucket rate limits, in-flight quotas, and honest
+HTTP backpressure (429 + Retry-After from the server's
+occupancy-derived hint). See docs/serving.md, "Front door".
+
+Entry points: ``python -m lens_tpu frontdoor --port 8080 --tenants
+tenants.json`` or in-process::
+
+    server = SimServer.single_bucket(
+        "toggle_colony", lanes=8, sink="log", out_dir="out/fd")
+    with FrontDoor(server, tenants="tenants.json") as fd:
+        ...  # http://127.0.0.1:{fd.port}/v1/requests
+"""
+
+from lens_tpu.frontdoor.app import FRONTDOOR_TRACK, FrontDoor
+from lens_tpu.frontdoor.auth import AuthError, Authenticator
+from lens_tpu.frontdoor.streams import (
+    decode_record_events,
+    record_events,
+    sse_event,
+)
+from lens_tpu.frontdoor.tenants import (
+    Entry,
+    TenantConfig,
+    TenantQueueFull,
+    TenantScheduler,
+    TokenBucket,
+    load_tenants,
+)
+
+__all__ = [
+    "FRONTDOOR_TRACK",
+    "AuthError",
+    "Authenticator",
+    "Entry",
+    "FrontDoor",
+    "TenantConfig",
+    "TenantQueueFull",
+    "TenantScheduler",
+    "TokenBucket",
+    "decode_record_events",
+    "load_tenants",
+    "record_events",
+    "sse_event",
+]

@@ -1,0 +1,917 @@
+"""The async HTTP front door over a resident :class:`SimServer`.
+
+ROADMAP item 5's last gap: after rounds 8-14 the serving core is
+production-shaped (continuous batching, prefix caching, WAL recovery,
+device failover, tracing) but only reachable in-process. This module
+is the thin host-side layer that makes it reachable from a socket —
+and the FIRST layer where policy is about WHO is asking (tenants,
+priorities, rate limits), which is exactly why it sits outside the
+device-side scheduler (the Podracer split: all tenancy policy is cheap
+host Python; the compiled lane programs never learn HTTP exists).
+
+Stdlib only by design: an asyncio HTTP/1.1 server (keep-alive,
+chunked responses) written against ``asyncio.start_server`` — no new
+dependency for the repo, and nothing the container doesn't have.
+
+Surface (docs/serving.md, "Front door"):
+
+==========================================  ================================
+``POST   /v1/requests``                     submit; 202 ``{"rid": ...}``
+``GET    /v1/requests/{rid}``               status + timing-table row
+``GET    /v1/requests/{rid}/stream``        SSE record stream (chunked)
+``DELETE /v1/requests/{rid}``               cancel (queued or running)
+``GET    /healthz``                         liveness: occupancy, queue,
+                                            quarantined devices, tenants
+``GET    /v1/status``                       full metrics snapshot
+``GET    /metrics``                         Prometheus text exposition
+==========================================  ================================
+
+Error mapping is part of the contract: malformed request JSON is a 400
+whose body carries the machine-readable field ``path`` from
+:class:`~lens_tpu.serve.batcher.RequestValidationError`; backpressure
+(tenant queue full) and throttling (rate limit, in-flight quota) are
+429 with a ``Retry-After`` header derived from the server's
+occupancy-based hint; a draining server answers submits with 503 +
+``Retry-After``; unknown/foreign rids are 404 (a tenant can never
+probe another tenant's ids).
+
+Threading model: ONE scheduler thread owns the `SimServer` hot loop
+(tenant-scheduler pump, then ``tick()``) under one lock; the asyncio
+loop runs in a second thread and reaches the server through a small
+executor that takes the same lock — so every admission decision is a
+single serialized history (what makes fairness testable), while SSE
+streams read result logs lock-free via the tail-frames contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+from lens_tpu.serve.batcher import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    QueueFull,
+    RequestValidationError,
+    TIMEOUT,
+)
+from lens_tpu.serve.metrics import request_timing_row
+from lens_tpu.frontdoor.auth import AuthError, Authenticator
+from lens_tpu.frontdoor.streams import record_events, sse_event
+from lens_tpu.frontdoor.tenants import (
+    Entry,
+    TenantConfig,
+    TenantQueueFull,
+    TenantScheduler,
+    load_tenants,
+)
+
+#: Span-trace track for front-door events (docs/observability.md).
+FRONTDOOR_TRACK = "frontdoor"
+
+_TERMINAL = (DONE, TIMEOUT, CANCELLED, FAILED)
+
+_STATUS_TEXT = {
+    200: "OK", 202: "Accepted", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout",
+    413: "Payload Too Large", 429: "Too Many Requests",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class _HttpRequest:
+    __slots__ = ("method", "path", "headers", "body")
+
+    def __init__(self, method: str, path: str,
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.headers = headers
+        self.body = body
+
+
+class FrontDoor:
+    """Serve a :class:`SimServer` over HTTP with multi-tenant
+    fair-share admission.
+
+    Parameters
+    ----------
+    server:
+        The resident ``SimServer``. Must use ``sink="log"`` — result
+        streaming reads the per-request ``.lens`` logs.
+    tenants:
+        ``None`` (open single-tenant mode: one implicit unlimited
+        ``default`` tenant), a path to a ``tenants.json``, a list of
+        tenant entries, or a ``{name: TenantConfig}`` mapping — see
+        :mod:`lens_tpu.frontdoor.tenants`.
+    host / port:
+        Bind address; port 0 picks a free port (``.port`` reports the
+        bound one after :meth:`start`).
+    own_server:
+        When True, :meth:`drain`/:meth:`close` also close the
+        ``SimServer`` (the CLI's mode; in-process callers usually keep
+        ownership).
+    idle_sleep_s:
+        Scheduler-thread sleep when the server is fully idle (keeps an
+        idle front door near-zero CPU without adding admission latency
+        under load).
+    max_body_bytes:
+        Bound on a request body (413 past it).
+    """
+
+    def __init__(
+        self,
+        server: Any,
+        tenants: Any = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        own_server: bool = False,
+        idle_sleep_s: float = 0.002,
+        max_body_bytes: int = 8 << 20,
+        stream_poll_s: float = 0.02,
+    ):
+        if getattr(server, "sink", None) != "log":
+            raise ValueError(
+                "FrontDoor needs a SimServer with sink='log' (record "
+                "streaming reads the per-request result logs)"
+            )
+        self.server = server
+        if tenants is None:
+            table: Dict[str, TenantConfig] = {
+                "default": TenantConfig(name="default")
+            }
+        elif isinstance(tenants, Mapping) and all(
+            isinstance(v, TenantConfig) for v in tenants.values()
+        ):
+            table = dict(tenants)
+        else:
+            table = load_tenants(tenants)
+        self.tenants = table
+        self.auth = Authenticator(table)
+        self.sched = TenantScheduler(table)
+        self.host = host
+        self._requested_port = int(port)
+        self.port: Optional[int] = None
+        self.own_server = bool(own_server)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.stream_poll_s = float(stream_poll_s)
+        # one lock serializes ALL SimServer access (scheduler thread's
+        # pump+tick, the HTTP executor's submits/status/cancels)
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="frontdoor-http"
+        )
+        self._rid_tenant: Dict[str, str] = {}   # rid -> owning tenant
+        self._inflight_rids: Dict[str, str] = {}  # submitted, not done
+        self._done_at_door: Dict[str, Tuple[str, Optional[str]]] = {}
+        self._draining = False
+        # a fatal scheduler error (parked stream failure, watchdog):
+        # the loop thread died — the door flips to draining and every
+        # submit answers 503 naming the cause instead of accepting
+        # work nothing will ever run
+        self._sched_error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._sched_thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._http_server: Optional[asyncio.AbstractServer] = None
+        self._active_streams = 0
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FrontDoor":
+        """Bind the socket, start the asyncio loop thread and the
+        scheduler thread. Returns self (so ``FrontDoor(...).start()``
+        composes)."""
+        if self._started:
+            return self
+        self._started = True
+        self._loop = asyncio.new_event_loop()
+        ready = threading.Event()
+
+        def run_loop() -> None:
+            asyncio.set_event_loop(self._loop)
+            self._loop.call_soon(ready.set)
+            self._loop.run_forever()
+
+        self._loop_thread = threading.Thread(
+            target=run_loop, name="frontdoor-loop", daemon=True
+        )
+        self._loop_thread.start()
+        ready.wait()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._start_http(), self._loop
+        )
+        fut.result(timeout=10)
+        self._sched_thread = threading.Thread(
+            target=self._scheduler_loop, name="frontdoor-sched",
+            daemon=True,
+        )
+        self._sched_thread.start()
+        return self
+
+    async def _start_http(self) -> None:
+        self._http_server = await asyncio.start_server(
+            self._handle_connection, host=self.host,
+            port=self._requested_port,
+            family=socket.AF_INET,
+        )
+        self.port = self._http_server.sockets[0].getsockname()[1]
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop accepting new work (submits answer
+        503 + Retry-After), let everything queued and in flight run to
+        completion, give open streams a moment to deliver their
+        ``end`` events, then stop the threads (and close the server
+        when ``own_server``). Returns True when fully drained within
+        ``timeout`` (None = wait indefinitely); on False the caller
+        decides between waiting more and a hard :meth:`close`."""
+        self._draining = True
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+
+        def expired() -> bool:
+            return deadline is not None and time.monotonic() > deadline
+
+        dead = False
+        while not expired():
+            if self._sched_error is not None or (
+                self._sched_thread is not None
+                and not self._sched_thread.is_alive()
+            ):
+                # the scheduler thread died on a fatal server error:
+                # nothing will ever pump or tick again, so waiting on
+                # the queues is waiting forever — close now and report
+                # the drain as failed
+                dead = True
+                break
+            with self._lock:
+                busy = (
+                    self.sched.queued()
+                    or self._inflight_rids
+                    or len(self.server.queue)
+                    or any(
+                        b.busy() for b in self.server.buckets.values()
+                    )
+                )
+            if not busy and self._active_streams == 0:
+                break
+            time.sleep(0.02)
+        drained = not expired() and not dead
+        self.close()
+        return drained
+
+    def close(self) -> None:
+        """Stop threads and the HTTP listener NOW (queued front-door
+        entries are dropped; the server keeps whatever it already
+        accepted). Idempotent; closes the ``SimServer`` only under
+        ``own_server``."""
+        if self._closed:
+            return
+        self._closed = True
+        self._draining = True
+        self._stop.set()
+        if self._sched_thread is not None:
+            self._sched_thread.join(timeout=30)
+        if self._loop is not None:
+            async def shutdown() -> None:
+                if self._http_server is not None:
+                    self._http_server.close()
+                    await self._http_server.wait_closed()
+                # open keep-alive connections hold pending handler
+                # tasks; cancel them so the loop stops clean
+                tasks = [
+                    t for t in asyncio.all_tasks()
+                    if t is not asyncio.current_task()
+                ]
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    shutdown(), self._loop
+                ).result(timeout=10)
+            except Exception:
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            if self._loop_thread is not None:
+                self._loop_thread.join(timeout=10)
+            if not self._loop.is_running():
+                self._loop.close()
+        self._pool.shutdown(wait=False)
+        if self.own_server:
+            self.server.close()
+
+    def __enter__(self) -> "FrontDoor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- scheduler thread ----------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                self._sweep_inflight()
+                self._pump()
+                try:
+                    busy = self.server.tick()
+                except Exception as e:
+                    # a fatal server error (parked stream failure,
+                    # watchdog): stop ticking and flip the door to
+                    # draining — new submits answer 503 naming this
+                    # cause instead of queueing work nothing will run
+                    self._sched_error = e
+                    self._draining = True
+                    self._stop.set()
+                    raise
+                waiting = self.sched.queued() or len(self.server.queue)
+            if not busy and not waiting:
+                time.sleep(self.idle_sleep_s)
+
+    def _pump(self) -> None:
+        """Move requests from the tenant scheduler into the server's
+        bounded queue — the WDRR egress. Gated on free queue depth so
+        the pump never bounces off ``QueueFull`` (which would count
+        spurious rejects); the server queue's own bound therefore
+        backpressures the TENANT queues, whose bounds backpressure the
+        clients as 429s."""
+        while len(self.server.queue) < self.server.queue.max_depth:
+            entry = self.sched.pop()
+            if entry is None:
+                return
+            try:
+                self.server.submit(entry.request, rid=entry.rid)
+            except QueueFull:
+                # unreachable while the depth gate above holds, but a
+                # refused entry must never lose its place: re-park it
+                # at the head and try again next pump
+                self.sched.push_front(entry)
+                return
+            except Exception as e:
+                # validated at ingress, so this is rare (e.g. every
+                # device quarantined since) — record a front-door
+                # terminal so the client's status poll sees the cause
+                self._bounded_put(
+                    self._done_at_door, entry.rid,
+                    (FAILED, f"{type(e).__name__}: {e}"),
+                    self.DOOR_TERMINAL_RETENTION,
+                )
+                continue
+            self.sched.note_submitted(entry.tenant)
+            self._inflight_rids[entry.rid] = entry.tenant
+            if self.server.trace:
+                self.server.trace.emit_span(
+                    "frontdoor.request", entry.received_at,
+                    time.perf_counter(), track=FRONTDOOR_TRACK,
+                    aid=entry.rid, rid=entry.rid,
+                    tenant=entry.tenant, priority=entry.priority,
+                )
+
+    #: Retention bounds for the per-request maps a long-running door
+    #: would otherwise grow forever (one entry per request EVER
+    #: accepted). Past the bound the OLDEST tenth is evicted (dicts
+    #: iterate in insertion order) — an evicted rid reads as 404,
+    #: which is the documented retention contract for ancient ids.
+    RID_RETENTION = 200_000
+    DOOR_TERMINAL_RETENTION = 20_000
+
+    @staticmethod
+    def _bounded_put(table: Dict, key, value, bound: int) -> None:
+        table[key] = value
+        if len(table) > bound:
+            for old in list(table)[: max(bound // 10, 1)]:
+                del table[old]
+
+    def _sweep_inflight(self) -> None:
+        if not self._inflight_rids:
+            return
+        done = [
+            (rid, tenant)
+            for rid, tenant in self._inflight_rids.items()
+            if getattr(
+                self.server.tickets.get(rid), "status", FAILED
+            ) in _TERMINAL
+        ]
+        for rid, tenant in done:
+            self.sched.note_finished(tenant)
+            del self._inflight_rids[rid]
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    async def _handle_connection(self, reader, writer) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader, writer)
+                if request is None:
+                    return
+                keep = await self._dispatch(request, writer)
+                if not keep:
+                    return
+        except (
+            ConnectionResetError, BrokenPipeError, asyncio.TimeoutError
+        ):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(
+        self, reader, writer
+    ) -> Optional[_HttpRequest]:
+        try:
+            line = await reader.readline()
+        except Exception:
+            return None
+        if not line:
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) != 3 or not parts[2].startswith("HTTP/1."):
+            await self._respond(
+                writer, 400, {"error": "malformed request line"},
+                keep_alive=False,
+            )
+            return None
+        method, target, _version = parts
+        headers: Dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+            if len(headers) > 100:
+                await self._respond(
+                    writer, 400, {"error": "too many headers"},
+                    keep_alive=False,
+                )
+                return None
+        body = b""
+        length = headers.get("content-length")
+        if length is not None:
+            try:
+                n = int(length)
+            except ValueError:
+                await self._respond(
+                    writer, 400,
+                    {"error": "malformed Content-Length"},
+                    keep_alive=False,
+                )
+                return None
+            if n > self.max_body_bytes:
+                await self._respond(
+                    writer, 413,
+                    {"error": f"body exceeds {self.max_body_bytes} "
+                              f"bytes"},
+                    keep_alive=False,
+                )
+                return None
+            if headers.get("expect", "").lower() == "100-continue":
+                writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                await writer.drain()
+            body = await reader.readexactly(n)
+        path = target.split("?", 1)[0]
+        return _HttpRequest(method.upper(), path, headers, body)
+
+    async def _respond(
+        self,
+        writer,
+        status: int,
+        payload: Any,
+        keep_alive: bool = True,
+        extra_headers: Optional[Mapping[str, str]] = None,
+        content_type: str = "application/json",
+    ) -> None:
+        if isinstance(payload, (dict, list)):
+            body = (json.dumps(payload) + "\n").encode()
+        elif isinstance(payload, str):
+            body = payload.encode()
+        else:
+            body = bytes(payload)
+        head = [
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'keep-alive' if keep_alive else 'close'}",
+        ]
+        for name, value in (extra_headers or {}).items():
+            head.append(f"{name}: {value}")
+        writer.write(
+            ("\r\n".join(head) + "\r\n\r\n").encode() + body
+        )
+        await writer.drain()
+
+    async def _locked(self, fn: Callable[[], Any]) -> Any:
+        """Run a server-touching callable on the executor under the
+        admission lock (never block the event loop on the lock)."""
+
+        def call():
+            with self._lock:
+                return fn()
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._pool, call
+        )
+
+    # -- routing -------------------------------------------------------------
+
+    async def _dispatch(self, req: _HttpRequest, writer) -> bool:
+        try:
+            if req.path == "/healthz" and req.method == "GET":
+                return await self._get_healthz(req, writer)
+            if req.path == "/metrics" and req.method == "GET":
+                return await self._get_metrics(req, writer)
+            if req.path == "/v1/status" and req.method == "GET":
+                return await self._get_status(req, writer)
+            if req.path == "/v1/requests" and req.method == "POST":
+                return await self._post_request(req, writer)
+            if req.path.startswith("/v1/requests/"):
+                rest = req.path[len("/v1/requests/"):]
+                if rest.endswith("/stream") and req.method == "GET":
+                    return await self._get_stream(
+                        req, writer, rest[: -len("/stream")].rstrip("/")
+                    )
+                rid = rest.rstrip("/")
+                if "/" not in rid:
+                    if req.method == "GET":
+                        return await self._get_request(req, writer, rid)
+                    if req.method == "DELETE":
+                        return await self._delete_request(
+                            req, writer, rid
+                        )
+                    await self._respond(
+                        writer, 405,
+                        {"error": f"{req.method} not allowed here"},
+                    )
+                    return True
+            await self._respond(
+                writer, 404, {"error": f"no route {req.method} "
+                                       f"{req.path}"},
+            )
+            return True
+        except AuthError as e:
+            await self._respond(
+                writer, e.status, {"error": str(e)},
+            )
+            return True
+        except (
+            ConnectionResetError, BrokenPipeError
+        ):
+            return False
+        except Exception as e:
+            try:
+                await self._respond(
+                    writer, 500,
+                    {"error": f"{type(e).__name__}: {e}"},
+                    keep_alive=False,
+                )
+            except Exception:
+                pass
+            return False
+
+    def _owner_or_none(
+        self, tenant: TenantConfig, rid: str
+    ) -> Optional[str]:
+        owner = self._rid_tenant.get(rid)
+        if owner is None or owner != tenant.name:
+            return None
+        return owner
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _post_request(self, req: _HttpRequest, writer) -> bool:
+        tenant = self.auth.resolve(req.headers)
+        t_recv = time.perf_counter()
+        try:
+            mapping = json.loads(req.body.decode() or "null")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            await self._respond(
+                writer, 400,
+                {"error": f"body is not valid JSON: {e}", "path": None},
+            )
+            return True
+        if not isinstance(mapping, dict):
+            await self._respond(
+                writer, 400,
+                {"error": "body must be a JSON request object",
+                 "path": None},
+            )
+            return True
+        claimed = mapping.get("tenant")
+        if claimed is not None and claimed != tenant.name:
+            await self._respond(
+                writer, 403,
+                {"error": f"authenticated as tenant {tenant.name!r}; "
+                          f"request names {claimed!r}",
+                 "path": "tenant"},
+            )
+            return True
+
+        def ingress():
+            body = dict(mapping)
+            body["tenant"] = tenant.name
+            body.setdefault("priority", tenant.default_priority)
+            if "composite" not in body and len(
+                self.server.buckets
+            ) == 1:
+                body["composite"] = next(iter(self.server.buckets))
+            try:
+                request = self.server.validate(body)
+            except RequestValidationError as e:
+                return 400, {"error": str(e), "path": e.path}, {}
+            except ValueError as e:
+                return 400, {"error": str(e), "path": None}, {}
+            if self._draining:
+                hint = max(self.server.retry_after_hint(), 1.0)
+                cause = (
+                    f"server is down: {type(self._sched_error).__name__}"
+                    f": {self._sched_error}"
+                    if self._sched_error is not None
+                    else "server is draining; not accepting new "
+                         "requests"
+                )
+                return 503, {
+                    "error": cause, "tenant": tenant.name,
+                }, {"Retry-After": f"{hint:.3f}"}
+            # queue-depth check BEFORE the token bucket: a rejected
+            # submit must not burn a rate-limit token the tenant never
+            # got a queue slot for
+            if self.sched.queued(tenant.name) >= tenant.queue_depth:
+                self.server._metrics.tenant_inc(
+                    tenant.name, "rejected"
+                )
+                hint = max(self.server.retry_after_hint(), 0.05)
+                return 429, {
+                    "error": f"tenant {tenant.name!r} queue full "
+                             f"({tenant.queue_depth} waiting)",
+                    "tenant": tenant.name,
+                }, {"Retry-After": f"{hint:.3f}"}
+            reason, wait = self.sched.throttle(tenant.name)
+            if reason is not None:
+                self.server._metrics.tenant_inc(
+                    tenant.name, "throttled"
+                )
+                return 429, {
+                    "error": reason, "tenant": tenant.name,
+                }, {"Retry-After": f"{max(wait, 0.001):.3f}"}
+            rid = self.server.reserve_id()
+            entry = Entry(
+                rid=rid,
+                tenant=tenant.name,
+                priority=str(body["priority"]),
+                request=request,
+                received_at=t_recv,
+            )
+            try:
+                self.sched.push(
+                    entry,
+                    retry_after=max(
+                        self.server.retry_after_hint(), 0.05
+                    ),
+                )
+            except TenantQueueFull as e:
+                self.server._metrics.tenant_inc(
+                    tenant.name, "rejected"
+                )
+                return 429, {
+                    "error": str(e), "tenant": tenant.name,
+                }, {"Retry-After": f"{e.retry_after:.3f}"}
+            self._bounded_put(
+                self._rid_tenant, rid, tenant.name,
+                self.RID_RETENTION,
+            )
+            return 202, {
+                "rid": rid,
+                "status": "queued",
+                "tenant": tenant.name,
+                "priority": entry.priority,
+            }, {}
+
+        status, payload, headers = await self._locked(ingress)
+        await self._respond(
+            writer, status, payload, extra_headers=headers
+        )
+        return True
+
+    async def _get_request(
+        self, req: _HttpRequest, writer, rid: str
+    ) -> bool:
+        tenant = self.auth.resolve(req.headers)
+        if self._owner_or_none(tenant, rid) is None:
+            await self._respond(
+                writer, 404, {"error": f"unknown request {rid!r}"}
+            )
+            return True
+
+        def fetch():
+            t = self.server.tickets.get(rid)
+            if t is not None:
+                out = self.server.status(rid)
+                out["tenant"] = tenant.name
+                out["priority"] = t.request.priority
+                out["timing"] = request_timing_row(
+                    t, self.server._metrics._t0
+                )
+                return out
+            if rid in self._done_at_door:
+                status, error = self._done_at_door[rid]
+                return {
+                    "request_id": rid, "status": status,
+                    "error": error, "tenant": tenant.name,
+                }
+            return {
+                "request_id": rid, "status": "queued",
+                "stage": "frontdoor", "tenant": tenant.name,
+            }
+
+        await self._respond(writer, 200, await self._locked(fetch))
+        return True
+
+    async def _delete_request(
+        self, req: _HttpRequest, writer, rid: str
+    ) -> bool:
+        tenant = self.auth.resolve(req.headers)
+        if self._owner_or_none(tenant, rid) is None:
+            await self._respond(
+                writer, 404, {"error": f"unknown request {rid!r}"}
+            )
+            return True
+
+        def cancel():
+            entry = self.sched.cancel(rid)
+            if entry is not None:
+                self._bounded_put(
+                    self._done_at_door, rid, (CANCELLED, None),
+                    self.DOOR_TERMINAL_RETENTION,
+                )
+                return {"request_id": rid, "status": CANCELLED}
+            if rid in self.server.tickets:
+                return {
+                    "request_id": rid,
+                    "status": self.server.cancel(rid),
+                }
+            status, error = self._done_at_door.get(
+                rid, (CANCELLED, None)
+            )
+            return {"request_id": rid, "status": status,
+                    "error": error}
+
+        await self._respond(writer, 200, await self._locked(cancel))
+        return True
+
+    async def _get_stream(
+        self, req: _HttpRequest, writer, rid: str
+    ) -> bool:
+        tenant = self.auth.resolve(req.headers)
+        if self._owner_or_none(tenant, rid) is None:
+            await self._respond(
+                writer, 404, {"error": f"unknown request {rid!r}"}
+            )
+            return True
+
+        def state() -> Dict[str, Any]:
+            # lock-free scalar reads (GIL-atomic): one poll may see a
+            # one-tick-stale status, never a torn one
+            t = self.server.tickets.get(rid)
+            if t is None:
+                if rid in self._done_at_door:
+                    status, error = self._done_at_door[rid]
+                    return {
+                        "rid": rid, "status": status, "error": error,
+                        "terminal": True, "streamed": True,
+                        "path": None,
+                    }
+                return {
+                    "rid": rid, "status": "queued", "terminal": False,
+                    "streamed": False, "path": None, "error": None,
+                }
+            return {
+                "rid": rid,
+                "status": t.status,
+                "terminal": t.status in _TERMINAL,
+                "streamed": t.streamed_at is not None,
+                "path": t.result_path,
+                "error": t.error,
+                # bumps when a device quarantine displaces the
+                # request and its sink restarts: the stream resets
+                "epoch": t.requeues,
+            }
+
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-store\r\n"
+            "Transfer-Encoding: chunked\r\n"
+            "Connection: keep-alive\r\n\r\n"
+        )
+        writer.write(head.encode())
+        await writer.drain()
+        metrics = self.server._metrics
+        t0 = time.perf_counter()
+        self._active_streams += 1
+        try:
+            try:
+                async for chunk in record_events(
+                    state,
+                    poll_s=self.stream_poll_s,
+                    on_bytes=lambda n: metrics.tenant_inc(
+                        tenant.name, "streamed_bytes", n
+                    ),
+                ):
+                    writer.write(
+                        f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                    )
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                return False  # client went away mid-stream
+            except Exception as e:
+                # the response HEAD is already on the wire: a 500 now
+                # would land inside the chunked body and corrupt the
+                # framing. Terminate the stream in-band instead: one
+                # SSE error event, then the terminal chunk, then close
+                # the connection (no keep-alive after a torn stream).
+                chunk = sse_event(
+                    "error",
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                )
+                try:
+                    writer.write(
+                        f"{len(chunk):x}\r\n".encode() + chunk
+                        + b"\r\n0\r\n\r\n"
+                    )
+                    await writer.drain()
+                except Exception:
+                    pass
+                return False
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        finally:
+            self._active_streams -= 1
+            if self.server.trace:
+                self.server.trace.emit_span(
+                    "frontdoor.stream", t0, time.perf_counter(),
+                    track=FRONTDOOR_TRACK, aid=f"{rid}/stream",
+                    rid=rid, tenant=tenant.name,
+                )
+        return True
+
+    async def _get_healthz(self, req: _HttpRequest, writer) -> bool:
+        def fetch():
+            snap = self.server.metrics()
+            return {
+                "status": "draining" if self._draining else "ok",
+                "occupancy": snap["occupancy"],
+                "queue_depth": snap["queue_depth"],
+                "lanes_busy": snap["lanes_busy"],
+                "lanes_total": snap["lanes_total"],
+                "quarantined_devices": snap["quarantined_devices"],
+                "frontdoor": {
+                    "draining": self._draining,
+                    "queued": self.sched.queued(),
+                    "active_streams": self._active_streams,
+                    "tenants": self.sched.snapshot(),
+                },
+            }
+
+        payload = await self._locked(fetch)
+        status = 503 if self._draining else 200
+        await self._respond(writer, status, payload)
+        return True
+
+    async def _get_status(self, req: _HttpRequest, writer) -> bool:
+        def fetch():
+            snap = self.server.metrics()
+            snap["frontdoor"] = {
+                "draining": self._draining,
+                "queued": self.sched.queued(),
+                "active_streams": self._active_streams,
+                "tenants": self.sched.snapshot(),
+            }
+            return snap
+
+        await self._respond(writer, 200, await self._locked(fetch))
+        return True
+
+    async def _get_metrics(self, req: _HttpRequest, writer) -> bool:
+        text = await self._locked(self.server.prometheus_metrics)
+        await self._respond(
+            writer, 200, text,
+            content_type="text/plain; version=0.0.4",
+        )
+        return True

@@ -1,0 +1,110 @@
+"""Tenant identification for the front door.
+
+Deliberately minimal: the front door's security model is shared-secret
+API keys mapping a connection to a TENANT (the unit every policy —
+fairness weight, rate limit, quota, counters — attaches to), not user
+identity. Deployments needing real authn put a terminating proxy in
+front and pass the tenant through; this layer only has to be
+unambiguous and impossible to spoof ACROSS tenants that hold keys.
+
+Resolution order (first match wins):
+
+1. ``Authorization: Bearer <key>`` or ``X-API-Key: <key>`` — looked up
+   against the tenants' ``api_key`` values; an unknown key is a 401.
+2. ``X-Tenant: <name>`` — accepted only for tenants configured WITHOUT
+   an ``api_key`` (open tenants); naming a keyed tenant without its
+   key is a 403, an unknown name a 401.
+3. No credentials: the single open tenant if exactly one exists (the
+   zero-config case — no tenants file means one implicit ``default``
+   tenant), else a 401 naming what is required.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+from lens_tpu.frontdoor.tenants import TenantConfig
+
+
+class AuthError(Exception):
+    """Refused tenant resolution; ``status`` is the HTTP code (401
+    unknown/missing credentials, 403 wrong credentials for a named
+    tenant)."""
+
+    def __init__(self, status: int, message: str):
+        self.status = int(status)
+        super().__init__(message)
+
+
+class Authenticator:
+    """Header → :class:`TenantConfig` resolution over one tenant table."""
+
+    def __init__(self, tenants: Mapping[str, TenantConfig]):
+        self.tenants = dict(tenants)
+        self._by_key: Dict[str, TenantConfig] = {
+            cfg.api_key: cfg
+            for cfg in self.tenants.values()
+            if cfg.api_key is not None
+        }
+        self._open = [
+            cfg for cfg in self.tenants.values() if cfg.api_key is None
+        ]
+
+    @staticmethod
+    def _credentials(
+        headers: Mapping[str, str]
+    ) -> Tuple[Optional[str], Optional[str]]:
+        """(api_key, claimed_tenant_name) from the request headers
+        (header names lower-cased by the HTTP layer)."""
+        key: Optional[str] = None
+        auth = headers.get("authorization")
+        if auth is not None:
+            scheme, _, value = auth.partition(" ")
+            if scheme.lower() != "bearer" or not value.strip():
+                raise AuthError(
+                    401,
+                    "malformed Authorization header (expected "
+                    "'Bearer <api-key>')",
+                )
+            key = value.strip()
+        if key is None:
+            key = headers.get("x-api-key")
+        return key, headers.get("x-tenant")
+
+    def resolve(self, headers: Mapping[str, str]) -> TenantConfig:
+        key, claimed = self._credentials(headers)
+        if key is not None:
+            cfg = self._by_key.get(key)
+            if cfg is None:
+                raise AuthError(401, "unknown api key")
+            if claimed is not None and claimed != cfg.name:
+                raise AuthError(
+                    403,
+                    f"api key belongs to tenant {cfg.name!r}, not "
+                    f"{claimed!r}",
+                )
+            return cfg
+        if claimed is not None:
+            cfg = self.tenants.get(claimed)
+            if cfg is None:
+                raise AuthError(401, f"unknown tenant {claimed!r}")
+            if cfg.api_key is not None:
+                raise AuthError(
+                    403,
+                    f"tenant {claimed!r} requires its api key "
+                    f"(Authorization: Bearer ...)",
+                )
+            return cfg
+        if len(self._open) == 1:
+            return self._open[0]
+        if self._open:
+            raise AuthError(
+                401,
+                f"no credentials and {len(self._open)} open tenants "
+                f"configured — name one with X-Tenant",
+            )
+        raise AuthError(
+            401,
+            "no credentials (every configured tenant requires an api "
+            "key; send Authorization: Bearer <key>)",
+        )

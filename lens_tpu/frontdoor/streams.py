@@ -1,0 +1,149 @@
+"""Server-sent-event record streaming for the front door.
+
+``GET /v1/requests/{rid}/stream`` answers with an SSE body whose
+``record`` events carry the request's emit-log frames — the RAW bytes,
+base64-armored, exactly as they sit in the request's ``.lens`` file.
+The stream rides :func:`lens_tpu.emit.log.tail_frames`'s
+reader-while-writer contract (only complete frames are ever sent; a
+torn tail is re-read once the writer finishes it), so the
+concatenation of every ``record`` event's decoded bytes is
+BYTE-IDENTICAL to the finished log file — the serving determinism
+contract surviving the hop over HTTP, pinned in
+tests/test_frontdoor.py down to the stochastic composites.
+
+Event vocabulary (in order):
+
+- ``meta``: one JSON object ``{rid, status}`` when the stream opens;
+- ``record``: one base64 line per complete log frame (header record
+  first, then one SEGMENT record per streamed window);
+- ``reset``: the request's result stream RESTARTED from scratch — a
+  device quarantine displaced it onto a surviving shard and its sink
+  regenerates the complete stream (docs/serving.md, "Mesh serving &
+  device failover"). The client discards everything received so far;
+  the re-streamed bytes are, by the failover contract, what a
+  never-faulted run would have produced;
+- ``end``: one JSON object ``{status, error}`` once the request is
+  terminal AND its records are durably down (the server's
+  per-request stream-completion mark — status alone runs ahead of
+  the sink under the pipeline); the connection closes after it.
+
+Comment lines (``: keepalive``) are emitted through long quiet gaps so
+proxies do not reap an idle-but-healthy stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+from typing import Any, AsyncIterator, Callable, Dict, Optional
+
+from lens_tpu.emit.log import tail_frames
+
+
+def sse_event(event: str, data: str) -> bytes:
+    """One SSE frame (single-line data — base64/JSON never embeds a
+    newline here)."""
+    return f"event: {event}\ndata: {data}\n\n".encode()
+
+
+def sse_comment(text: str = "keepalive") -> bytes:
+    return f": {text}\n\n".encode()
+
+
+async def record_events(
+    state: Callable[[], Dict[str, Any]],
+    poll_s: float = 0.02,
+    heartbeat_s: float = 15.0,
+    on_bytes: Optional[Callable[[int], None]] = None,
+) -> AsyncIterator[bytes]:
+    """Yield the SSE byte chunks of one request's record stream.
+
+    ``state()`` is the front door's lock-free ticket probe: a dict
+    with ``status`` (lifecycle string or ``"queued"`` while still at
+    the front door), ``terminal`` (bool), ``streamed`` (records
+    durably down — gates the ``end`` event), ``path`` (the result log,
+    None before admission / for sinkless failures) and ``error``.
+    ``on_bytes`` observes each record event's RAW frame size (the
+    per-tenant ``streamed_bytes`` counter).
+    """
+    st = state()
+    yield sse_event(
+        "meta", json.dumps({"rid": st.get("rid"), "status": st["status"]})
+    )
+    offset = 0
+    quiet = 0.0
+    epoch = st.get("epoch", 0)
+    while True:
+        st = state()
+        path = st.get("path")
+        # decide BEFORE reading: if the completion mark is already
+        # set, everything durable is visible to the read below, so
+        # ending after it can never drop a tail frame
+        done = bool(st["terminal"]) and (
+            st.get("streamed", False) or path is None
+        )
+        sent = False
+        exists = bool(path) and os.path.exists(path)
+        if st.get("epoch", 0) != epoch or (
+            exists and os.path.getsize(path) < offset
+        ):
+            # the request was displaced off a quarantined device and
+            # its sink restarted from scratch (or the file shrank
+            # under us, same thing): re-read from zero and tell the
+            # client to discard what it has
+            epoch = st.get("epoch", 0)
+            offset = 0
+            yield sse_event(
+                "reset", json.dumps({"reason": "stream restarted"})
+            )
+        if exists:
+            frames, offset = tail_frames(path, offset)
+            for raw in frames:
+                if on_bytes is not None:
+                    on_bytes(len(raw))
+                yield sse_event(
+                    "record", base64.b64encode(raw).decode()
+                )
+                sent = True
+        if done:
+            yield sse_event(
+                "end",
+                json.dumps(
+                    {"status": st["status"], "error": st.get("error")}
+                ),
+            )
+            return
+        if sent:
+            quiet = 0.0
+        else:
+            quiet += poll_s
+            if quiet >= heartbeat_s:
+                quiet = 0.0
+                yield sse_comment()
+        await asyncio.sleep(poll_s)
+
+
+def decode_record_events(body: bytes):
+    """Client-side helper (tests, bench): parse an SSE body into
+    ``(raw_frame_bytes, end_object)`` — the inverse of
+    :func:`record_events`. Raises if the stream carries no ``end``
+    event (a torn stream must not read as a complete one)."""
+    raw = b""
+    end_obj = None
+    event = None
+    for line in body.decode().split("\n"):
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            data = line[len("data: "):]
+            if event == "record":
+                raw += base64.b64decode(data)
+            elif event == "reset":
+                raw = b""  # stream restarted after device failover
+            elif event == "end":
+                end_obj = json.loads(data)
+    if end_obj is None:
+        raise ValueError("SSE stream carried no 'end' event (torn?)")
+    return raw, end_obj

@@ -1,0 +1,433 @@
+"""Multi-tenant admission policy: who gets the next lane, and when.
+
+The front door's job split (docs/serving.md, "Front door") follows the
+Podracer/Sebulba host-vs-device discipline one level up: the serve
+scheduler owns DEVICE policy (lane packing, windows, priority classes
+inside its bounded queue), and this module owns TENANT policy — which
+client's request is handed to the server next, and which requests are
+refused before they cost anything. Everything here is plain Python
+over plain data, deliberately jax-free and HTTP-free, so fairness is
+unit-testable with a fake clock and no sockets.
+
+Three mechanisms, composable per tenant (``tenants.json``):
+
+- **Weighted deficit round robin** (:class:`TenantScheduler`): queued
+  requests wait in per-(tenant, class) FIFOs; ``pop()`` serves the
+  ``interactive`` class strictly ahead of ``batch`` and, within a
+  class, cycles tenants crediting ``weight`` deficit per visit — a
+  tenant flooding its own queue cannot push another tenant's share
+  below ``weight / total_weight`` of admissions, which is the
+  starvation-freedom bound tests/test_frontdoor.py pins.
+- **Token-bucket rate limits** (:class:`TokenBucket`): ``rate``
+  requests/second with ``burst`` capacity; an empty bucket yields the
+  seconds until the next token — the HTTP 429 ``Retry-After``.
+- **In-flight quotas** (``max_inflight``): a hard cap on one tenant's
+  queued + running requests, the memory/lane-hoarding bound.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from dataclasses import dataclass, fields
+from typing import Any, Deque, Dict, List, Mapping, Optional, Tuple
+
+from lens_tpu.serve.batcher import BATCH, PRIORITIES
+
+#: Keys a tenants.json tenant entry may carry.
+_TENANT_KEYS = {
+    "name", "api_key", "weight", "rate", "burst", "max_inflight",
+    "queue_depth", "default_priority",
+}
+
+
+class TenantQueueFull(Exception):
+    """A tenant's front-door queue is at depth: retry after
+    ``retry_after`` seconds (maps to HTTP 429 + ``Retry-After``)."""
+
+    def __init__(self, tenant: str, depth: int, retry_after: float):
+        self.tenant = tenant
+        self.depth = int(depth)
+        self.retry_after = float(retry_after)
+        super().__init__(
+            f"tenant {tenant!r} queue full ({depth} waiting); retry "
+            f"in ~{self.retry_after:.2f}s"
+        )
+
+
+@dataclass
+class TenantConfig:
+    """One tenant's policy knobs (all enforcement lives in
+    :class:`TenantScheduler` / the front door).
+
+    weight:
+        WDRR share (> 0). With tenants A (2.0) and B (1.0) both
+        backlogged, A is admitted twice per B's once.
+    rate / burst:
+        Token-bucket submit rate limit: ``rate`` requests/second
+        sustained, ``burst`` tokens of headroom (default
+        ``max(rate, 1)``). ``None`` rate = unlimited.
+    max_inflight:
+        Cap on the tenant's queued-at-front-door + running requests;
+        a submit past it is throttled (429). ``None`` = unlimited.
+    queue_depth:
+        Bound on the tenant's front-door queues (both classes
+        combined); a submit past it is rejected (429 + Retry-After
+        from the server's occupancy hint).
+    default_priority:
+        Admission class for requests that do not name one.
+    api_key:
+        Shared secret identifying the tenant (``Authorization:
+        Bearer`` / ``X-API-Key``). ``None``: the tenant is OPEN — any
+        client may claim it by name via ``X-Tenant``.
+    """
+
+    name: str
+    api_key: Optional[str] = None
+    weight: float = 1.0
+    rate: Optional[float] = None
+    burst: Optional[float] = None
+    max_inflight: Optional[int] = None
+    queue_depth: int = 256
+    default_priority: str = BATCH
+
+    def __post_init__(self) -> None:
+        if not self.name or not isinstance(self.name, str):
+            raise ValueError(f"tenant name must be a non-empty string, "
+                             f"got {self.name!r}")
+        if not float(self.weight) > 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight={self.weight} must be > 0"
+            )
+        if self.rate is not None and not float(self.rate) > 0:
+            raise ValueError(
+                f"tenant {self.name!r}: rate={self.rate} must be > 0 "
+                f"(omit for unlimited)"
+            )
+        if self.burst is not None and not float(self.burst) >= 1:
+            raise ValueError(
+                f"tenant {self.name!r}: burst={self.burst} must be >= 1"
+            )
+        if self.max_inflight is not None and int(self.max_inflight) < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: max_inflight="
+                f"{self.max_inflight} must be >= 1"
+            )
+        if int(self.queue_depth) < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: queue_depth={self.queue_depth} "
+                f"must be >= 1"
+            )
+        if self.default_priority not in PRIORITIES:
+            raise ValueError(
+                f"tenant {self.name!r}: unknown default_priority "
+                f"{self.default_priority!r}; known: "
+                f"{', '.join(PRIORITIES)}"
+            )
+
+    @classmethod
+    def from_mapping(cls, entry: Mapping[str, Any]) -> "TenantConfig":
+        unknown = set(entry) - _TENANT_KEYS
+        if unknown:
+            raise ValueError(
+                f"tenant entry {entry.get('name', '?')!r}: unknown "
+                f"keys {sorted(unknown)}; known: {sorted(_TENANT_KEYS)}"
+            )
+        if "name" not in entry:
+            raise ValueError(f"tenant entry needs a 'name': {entry!r}")
+        kwargs = {f.name: entry[f.name] for f in fields(cls)
+                  if f.name in entry}
+        return cls(**kwargs)
+
+
+def load_tenants(spec: Any) -> Dict[str, TenantConfig]:
+    """Tenant table from the ``tenants.json`` form: a path, an inline
+    JSON string (starts with ``{`` or ``[`` — the CLI's ``--tenants``
+    accepts both), a list of tenant entries, or ``{"tenants": [...]}``.
+    Returns ``{name: TenantConfig}``; duplicate names and duplicate
+    api_keys raise."""
+    if isinstance(spec, str):
+        if spec.lstrip().startswith(("{", "[")):
+            spec = json.loads(spec)
+        else:
+            with open(spec) as f:
+                spec = json.load(f)
+    if isinstance(spec, Mapping):
+        unknown = set(spec) - {"tenants"}
+        if unknown:
+            raise ValueError(
+                f"unknown tenants-spec keys {sorted(unknown)}; known: "
+                f"tenants"
+            )
+        spec = spec.get("tenants") or []
+    if not isinstance(spec, (list, tuple)):
+        raise ValueError(
+            f"tenants spec must be a list of tenant entries (or "
+            f"{{'tenants': [...]}}), got {type(spec).__name__}"
+        )
+    out: Dict[str, TenantConfig] = {}
+    keys: Dict[str, str] = {}
+    for entry in spec:
+        cfg = (
+            entry if isinstance(entry, TenantConfig)
+            else TenantConfig.from_mapping(entry)
+        )
+        if cfg.name in out:
+            raise ValueError(f"duplicate tenant name {cfg.name!r}")
+        if cfg.api_key is not None:
+            if cfg.api_key in keys:
+                raise ValueError(
+                    f"tenants {keys[cfg.api_key]!r} and {cfg.name!r} "
+                    f"share an api_key"
+                )
+            keys[cfg.api_key] = cfg.name
+        out[cfg.name] = cfg
+    if not out:
+        raise ValueError("tenants spec names no tenants")
+    return out
+
+
+class TokenBucket:
+    """Classic token bucket, lazily refilled at ``take`` time.
+
+    ``take()`` returns 0.0 when a token was granted, else the seconds
+    until one becomes available (the Retry-After hint). ``clock`` is
+    injectable so rate-limit tests need no real sleeping.
+    """
+
+    def __init__(
+        self, rate: float, burst: Optional[float] = None, clock=None
+    ):
+        if not rate > 0:
+            raise ValueError(f"rate={rate} must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else max(
+            self.rate, 1.0
+        )
+        if self.burst < 1:
+            raise ValueError(f"burst={self.burst} must be >= 1")
+        self._clock = clock if clock is not None else time.monotonic
+        self._tokens = self.burst
+        self._stamp = self._clock()
+
+    def take(self) -> float:
+        now = self._clock()
+        self._tokens = min(
+            self.burst, self._tokens + (now - self._stamp) * self.rate
+        )
+        self._stamp = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return 0.0
+        return (1.0 - self._tokens) / self.rate
+
+
+@dataclass
+class Entry:
+    """One request waiting at the front door: everything the pump
+    needs to submit it to the server under its reserved id."""
+
+    rid: str
+    tenant: str
+    priority: str
+    request: Any  # a validated ScenarioRequest
+    received_at: float = 0.0
+
+
+class _Ring:
+    """One priority class's DRR ring: tenant order is registration
+    order (deterministic), ``next_tenant`` credits ``weight`` deficit
+    per visit and serves a tenant while its deficit lasts."""
+
+    def __init__(self) -> None:
+        self.order: List[str] = []
+        self.deficit: Dict[str, float] = {}
+        self.idx = 0
+
+    def add(self, tenant: str) -> None:
+        if tenant not in self.deficit:
+            self.order.append(tenant)
+            self.deficit[tenant] = 0.0
+
+
+class TenantScheduler:
+    """Per-tenant weighted deficit-round-robin queues in front of the
+    serve scheduler's bounded FIFO.
+
+    NOT thread-safe by itself — the front door serializes access under
+    its server lock (one lock for tenant policy + server calls keeps
+    the admission order a single serialized history, which is what
+    makes fairness testable).
+    """
+
+    def __init__(
+        self,
+        tenants: Mapping[str, TenantConfig],
+        clock=None,
+    ):
+        self.tenants = dict(tenants)
+        self._clock = clock if clock is not None else time.monotonic
+        self._queues: Dict[Tuple[str, str], Deque[Entry]] = {}
+        # an entry the server refused with QueueFull after it was
+        # popped: it goes out FIRST on the next pop (its WDRR turn was
+        # already spent on it)
+        self._head: Optional[Entry] = None
+        self._rings = {cls: _Ring() for cls in PRIORITIES}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self.inflight: Dict[str, int] = {}
+        for name, cfg in self.tenants.items():
+            for cls in PRIORITIES:
+                self._queues[(name, cls)] = deque()
+                self._rings[cls].add(name)
+            if cfg.rate is not None:
+                self._buckets[name] = TokenBucket(
+                    cfg.rate, cfg.burst, clock=self._clock
+                )
+            self.inflight[name] = 0
+
+    # -- ingress checks (the front door's 429 sources) -----------------------
+
+    def queued(self, tenant: Optional[str] = None) -> int:
+        head = (
+            1 if self._head is not None
+            and (tenant is None or self._head.tenant == tenant)
+            else 0
+        )
+        if tenant is not None:
+            return head + sum(
+                len(self._queues[(tenant, cls)]) for cls in PRIORITIES
+            )
+        return head + sum(len(q) for q in self._queues.values())
+
+    def throttle(self, tenant: str) -> Tuple[Optional[str], float]:
+        """Rate/quota check for one incoming request: ``(None, 0.0)``
+        to proceed, else ``(reason, retry_after)`` — the front door
+        turns a reason into a tenant-scoped 429. Consumes a token on
+        success (the request WILL be queued)."""
+        cfg = self.tenants[tenant]
+        if cfg.max_inflight is not None:
+            busy = self.queued(tenant) + self.inflight[tenant]
+            if busy >= cfg.max_inflight:
+                return (
+                    f"tenant {tenant!r} is at its in-flight quota "
+                    f"({busy}/{cfg.max_inflight} requests queued or "
+                    f"running)",
+                    1.0,
+                )
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            wait = bucket.take()
+            if wait > 0:
+                return (
+                    f"tenant {tenant!r} is over its rate limit "
+                    f"({cfg.rate}/s)",
+                    wait,
+                )
+        return None, 0.0
+
+    def push(self, entry: Entry, retry_after: float = 1.0) -> None:
+        """Queue one admitted-at-ingress request; raises
+        :class:`TenantQueueFull` past the tenant's depth bound."""
+        cfg = self.tenants[entry.tenant]
+        if self.queued(entry.tenant) >= cfg.queue_depth:
+            raise TenantQueueFull(
+                entry.tenant, self.queued(entry.tenant), retry_after
+            )
+        self._queues[(entry.tenant, entry.priority)].append(entry)
+
+    # -- egress (the pump's WDRR pop) ----------------------------------------
+
+    def pop(self) -> Optional[Entry]:
+        """The next request to hand the serve scheduler: a refused
+        head entry first, then the interactive class strictly ahead
+        of batch; within a class, weighted deficit round robin over
+        tenants (FIFO per tenant). Returns None when nothing is
+        queued."""
+        if self._head is not None:
+            entry, self._head = self._head, None
+            return entry
+        for cls in PRIORITIES:
+            entry = self._pop_ring(cls)
+            if entry is not None:
+                return entry
+        return None
+
+    def _pop_ring(self, cls: str) -> Optional[Entry]:
+        ring = self._rings[cls]
+        active = [
+            t for t in ring.order if self._queues[(t, cls)]
+        ]
+        if not active:
+            # idle class: deficits reset so a later burst starts fair
+            # (standard DRR — credit must not accrue while empty)
+            for t in ring.order:
+                ring.deficit[t] = 0.0
+            return None
+        # bounded scan: each full pass over the active tenants credits
+        # every deficit by its weight, so within ceil(1/min_weight)
+        # passes someone can afford a request. A tenant's turn lasts
+        # while its deficit covers another request (weight 2 serves
+        # two per visit); the pointer advances the moment its deficit
+        # breaks, so no tenant can be revisited before the others.
+        min_w = min(self.tenants[t].weight for t in active)
+        for _ in range(2 * len(active) * (int(1.0 / min_w) + 2)):
+            t = active[ring.idx % len(active)]
+            if not self._queues[(t, cls)]:
+                ring.deficit[t] = 0.0
+                ring.idx += 1
+                continue
+            if ring.deficit[t] >= 1.0:
+                ring.deficit[t] -= 1.0
+                if ring.deficit[t] < 1.0:
+                    ring.idx += 1  # turn exhausted AFTER this serve
+                return self._queues[(t, cls)].popleft()
+            ring.deficit[t] += self.tenants[t].weight
+            if ring.deficit[t] < 1.0:
+                ring.idx += 1
+        # unreachable for weights > 0; be loud rather than spin
+        raise RuntimeError("WDRR failed to converge (weights broken?)")
+
+    def push_front(self, entry: Entry) -> None:
+        """Return a popped entry to the scheduler's head slot (the
+        server refused it with QueueFull): it keeps its turn — the
+        next pop hands it out again before any ring is consulted."""
+        if self._head is not None:
+            raise RuntimeError(
+                "push_front called with a head entry already parked "
+                "(the pump must re-pop before refusing again)"
+            )
+        self._head = entry
+
+    def cancel(self, rid: str) -> Optional[Entry]:
+        """Remove a still-queued request by id (front-door cancel)."""
+        if self._head is not None and self._head.rid == rid:
+            entry, self._head = self._head, None
+            return entry
+        for q in self._queues.values():
+            for entry in q:
+                if entry.rid == rid:
+                    q.remove(entry)
+                    return entry
+        return None
+
+    # -- inflight accounting -------------------------------------------------
+
+    def note_submitted(self, tenant: str) -> None:
+        self.inflight[tenant] += 1
+
+    def note_finished(self, tenant: str) -> None:
+        self.inflight[tenant] = max(0, self.inflight[tenant] - 1)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Live per-tenant queue/inflight gauges (the /healthz body)."""
+        return {
+            name: {
+                "queued": self.queued(name),
+                "inflight": self.inflight[name],
+                "weight": cfg.weight,
+                "rate": cfg.rate,
+                "max_inflight": cfg.max_inflight,
+            }
+            for name, cfg in self.tenants.items()
+        }

@@ -9,11 +9,15 @@ determinism contract.
 """
 
 from lens_tpu.serve.batcher import (
+    BATCH,
     CANCELLED,
     DONE,
     FAILED,
+    INTERACTIVE,
+    PRIORITIES,
     QUEUED,
     QueueFull,
+    RequestValidationError,
     RUNNING,
     SimulationDiverged,
     TIMEOUT,
@@ -28,12 +32,16 @@ from lens_tpu.serve.streamer import Streamer, WatchdogTimeout
 from lens_tpu.serve.wal import ServeWal
 
 __all__ = [
+    "BATCH",
     "CANCELLED",
     "DONE",
     "FAILED",
+    "INTERACTIVE",
+    "PRIORITIES",
     "QUEUED",
     "FaultPlan",
     "QueueFull",
+    "RequestValidationError",
     "RUNNING",
     "TIMEOUT",
     "LanePool",

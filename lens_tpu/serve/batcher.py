@@ -22,6 +22,7 @@ Retry-After).
 from __future__ import annotations
 
 import itertools
+import numbers
 import time
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, Iterator, List, Mapping, Optional
@@ -33,6 +34,30 @@ DONE = "done"
 TIMEOUT = "timeout"
 CANCELLED = "cancelled"
 FAILED = "failed"
+
+#: Admission priority classes, highest first. ``interactive`` requests
+#: are admitted ahead of ``batch`` ones whenever both wait for a lane
+#: (FIFO within a class) — the front door's latency tier. The default
+#: is ``batch``: a request stream that never names a priority is the
+#: plain FIFO the server always had, bit for bit.
+INTERACTIVE = "interactive"
+BATCH = "batch"
+PRIORITIES = (INTERACTIVE, BATCH)
+
+
+class RequestValidationError(ValueError):
+    """A malformed request, with a MACHINE-READABLE field path.
+
+    ``path`` names the offending field in dotted form (``"emit.every"``,
+    ``"prefix.horizon"``, ``"overrides"``) — what the front door's HTTP
+    400 body carries so a client can repair programmatically instead of
+    parsing prose. A ``ValueError`` subclass, so every existing
+    ``except ValueError`` call site keeps working unchanged.
+    """
+
+    def __init__(self, message: str, path: Optional[str] = None):
+        self.path = path
+        super().__init__(message)
 
 
 class QueueFull(Exception):
@@ -48,6 +73,93 @@ class QueueFull(Exception):
         super().__init__(
             f"request queue full ({depth} deep); retry in "
             f"~{self.retry_after:.2f}s"
+        )
+
+
+def validate_emit_block(emit: Any) -> None:
+    """Structural validation of a request's ``emit`` block (the checks
+    that need no bucket/schema context), shared by
+    :meth:`ScenarioRequest.from_mapping` and ``SimServer`` submit-time
+    validation. Raises :class:`RequestValidationError` with the
+    offending field's dotted path."""
+    if emit is None:
+        return
+    if not isinstance(emit, Mapping):
+        raise RequestValidationError(
+            f"emit must be a mapping, got {type(emit).__name__}",
+            path="emit",
+        )
+    unknown = set(emit) - {"paths", "every"}
+    if unknown:
+        raise RequestValidationError(
+            f"unknown emit keys {sorted(unknown)}; known: every, paths",
+            path=f"emit.{sorted(unknown)[0]}",
+        )
+    every = emit.get("every", 1)
+    # integral-valued floats pass (the pre-round-15 server coerced
+    # with int(), so a request file carrying 2.0 keeps working)
+    if isinstance(every, bool) or not (
+        isinstance(every, numbers.Integral)
+        or (isinstance(every, numbers.Real)
+            and float(every).is_integer())
+    ):
+        raise RequestValidationError(
+            f"emit every must be an integer, got {every!r}",
+            path="emit.every",
+        )
+    if every < 1:
+        raise RequestValidationError(
+            f"emit every={every} must be >= 1", path="emit.every"
+        )
+    paths = emit.get("paths")
+    if paths is not None and (
+        isinstance(paths, (str, bytes))
+        or not isinstance(paths, (list, tuple))
+        or not all(isinstance(p, str) for p in paths)
+    ):
+        raise RequestValidationError(
+            "emit paths must be a list of path-prefix strings",
+            path="emit.paths",
+        )
+
+
+def validate_prefix_block(prefix: Any) -> None:
+    """Structural validation of a request's ``prefix`` block (shape
+    only — horizon-grid and override-path checks need the bucket and
+    stay server-side). Raises :class:`RequestValidationError` with the
+    offending field's dotted path."""
+    if prefix is None:
+        return
+    if not isinstance(prefix, Mapping):
+        raise RequestValidationError(
+            f"prefix must be a mapping, got {type(prefix).__name__}",
+            path="prefix",
+        )
+    unknown = set(prefix) - {"horizon", "overrides"}
+    if unknown:
+        raise RequestValidationError(
+            f"unknown prefix keys {sorted(unknown)}; known: "
+            f"horizon, overrides",
+            path=f"prefix.{sorted(unknown)[0]}",
+        )
+    if "horizon" not in prefix:
+        raise RequestValidationError(
+            "prefix needs a 'horizon'", path="prefix.horizon"
+        )
+    if isinstance(prefix["horizon"], bool) or not isinstance(
+        prefix["horizon"], numbers.Real
+    ):
+        raise RequestValidationError(
+            f"prefix horizon must be a number, got "
+            f"{prefix['horizon']!r}",
+            path="prefix.horizon",
+        )
+    overrides = prefix.get("overrides")
+    if overrides is not None and not isinstance(overrides, Mapping):
+        raise RequestValidationError(
+            f"prefix overrides must be a mapping, got "
+            f"{type(overrides).__name__}",
+            path="prefix.overrides",
         )
 
 
@@ -115,6 +227,17 @@ class ScenarioRequest:
         prefix horizon). Must be shorter than ``horizon`` and on the
         bucket's step/emit grid. See docs/serving.md, "Prefix caching
         & forking".
+    tenant:
+        The tenant this request belongs to (multi-tenant serving via
+        the front door — docs/serving.md, "Front door"). The server
+        keeps per-tenant counters (admitted/rejected/...) under this
+        label; ``None`` (default) is untenanted traffic and counts
+        nowhere extra.
+    priority:
+        Admission class: ``"interactive"`` requests are admitted ahead
+        of ``"batch"`` (default) ones whenever both are queued; FIFO
+        within a class. An all-default stream is the plain FIFO the
+        server always had.
     """
 
     composite: str
@@ -126,22 +249,80 @@ class ScenarioRequest:
     deadline: Optional[float] = None
     hold_state: bool = False
     prefix: Optional[Mapping[str, Any]] = None
+    tenant: Optional[str] = None
+    priority: str = BATCH
 
     @classmethod
     def from_mapping(
         cls, request: Mapping[str, Any]
     ) -> "ScenarioRequest":
-        """Build from a JSON-shaped dict with a DESCRIPTIVE unknown-key
-        error (``cls(**request)`` would raise an opaque ``TypeError``
-        naming dataclass internals) — the CLI and ``SimServer.submit``
-        both route mapping submissions through here."""
+        """Build from a JSON-shaped dict, validating every block's
+        SHAPE eagerly with a descriptive error carrying a
+        machine-readable field path (:class:`RequestValidationError`
+        — the front door's 400 body quotes ``.path``). Schema-aware
+        checks (override paths, horizon grid, n_agents vs capacity)
+        still live server-side, where the bucket is known. The CLI and
+        ``SimServer.submit`` both route mapping submissions through
+        here."""
         known = {f.name for f in fields(cls)}
         unknown = set(request) - known
         if unknown:
-            raise ValueError(
+            raise RequestValidationError(
                 f"unknown request keys {sorted(unknown)}; known: "
-                f"{sorted(known)}"
+                f"{sorted(known)}",
+                path=sorted(unknown)[0],
             )
+        def _bad(name: str, want: str, path: Optional[str] = None):
+            return RequestValidationError(
+                f"{name} must be {want}, got {request[name]!r}",
+                path=path or name,
+            )
+
+        if "composite" in request and not isinstance(
+            request["composite"], str
+        ):
+            raise _bad("composite", "a string")
+        if "seed" in request and (
+            isinstance(request["seed"], bool)
+            or not isinstance(request["seed"], numbers.Integral)
+        ):
+            raise _bad("seed", "an integer")
+        for key in ("horizon", "deadline"):
+            if key in request and request[key] is not None and (
+                isinstance(request[key], bool)
+                or not isinstance(request[key], numbers.Real)
+            ):
+                raise _bad(key, "a number")
+        if "overrides" in request and not isinstance(
+            request["overrides"], Mapping
+        ):
+            raise _bad("overrides", "a mapping of state paths")
+        if "n_agents" in request and request["n_agents"] is not None \
+                and (
+                    isinstance(request["n_agents"], bool)
+                    or not isinstance(
+                        request["n_agents"],
+                        (numbers.Integral, Mapping),
+                    )
+                ):
+            raise _bad(
+                "n_agents", "an integer or per-species mapping"
+            )
+        if "hold_state" in request and not isinstance(
+            request["hold_state"], bool
+        ):
+            raise _bad("hold_state", "a boolean")
+        if "tenant" in request and request["tenant"] is not None \
+                and not isinstance(request["tenant"], str):
+            raise _bad("tenant", "a string")
+        if "priority" in request and request["priority"] not in PRIORITIES:
+            raise RequestValidationError(
+                f"unknown priority {request['priority']!r}; known: "
+                f"{', '.join(PRIORITIES)}",
+                path="priority",
+            )
+        validate_emit_block(request.get("emit"))
+        validate_prefix_block(request.get("prefix"))
         return cls(**request)
 
 
@@ -215,6 +396,10 @@ class Ticket:
     # quarantine (check_finite): the per-window finite check flagged
     # this ticket's lane; result() raises SimulationDiverged
     diverged: bool = False
+    # sink_errors="request": this ticket's sink already failed and was
+    # closed by the stream-side error handler — terminal paths must
+    # not close (or stream to) it again
+    sink_closed: bool = False
 
     def expired(self, now: float) -> bool:
         return (
@@ -311,20 +496,30 @@ class RequestQueue:
     def take(
         self, bucket_of, free_lanes: Dict[str, int], ready=None
     ) -> List[Ticket]:
-        """FIFO admission pass: tickets whose bucket still has a free
-        lane, decrementing ``free_lanes`` as it goes. ``bucket_of`` maps
-        a ticket to its bucket name. ``ready`` (optional predicate)
-        skips tickets that cannot be admitted yet — forks waiting on an
-        in-flight prefix — without losing their queue position, the
-        same non-blocking discipline as the per-bucket skip."""
+        """Priority-then-FIFO admission pass: tickets whose bucket
+        still has a free lane, decrementing ``free_lanes`` as it goes,
+        considering every ``interactive`` ticket before any ``batch``
+        one (stable within a class, so an all-default queue is the
+        plain FIFO pass this always was — bit for bit). ``bucket_of``
+        maps a ticket to its bucket name. ``ready`` (optional
+        predicate) skips tickets that cannot be admitted yet — forks
+        waiting on an in-flight prefix — without losing their queue
+        position, the same non-blocking discipline as the per-bucket
+        skip."""
         taken: List[Ticket] = []
-        rest: List[Ticket] = []
-        for t in self._queue:
+        # stable sort on the class rank only: FIFO within interactive,
+        # FIFO within batch, interactive first
+        for t in sorted(
+            self._queue,
+            key=lambda t: 0 if t.request.priority == INTERACTIVE else 1,
+        ):
             b = bucket_of(t)
             if (ready is None or ready(t)) and free_lanes.get(b, 0) > 0:
                 free_lanes[b] -= 1
                 taken.append(t)
-            else:
-                rest.append(t)
-        self._queue = rest
+        if taken:
+            picked = {id(t) for t in taken}
+            self._queue = [
+                t for t in self._queue if id(t) not in picked
+            ]
         return taken

@@ -65,7 +65,17 @@ _COUNTER_HELP = {
     "diverged": "lanes quarantined by the per-window finite check",
     "recovered": "unfinished WAL requests re-admitted at startup",
     "requeued": "requests displaced from a quarantined device",
+    "sink_failed": "requests failed by a request-scoped sink error",
 }
+
+#: Per-tenant counter names (round 15, docs/serving.md "Front door"):
+#: ``admitted``/``rejected`` are incremented by the server at submit
+#: (accepted into the queue / bounded-queue backpressure) for any
+#: request carrying a ``tenant``; ``throttled`` (rate-limit and
+#: in-flight-quota refusals) and ``streamed_bytes`` (record bytes
+#: streamed to the tenant over HTTP) are incremented by the front
+#: door, which owns those policies.
+TENANT_COUNTERS = ("admitted", "rejected", "throttled", "streamed_bytes")
 
 
 class ServerMetrics:
@@ -199,6 +209,12 @@ class ServerMetrics:
         # bounded queue full — host streaming is the bottleneck)
         self.stall_seconds = 0.0
         self.stalls = 0
+        # per-tenant counters (TENANT_COUNTERS), created lazily on the
+        # first increment for a tenant name. Locked: the front door's
+        # HTTP threads (throttles, streamed bytes) and the scheduler
+        # thread (admits/rejects) both write.
+        self._tenant_lock = threading.Lock()
+        self._tenants: Dict[str, Dict[str, int]] = {}
 
     # -- writers -------------------------------------------------------------
 
@@ -210,6 +226,33 @@ class ServerMetrics:
 
     def inc(self, name: str, by: int = 1) -> None:
         self._counters[name].inc(by)
+
+    def tenant_inc(
+        self, tenant: Optional[str], name: str, by: int = 1
+    ) -> None:
+        """Bump one tenant-scoped counter (no-op for untenanted
+        traffic, so the single-tenant serve path pays one None check).
+        Unknown names raise — a typo'd counter must not silently
+        create a new column."""
+        if tenant is None:
+            return
+        if name not in TENANT_COUNTERS:
+            raise KeyError(
+                f"unknown tenant counter {name!r}; known: "
+                f"{TENANT_COUNTERS}"
+            )
+        with self._tenant_lock:
+            row = self._tenants.setdefault(
+                str(tenant), {k: 0 for k in TENANT_COUNTERS}
+            )
+            row[name] += int(by)
+
+    @property
+    def tenants(self) -> Dict[str, Dict[str, int]]:
+        """A consistent copy of the per-tenant counter table
+        ({tenant: {admitted, rejected, throttled, streamed_bytes}})."""
+        with self._tenant_lock:
+            return {t: dict(row) for t, row in self._tenants.items()}
 
     def observe_request(self, wait_s: float, total_s: float) -> None:
         self.wait_seconds.observe(wait_s)
@@ -332,6 +375,7 @@ class ServerMetrics:
             "stream_lag_seconds": percentiles(self.stream_lag_seconds()),
             "stream_stall_seconds": self.stall_seconds,
             "stream_stalls": self.stalls,
+            "tenants": self.tenants,
         }
 
     def sample_point(self) -> Dict[str, Any]:
@@ -352,6 +396,9 @@ class ServerMetrics:
         }
         if self.shards:
             point["shards"] = [dict(s) for s in self.shards]
+        tenants = self.tenants
+        if tenants:
+            point["tenants"] = tenants
         return point
 
     def prometheus_text(self) -> str:
@@ -380,6 +427,28 @@ class ServerMetrics:
                     f"{ns}_shard_quarantined{label} "
                     f"{int(bool(s.get('quarantined')))}"
                 )
+        tenants = self.tenants
+        if tenants:
+            ns = self.registry.namespace
+
+            def esc(label: str) -> str:
+                # Prometheus label-value escaping: a tenant name with
+                # a quote/backslash/newline must not corrupt the
+                # whole exposition
+                return (
+                    label.replace("\\", "\\\\")
+                    .replace('"', '\\"')
+                    .replace("\n", "\\n")
+                )
+
+            for name in TENANT_COUNTERS:
+                lines.append(f"# TYPE {ns}_tenant_{name}_total counter")
+                for t in sorted(tenants):
+                    lines.append(
+                        f'{ns}_tenant_{name}_total'
+                        f'{{tenant="{esc(t)}"}} '
+                        f"{tenants[t][name]}"
+                    )
         return "\n".join(lines) + "\n"
 
 

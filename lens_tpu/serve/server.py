@@ -89,17 +89,22 @@ import numpy as np
 from lens_tpu.emit import LogEmitter
 from lens_tpu.emit.log import SEP
 from lens_tpu.serve.batcher import (
+    BATCH,
     CANCELLED,
     DONE,
     FAILED,
+    PRIORITIES,
     QUEUED,
     QueueFull,
     RUNNING,
+    RequestValidationError,
     SimulationDiverged,
     TIMEOUT,
     RequestQueue,
     ScenarioRequest,
     Ticket,
+    validate_emit_block,
+    validate_prefix_block,
 )
 from lens_tpu.obs.metrics import MetricsRing
 from lens_tpu.obs.trace import (
@@ -207,6 +212,12 @@ def _request_to_json(request: ScenarioRequest) -> Dict[str, Any]:
         if prefix.get("overrides"):
             block["overrides"] = _tree_to_json(prefix["overrides"])
         out["prefix"] = block
+    # tenancy/priority (round 15): recorded only when set, so a WAL
+    # written by untenanted traffic is byte-compatible with round 14
+    if request.tenant is not None:
+        out["tenant"] = str(request.tenant)
+    if request.priority != BATCH:
+        out["priority"] = str(request.priority)
     return out
 
 
@@ -452,6 +463,19 @@ class SimServer:
         this many seconds raises ``WatchdogTimeout`` instead of
         wedging ``tick()`` behind a hung sink or device window
         forever. ``None`` (default) = wait indefinitely.
+    sink_errors:
+        What a failed SINK APPEND (one request's result log raising —
+        disk quota, injected io_error) does. ``"fatal"`` (default, the
+        round-14 contract): the error parks on the stream pipe and
+        raises at the next scheduler call — correct for a
+        single-operator batch server where a torn stream means the
+        run is over. ``"request"``: the failure is scoped to the ONE
+        request whose sink raised — it retires FAILED with the cause,
+        its lane is reclaimed, every co-batched request keeps
+        streaming — the multi-tenant front-door policy (one tenant's
+        full disk must not take the server down). Errors not
+        attributable to a single sink (the device fetch itself) stay
+        fatal either way.
     recover_dir:
         Directory for the serve write-ahead log (``serve.wal``) and
         held-snapshot spills (``snapshots/``). When given, every
@@ -523,6 +547,7 @@ class SimServer:
         snapshot_budget_mb: Optional[float] = None,
         check_finite: str = "off",
         watchdog_s: Optional[float] = None,
+        sink_errors: str = "fatal",
         recover_dir: Optional[str] = None,
         faults: Optional[FaultPlan] = None,
         mesh: Any = None,
@@ -546,6 +571,11 @@ class SimServer:
             raise ValueError(
                 f"unknown check_finite {check_finite!r}; known: "
                 f"off, window"
+            )
+        if sink_errors not in ("fatal", "request"):
+            raise ValueError(
+                f"unknown sink_errors {sink_errors!r}; known: "
+                f"fatal, request"
             )
         if recover_dir and sink != "log":
             raise ValueError(
@@ -607,6 +637,12 @@ class SimServer:
         self.pipeline = pipeline
         self.check_finite = check_finite
         self.watchdog_s = watchdog_s
+        self.sink_errors = sink_errors
+        # sink_errors="request": failures the stream thread scoped to
+        # one request's sink, consumed (and turned into FAILED
+        # retirements) at the next tick's sweep
+        self._sink_failures: Dict[str, BaseException] = {}
+        self._sink_fail_lock = threading.Lock()
         self.faults = faults if faults is not None else FaultPlan(None)
         if self.trace:
             self.faults.trace = self.trace
@@ -676,8 +712,8 @@ class SimServer:
             "queue_depth", "out_dir", "sink", "stream_flush",
             "flush_every", "pipeline", "stream_queue",
             "snapshot_budget_mb", "check_finite", "watchdog_s",
-            "recover_dir", "faults", "mesh", "device_watchdog_s",
-            "trace_dir", "metrics_interval_s",
+            "sink_errors", "recover_dir", "faults", "mesh",
+            "device_watchdog_s", "trace_dir", "metrics_interval_s",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -686,7 +722,40 @@ class SimServer:
 
     # -- client surface ------------------------------------------------------
 
-    def submit(self, request: ScenarioRequest | Mapping[str, Any]) -> str:
+    def reserve_id(self) -> str:
+        """Mint (and permanently consume) the next request id WITHOUT
+        queueing anything — the front door reserves ids at HTTP accept
+        time so a client holds its rid while the request still waits
+        in the tenant scheduler, then submits with ``rid=``. A
+        reserved id that is never submitted (cancelled at the front
+        door) simply leaves a gap in the sequence."""
+        return self.queue.next_id()
+
+    def validate(
+        self, request: ScenarioRequest | Mapping[str, Any]
+    ) -> ScenarioRequest:
+        """Run the full submit-time validation WITHOUT queueing:
+        raises exactly what :meth:`submit` would raise for a malformed
+        request (``ValueError``/``RequestValidationError``), returns
+        the parsed request otherwise. The front door's 400-before-
+        enqueue check. No side effects."""
+        if isinstance(request, Mapping):
+            request = ScenarioRequest.from_mapping(request)
+        self._build_ticket(request, "validate-probe")
+        return request
+
+    def retry_after_hint(self) -> float:
+        """The occupancy-derived backpressure hint (what a ``QueueFull``
+        would quote right now) — the front door's ``Retry-After``
+        source for refusals it issues itself (tenant queue full,
+        drain)."""
+        return self._retry_after()
+
+    def submit(
+        self,
+        request: ScenarioRequest | Mapping[str, Any],
+        rid: Optional[str] = None,
+    ) -> str:
         """Queue a request; returns its request id.
 
         Raises ``ValueError`` for malformed requests — unknown bucket
@@ -697,16 +766,24 @@ class SimServer:
         site, not a FAILED ticket from deep inside the admission
         build). Raises ``QueueFull`` for backpressure (a healthy
         client retries after ``.retry_after`` seconds).
+
+        ``rid`` admits under a PRE-RESERVED id (one previously handed
+        out by :meth:`reserve_id` — the front door's deferred-submit
+        path); default is to mint the next id here.
         """
         if isinstance(request, Mapping):
             request = ScenarioRequest.from_mapping(request)
-        ticket = self._build_ticket(request, self.queue.next_id())
+        ticket = self._build_ticket(
+            request, rid if rid is not None else self.queue.next_id()
+        )
         try:
             self.queue.push(ticket, retry_after=self._retry_after())
         except QueueFull:
             self._metrics.inc("rejected")
+            self._metrics.tenant_inc(request.tenant, "rejected")
             self._metrics.queue_depth = len(self.queue)
             raise
+        self._metrics.tenant_inc(request.tenant, "admitted")
         self._register(ticket)
         if self._wal is not None:
             # durable intent: the WAL knows the request before the
@@ -726,9 +803,10 @@ class SimServer:
         original request id)."""
         bucket = self.buckets.get(request.composite)
         if bucket is None:
-            raise ValueError(
+            raise RequestValidationError(
                 f"no bucket serves composite {request.composite!r}; "
-                f"configured: {sorted(self.buckets)}"
+                f"configured: {sorted(self.buckets)}",
+                path="composite",
             )
         if not bucket.active_shards():
             raise ValueError(
@@ -785,43 +863,39 @@ class SimServer:
         n_agents against its capacities. Value shapes still validate
         at admission (they need the built state) and still fail only
         the one request."""
-        emit = request.emit
-        if emit is not None:
-            if not isinstance(emit, Mapping):
-                raise ValueError(
-                    f"emit must be a mapping, got "
-                    f"{type(emit).__name__}"
-                )
-            unknown = set(emit) - {"paths", "every"}
-            if unknown:
-                raise ValueError(
-                    f"unknown emit keys {sorted(unknown)}; known: "
-                    f"every, paths"
-                )
-            every = int(emit.get("every", 1))
-            if every < 1:
-                raise ValueError(f"emit every={every} must be >= 1")
-            paths = emit.get("paths")
-            if paths is not None and (
-                isinstance(paths, (str, bytes))
-                or not all(isinstance(p, str) for p in paths)
-            ):
-                raise ValueError(
-                    "emit paths must be a list of path-prefix strings"
-                )
-        pool = bucket.pool
-        pool.validate_overrides(request.overrides, what="override")
-        if request.prefix is not None:
-            if not isinstance(request.prefix, Mapping):
-                raise ValueError(
-                    f"prefix must be a mapping, got "
-                    f"{type(request.prefix).__name__}"
-                )
-            pool.validate_overrides(
-                dict(request.prefix).get("overrides"),
-                what="prefix override",
+        validate_emit_block(request.emit)
+        validate_prefix_block(request.prefix)
+        if request.priority not in PRIORITIES:
+            raise RequestValidationError(
+                f"unknown priority {request.priority!r}; known: "
+                f"{', '.join(PRIORITIES)}",
+                path="priority",
             )
-        pool.validate_agents(self._request_agents(bucket, request))
+        pool = bucket.pool
+        try:
+            pool.validate_overrides(request.overrides, what="override")
+        except RequestValidationError:
+            raise
+        except ValueError as e:
+            raise RequestValidationError(str(e), path="overrides")
+        if request.prefix is not None:
+            try:
+                pool.validate_overrides(
+                    dict(request.prefix).get("overrides"),
+                    what="prefix override",
+                )
+            except RequestValidationError:
+                raise
+            except ValueError as e:
+                raise RequestValidationError(
+                    str(e), path="prefix.overrides"
+                )
+        try:
+            pool.validate_agents(self._request_agents(bucket, request))
+        except RequestValidationError:
+            raise
+        except ValueError as e:
+            raise RequestValidationError(str(e), path="n_agents")
 
     def _validate_prefix(
         self, bucket: _Bucket, request: ScenarioRequest, steps: int
@@ -841,10 +915,11 @@ class SimServer:
             raise ValueError("prefix needs a 'horizon'")
         prefix_steps = self._horizon_steps(bucket, prefix["horizon"])
         if prefix_steps >= steps:
-            raise ValueError(
+            raise RequestValidationError(
                 f"prefix horizon ({prefix['horizon']}) must be shorter "
                 f"than the request horizon ({request.horizon}) — the "
-                f"suffix needs at least one step"
+                f"suffix needs at least one step",
+                path="prefix.horizon",
             )
         key = snapshot_key(
             request.composite,
@@ -925,6 +1000,10 @@ class SimServer:
             horizon=t.steps_done * bucket.pool.timestep,
             overrides=dict(req.prefix).get("overrides") or {},
             n_agents=req.n_agents,
+            # an interactive fork's prefix run is on its latency path:
+            # the internal ticket rides the fork's admission class
+            # (tenant deliberately unset — internal work is unbilled)
+            priority=req.priority,
         )
         warm_ticket = Ticket(
             request_id=self.queue.next_id(),
@@ -961,14 +1040,16 @@ class SimServer:
         if steps < 1 or abs(
             steps * pool.timestep - float(horizon)
         ) > 1e-6 * max(abs(float(horizon)), 1.0):
-            raise ValueError(
+            raise RequestValidationError(
                 f"horizon={horizon} is not a positive multiple "
-                f"of the bucket timestep {pool.timestep}"
+                f"of the bucket timestep {pool.timestep}",
+                path="horizon",
             )
         if steps % pool.emit_every != 0:
-            raise ValueError(
+            raise RequestValidationError(
                 f"horizon steps ({steps}) must be a multiple of the "
-                f"bucket emit_every ({pool.emit_every})"
+                f"bucket emit_every ({pool.emit_every})",
+                path="horizon",
             )
         return steps
 
@@ -1036,8 +1117,10 @@ class SimServer:
             self.queue.push(ticket, retry_after=self._retry_after())
         except QueueFull:
             self._metrics.inc("rejected")
+            self._metrics.tenant_inc(request.tenant, "rejected")
             self._metrics.queue_depth = len(self.queue)
             raise
+        self._metrics.tenant_inc(request.tenant, "admitted")
         # pin the held snapshot for the continuation only once the push
         # can no longer fail — QueueFull must leave no dangling ref
         ticket.carry_key = parent.held_key
@@ -1139,6 +1222,7 @@ class SimServer:
                 "forks": c["prefix_forks"],
                 "evictions": c["snapshot_evictions"],
             },
+            "tenants": self._metrics.tenants,
         }
 
     def reset_samples(self) -> None:
@@ -1216,6 +1300,7 @@ class SimServer:
         A non-terminal (running) request falls back to a full drain
         barrier before returning its partial records.
         """
+        self._sweep_sink_failures()
         t = self._ticket(request_id)
         if t.diverged:
             # quarantined physics: never hand back the (post-divergence
@@ -1303,6 +1388,9 @@ class SimServer:
         """
         if self._streamer is not None:
             self._streamer.check()
+        # sink_errors="request": retire requests whose sink failed
+        # since the last tick (one-window lag, like the finite check)
+        self._sweep_sink_failures()
         if self._wal is not None:
             # group commit: every WAL append since the last tick is
             # durable before the scheduler acts on any of it (one
@@ -1440,6 +1528,11 @@ class SimServer:
                 # reporting idle (also surfaces stream errors here)
                 if self._streamer is not None:
                     self._streamer.drain()
+                if self._sink_failures:
+                    # a scoped sink failure landed during the final
+                    # drain: tick once more so it retires FAILED
+                    # before this reports idle
+                    continue
                 return ticks
             if max_ticks is not None and ticks >= max_ticks:
                 raise RuntimeError(
@@ -1956,6 +2049,12 @@ class SimServer:
         t.carry_state = None
         t.carry_shard = None
         t.waiting = False
+        # a sink failure parked for the OLD incarnation is void — the
+        # re-run gets a fresh sink, and the first-failure-wins guard
+        # must not swallow a genuine failure of the new one
+        with self._sink_fail_lock:
+            t.sink_closed = False
+            self._sink_failures.pop(t.request_id, None)
         if t.cancel_requested:
             self._finish(t, CANCELLED)
             self._metrics.inc("cancelled")
@@ -2128,7 +2227,12 @@ class SimServer:
                 # no rows kept this window, but the sink must still
                 # close AFTER any appends already queued for it
                 job = LaneSlice(
-                    t.request_id, self._results[t.request_id]
+                    t.request_id, self._results[t.request_id],
+                    on_error=(
+                        self._sink_error_cb(t)
+                        if self.sink_errors == "request"
+                        else None
+                    ),
                 )
                 slices.append(job)
             if retire:
@@ -2251,6 +2355,11 @@ class SimServer:
             idx=idx,
             times=times,
             paths=[str(p) for p in paths] if paths else None,
+            on_error=(
+                self._sink_error_cb(t)
+                if self.sink_errors == "request"
+                else None
+            ),
         )
 
     def _spill_hold(self, t: Ticket, key, snap) -> None:
@@ -2294,6 +2403,113 @@ class SimServer:
                 shard=t.shard or 0,
             )
             self.faults.kill("streamed.walled")
+
+    def _sink_error_cb(self, t: Ticket):
+        """The per-request sink-failure handler handed to each stream
+        slice under ``sink_errors="request"``: runs on the stream
+        thread (or inline on the sync path), closes the broken sink,
+        parks the failure for the scheduler's sweep, and releases any
+        ``result()`` waiter. First failure wins (later windows of the
+        same dead sink re-raise into the same handler)."""
+
+        def failed(e: BaseException) -> None:
+            with self._sink_fail_lock:
+                if t.sink_closed:
+                    # later windows of the already-dead sink raise
+                    # again; the FIRST failure is the cause on record
+                    return
+                t.sink_closed = True
+                self._sink_failures[t.request_id] = e
+            try:
+                self._results[t.request_id].close()
+            except Exception:
+                pass  # the sink is already broken
+            # the torn stream is FINAL: whatever landed before the
+            # failure is all there will ever be — stamp the stream
+            # completion so result() and front-door streams stop
+            # waiting for appends that can never come (no WAL
+            # `streamed` event: that attestation is reserved for
+            # complete DONE streams)
+            t.streamed_at = time.perf_counter()
+            ev = self._stream_done.get(t.request_id)
+            if ev is not None:
+                ev.set()
+
+        return failed
+
+    def _sweep_sink_failures(self) -> None:
+        """Consume failures the stream path scoped to single requests
+        (``sink_errors="request"``) and retire them FAILED: a RUNNING
+        request's lane is reclaimed, a just-retired DONE flips FAILED
+        post-hoc (the same one-window lag discipline as the finite
+        check) — co-batched requests are untouched either way."""
+        if not self._sink_failures:
+            return
+        with self._sink_fail_lock:
+            failures, self._sink_failures = self._sink_failures, {}
+        for rid, e in failures.items():
+            t = self.tickets.get(rid)
+            if t is None:
+                continue
+            if t.status == QUEUED:
+                # a device quarantine re-queued the ticket between the
+                # failure and this sweep: the failed sink belonged to
+                # the dead incarnation and the re-run streams afresh —
+                # the stale failure is void
+                continue
+            t.error = (
+                f"sink failure: {type(e).__name__}: {e} — the "
+                f"request's result stream is torn and the request "
+                f"failed; co-batched requests are unaffected; "
+                f"{t.stage_note()}"
+            )
+            self._metrics.inc("sink_failed")
+            self.trace.instant(
+                "sink.failed", rid=rid, tick=self._ticks,
+            )
+            if t.status == RUNNING:
+                shard = (
+                    self.buckets[t.request.composite].shards[t.shard]
+                    if t.shard is not None
+                    else None
+                )
+                if (
+                    shard is not None
+                    and t.lane is not None
+                    and shard.assignments.get(t.lane) is t
+                ):
+                    shard.pool.release(t.lane)
+                    del shard.assignments[t.lane]
+                self._finish(t, FAILED)
+                self._metrics.inc("failed")
+            elif t.status == DONE:
+                # retired before its final window's append landed:
+                # flip post-hoc, drop any held snapshot of a request
+                # whose stream the client can never trust
+                t.status = FAILED
+                self._metrics.inc("failed")
+                if t.held_key is not None:
+                    key, t.held_key = t.held_key, None
+                    self._metrics.inc(
+                        "snapshot_evictions",
+                        self.snapshots.release(key),
+                    )
+                    if (
+                        key in self.snapshots
+                        and self.snapshots.refs(key) == 0
+                    ):
+                        self.snapshots.drop(key)
+                if self._wal is not None and not t.internal:
+                    self._wal.append({
+                        "event": RETIRE,
+                        "rid": t.request_id,
+                        "status": FAILED,
+                        "error": t.error,
+                        "steps": t.steps_done,
+                    }, shard=t.shard or 0)
+            # other terminal states (cancelled/expired raced the
+            # failure): keep the terminal status; the error string
+            # still marks the records as torn
 
     def _completion_cb(self, t: Ticket):
         """Completion bookkeeping for a pipelined DONE request, run by
@@ -2362,7 +2578,7 @@ class SimServer:
                     self._metrics.inc("failed")
         sink = self._results.get(t.request_id)
         pipelined_done = self._streamer is not None and status == DONE
-        if sink is not None:
+        if sink is not None and not t.sink_closed:
             if self._streamer is None:
                 sink.close()
                 self._mark_streamed(t)
@@ -2377,7 +2593,15 @@ class SimServer:
                     if ev is not None:
                         ev.set()
 
-                self._streamer.submit_close(sink, on_close=closed)
+                self._streamer.submit_close(
+                    sink,
+                    on_close=closed,
+                    on_error=(
+                        self._sink_error_cb(t)
+                        if self.sink_errors == "request"
+                        else None
+                    ),
+                )
             # pipelined DONE: the retiring window's LaneSlice carries
             # close_after, keeping append->close order per request
         if t.admitted_at is not None and not pipelined_done \
@@ -2602,6 +2826,12 @@ class SimServer:
             return
         self._closed = True
         first_error: Optional[BaseException] = None
+        try:
+            # scoped sink failures still parked flip their requests
+            # FAILED before the meta/timing table is written
+            self._sweep_sink_failures()
+        except BaseException as e:
+            first_error = e
         # fail coalesced-prefix waiters FIRST, with the cause: their
         # shared prefix run will never land now, and a queued fork
         # left QUEUED forever would read as "still pending" to any
@@ -2654,6 +2884,9 @@ class SimServer:
             first_error = first_error or e
         if self.out_dir:
             try:
+                # failures parked during the streamer's final drain
+                # must flip their tickets before the table is written
+                self._sweep_sink_failures()
                 self._refresh_gauges()
                 write_server_meta(
                     self.out_dir,

@@ -111,6 +111,10 @@ class LaneSlice:
     # (the scheduler hangs request-completion bookkeeping here so a
     # pipelined request's latency is measured when its records are
     # actually available, not when bookkeeping ran ahead)
+    on_error: Optional[Any] = None        # sink failure scoped to THIS
+    # request (the server's sink_errors="request" policy): called with
+    # the exception instead of poisoning the whole stream pipe; the
+    # slice's close/on_close are skipped (the handler owns cleanup)
 
 
 @dataclass
@@ -138,21 +142,31 @@ def process_window(
     ``FaultPlan``) arms the ``sink.append`` io_error seam on both
     paths."""
     for s in slices:
-        if s.idx is not None:
-            if faults:
-                faults.io_error("sink.append", s.request_id)
-            source = host
-            if s.paths:
-                source = filter_paths(host, s.paths)
-            if source:
-                tree = jax.tree.map(
-                    lambda leaf: np.asarray(leaf)[s.idx, s.lane], source
-                )
-                s.sink.append(tree, s.times)
-        if s.close_after:
-            s.sink.close()
-        if s.on_close is not None:
-            s.on_close()
+        try:
+            if s.idx is not None:
+                if faults:
+                    faults.io_error("sink.append", s.request_id)
+                source = host
+                if s.paths:
+                    source = filter_paths(host, s.paths)
+                if source:
+                    tree = jax.tree.map(
+                        lambda leaf: np.asarray(leaf)[s.idx, s.lane],
+                        source,
+                    )
+                    s.sink.append(tree, s.times)
+            if s.close_after:
+                s.sink.close()
+            if s.on_close is not None:
+                s.on_close()
+        except Exception as e:
+            if s.on_error is None:
+                raise  # sink_errors="fatal": park on the stream pipe
+            # sink_errors="request": the failure is THIS request's
+            # alone — hand it to the server's per-request handler and
+            # keep streaming the co-batched slices (close/on_close are
+            # skipped; the handler owns the sink's cleanup)
+            s.on_error(e)
 
 
 class Streamer:
@@ -273,15 +287,20 @@ class Streamer:
             self._cond.notify_all()
         return stalled
 
-    def submit_close(self, sink: Any, on_close: Any = None) -> None:
+    def submit_close(
+        self, sink: Any, on_close: Any = None, on_error: Any = None
+    ) -> None:
         """Queue a sink close behind everything already queued (a
         cancelled/expired request's ordered shutdown). ``on_close``
-        runs after the close — completion signalling."""
+        runs after the close — completion signalling; ``on_error``
+        scopes a close failure to the owning request (the server's
+        ``sink_errors="request"`` policy)."""
         self.submit(
             WindowItem(
                 traj=None,
                 slices=[LaneSlice(
-                    "", sink, close_after=True, on_close=on_close
+                    "", sink, close_after=True, on_close=on_close,
+                    on_error=on_error,
                 )],
             )
         )

@@ -120,6 +120,49 @@ class TestParsing:
                  "--check-finite", "sometimes"]
             )
 
+    def test_serve_sink_errors_arg(self):
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json"]
+        )
+        assert args.sink_errors == "fatal"  # round-14 default
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json",
+             "--sink-errors", "request"]
+        )
+        assert args.sink_errors == "request"
+        with pytest.raises(SystemExit):  # only fatal|request
+            _build_parser().parse_args(
+                ["serve", "--requests", "r.json",
+                 "--sink-errors", "shrug"]
+            )
+
+    def test_frontdoor_args(self):
+        """Round 15: the HTTP front door subcommand (docs/serving.md,
+        'Front door') — bucket + server knobs shared with serve, plus
+        the HTTP/tenancy flags."""
+        args = _build_parser().parse_args(["frontdoor"])
+        assert args.command == "frontdoor"
+        assert (args.host, args.port) == ("127.0.0.1", 8080)
+        assert args.tenants is None
+        assert args.out_dir == "out/frontdoor"
+        assert args.drain_grace is None
+        # multi-tenant default: sink errors scoped to one request
+        assert args.sink_errors == "request"
+        # the shared serve knobs ride along with their serve defaults
+        assert (args.lanes, args.window, args.queue_depth) == (4, 32, 64)
+        assert (args.pipeline, args.snapshot_budget_mb) == ("on", 256.0)
+        args = _build_parser().parse_args([
+            "frontdoor", "--composite", "minimal_ode", "--port", "0",
+            "--host", "0.0.0.0", "--tenants", "tenants.json",
+            "--lanes", "8", "--mesh", "2", "--drain-grace", "30",
+            "--recover-dir", "out/wal",
+        ])
+        assert args.port == 0
+        assert args.host == "0.0.0.0"
+        assert args.tenants == "tenants.json"
+        assert (args.lanes, args.mesh, args.drain_grace) == (8, 2, 30.0)
+        assert args.recover_dir == "out/wal"
+
     def test_sweep_args(self):
         args = _build_parser().parse_args(
             ["sweep", "--spec", "sweep.json", "--out-dir", "out/s",
@@ -311,6 +354,157 @@ class TestServeEagerValidation:
             run_sweep(spec)
 
 
+class TestFromMappingFieldPaths:
+    """Round-15 satellite: ``ScenarioRequest.from_mapping`` rejects
+    every malformed block with a machine-readable field path (the
+    front door's structured 400 body) — one case per branch. Jax-free:
+    the batcher is plain Python."""
+
+    def _path_of(self, mapping):
+        from lens_tpu.serve.batcher import (
+            RequestValidationError,
+            ScenarioRequest,
+        )
+
+        with pytest.raises(RequestValidationError) as e:
+            ScenarioRequest.from_mapping(mapping)
+        assert str(e.value)  # always a human message too
+        return e.value.path
+
+    def test_unknown_key(self):
+        assert self._path_of({"composite": "c", "horizont": 1.0}) \
+            == "horizont"
+
+    def test_bad_scalar_fields(self):
+        assert self._path_of({"composite": 7}) == "composite"
+        assert self._path_of({"composite": "c", "seed": "x"}) == "seed"
+        assert self._path_of({"composite": "c", "seed": True}) == "seed"
+        assert self._path_of({"composite": "c", "horizon": "soon"}) \
+            == "horizon"
+        assert self._path_of({"composite": "c", "deadline": []}) \
+            == "deadline"
+        assert self._path_of({"composite": "c", "hold_state": 1}) \
+            == "hold_state"
+        assert self._path_of({"composite": "c", "tenant": 5}) \
+            == "tenant"
+        assert self._path_of({"composite": "c", "priority": "vip"}) \
+            == "priority"
+        assert self._path_of({"composite": "c", "overrides": [1]}) \
+            == "overrides"
+        assert self._path_of({"composite": "c", "n_agents": "many"}) \
+            == "n_agents"
+
+    def test_emit_block_branches(self):
+        assert self._path_of({"composite": "c", "emit": "alive"}) \
+            == "emit"
+        assert self._path_of(
+            {"composite": "c", "emit": {"path": ["alive"]}}
+        ) == "emit.path"
+        assert self._path_of(
+            {"composite": "c", "emit": {"every": 0}}
+        ) == "emit.every"
+        assert self._path_of(
+            {"composite": "c", "emit": {"every": "all"}}
+        ) == "emit.every"
+        assert self._path_of(
+            {"composite": "c", "emit": {"paths": "alive"}}
+        ) == "emit.paths"
+        assert self._path_of(
+            {"composite": "c", "emit": {"paths": [1, 2]}}
+        ) == "emit.paths"
+
+    def test_prefix_block_branches(self):
+        assert self._path_of({"composite": "c", "prefix": 4.0}) \
+            == "prefix"
+        assert self._path_of(
+            {"composite": "c", "prefix": {"horizont": 4.0}}
+        ) == "prefix.horizont"
+        assert self._path_of({"composite": "c", "prefix": {}}) \
+            == "prefix.horizon"
+        assert self._path_of(
+            {"composite": "c", "prefix": {"horizon": "early"}}
+        ) == "prefix.horizon"
+        assert self._path_of(
+            {"composite": "c",
+             "prefix": {"horizon": 4.0, "overrides": [1]}}
+        ) == "prefix.overrides"
+
+    def test_valid_mapping_roundtrips(self):
+        from lens_tpu.serve.batcher import ScenarioRequest
+
+        r = ScenarioRequest.from_mapping({
+            "composite": "c", "seed": 3, "horizon": 8.0,
+            "emit": {"paths": ["alive"], "every": 2},
+            "prefix": {"horizon": 4.0, "overrides": {}},
+            "tenant": "acme", "priority": "interactive",
+        })
+        assert (r.tenant, r.priority) == ("acme", "interactive")
+
+
+class TestServeDrain:
+    """Round-15 satellite: SIGTERM on a mid-flight ``serve`` drains —
+    stops accepting list entries, finishes in-flight requests, closes
+    streamer/sinks cleanly and writes server_meta.json — instead of
+    relying on crash recovery. Pinned with a real subprocess kill."""
+
+    def test_sigterm_drains_cleanly(self, tmp_path):
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps(
+            [{"seed": i, "horizon": 400.0} for i in range(30)]
+        ))
+        out = tmp_path / "served"
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lens_tpu", "serve",
+             "--composite", "minimal_ode", "--capacity", "4",
+             "--lanes", "2", "--window", "4", "--queue-depth", "4",
+             "--requests", str(reqs), "--out-dir", str(out)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        # signal once the server is demonstrably mid-flight (first
+        # result log exists), while most of the list is unsubmitted
+        # behind the depth-4 queue
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if out.exists() and any(
+                f.suffix == ".lens" for f in out.iterdir()
+            ):
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"serve exited early:\n{proc.stdout.read()}"
+                )
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            raise AssertionError("server never started serving")
+        proc.send_signal(signal.SIGTERM)
+        stdout, _ = proc.communicate(timeout=180)
+        assert proc.returncode == 0, stdout
+        assert "drain: caught signal" in stdout
+        assert "drain: stopped accepting" in stdout
+        assert "never submitted" in stdout
+        assert "served" in stdout
+        # clean close: the meta sidecar landed and every submitted
+        # request has its log; the unsubmitted tail has none
+        assert (out / "server_meta.json").exists(), stdout
+        with open(out / "server_meta.json") as f:
+            meta = json.load(f)
+        submitted = meta["counters"]["submitted"]
+        assert 0 < submitted < 30
+        assert meta["counters"]["retired"] == submitted
+        lens = [f for f in out.iterdir() if f.suffix == ".lens"]
+        assert len(lens) == submitted
+
+
 class TestServeRecoveryFlags:
     def test_serve_writes_wal_when_recover_dir_given(
         self, tmp_path, capsys
@@ -343,6 +537,87 @@ class TestServeRecoveryFlags:
         printed = capsys.readouterr().out
         assert "recovered 1 request(s)" in printed
         assert "done=1" in printed
+
+
+class TestFrontDoorCommand:
+    """``python -m lens_tpu frontdoor``: end-to-end subprocess smoke —
+    serve over HTTP, then SIGTERM drains gracefully (exit 0, meta
+    written, per-tenant summary printed)."""
+
+    def test_frontdoor_smoke_with_sigterm_drain(self, tmp_path):
+        import http.client
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        out = tmp_path / "fd_out"
+        tenants = tmp_path / "tenants.json"
+        tenants.write_text(json.dumps({"tenants": [
+            {"name": "acme", "api_key": "ak", "weight": 2.0},
+            {"name": "pub"},
+        ]}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lens_tpu", "frontdoor",
+             "--composite", "minimal_ode", "--capacity", "4",
+             "--lanes", "2", "--window", "4", "--port", "0",
+             "--tenants", str(tenants), "--out-dir", str(out)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+        try:
+            port = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if "listening on" in line:
+                    port = int(line.rsplit(":", 1)[1])
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"frontdoor exited early:\n"
+                        f"{line}{proc.stdout.read()}"
+                    )
+            assert port, "never printed the listen port"
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=60
+            )
+            conn.request(
+                "POST", "/v1/requests",
+                body=json.dumps({"seed": 3, "horizon": 8.0}),
+                headers={"Authorization": "Bearer ak"},
+            )
+            r = conn.getresponse()
+            sub = json.loads(r.read())
+            assert r.status == 202 and sub["tenant"] == "acme"
+            rid = sub["rid"]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                conn.request(
+                    "GET", f"/v1/requests/{rid}",
+                    headers={"Authorization": "Bearer ak"},
+                )
+                st = json.loads(conn.getresponse().read())
+                if st["status"] == "done":
+                    break
+                time.sleep(0.05)
+            assert st["status"] == "done", st
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            assert "acme" in health["frontdoor"]["tenants"]
+            conn.close()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            stdout, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, stdout
+        assert "drain: caught signal" in stdout
+        assert "drained: submitted=1" in stdout
+        assert "tenant acme: admitted=1" in stdout
+        assert (out / "server_meta.json").exists()
+        with open(out / "server_meta.json") as f:
+            meta = json.load(f)
+        assert meta["tenants"]["acme"]["admitted"] == 1
 
 
 class TestSweepCommand:

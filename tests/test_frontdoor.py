@@ -1,0 +1,818 @@
+"""The multi-tenant HTTP front door (lens_tpu.frontdoor).
+
+Four contract families (docs/serving.md, "Front door"):
+
+- **Tenant policy is plain Python**: WDRR weights, strict
+  interactive-over-batch ordering, token buckets, quotas, and the
+  priority-aware serve queue are pinned deterministically with fake
+  clocks and no sockets.
+- **HTTP semantics**: submit/status/stream/cancel round trips, 400
+  bodies carrying machine-readable field paths, 401/403/404 tenancy
+  isolation, 429 + Retry-After honored by a retrying client, 503
+  while draining.
+- **Bytes**: an SSE record stream's decoded frames are BYTE-IDENTICAL
+  to the request's ``.lens`` log — including the stochastic composite
+  on a 2-device mesh with the pipeline on (the serving determinism
+  contract surviving the hop over HTTP).
+- **Fairness**: a flooding tenant cannot stall the interactive class
+  beyond a bounded number of windows (starvation-freedom), pinned
+  both at the scheduler level and end-to-end over HTTP.
+"""
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lens_tpu.frontdoor import (
+    AuthError,
+    Authenticator,
+    Entry,
+    FrontDoor,
+    TenantConfig,
+    TenantQueueFull,
+    TenantScheduler,
+    TokenBucket,
+    decode_record_events,
+    load_tenants,
+)
+from lens_tpu.serve import (
+    INTERACTIVE,
+    ScenarioRequest,
+    SimServer,
+)
+from lens_tpu.serve.batcher import RequestQueue, Ticket
+
+
+def _entry(rid, tenant, priority="batch", request=None):
+    return Entry(rid=rid, tenant=tenant, priority=priority,
+                 request=request)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# tenant policy (jax-free, deterministic)
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_rate(self):
+        clock = _Clock()
+        b = TokenBucket(rate=2.0, burst=2, clock=clock)
+        assert b.take() == 0.0
+        assert b.take() == 0.0
+        wait = b.take()
+        assert wait == pytest.approx(0.5)
+        clock.t += 0.5
+        assert b.take() == 0.0
+
+    def test_refill_caps_at_burst(self):
+        clock = _Clock()
+        b = TokenBucket(rate=10.0, burst=3, clock=clock)
+        clock.t += 100.0
+        for _ in range(3):
+            assert b.take() == 0.0
+        assert b.take() > 0.0
+
+
+class TestTenantScheduler:
+    def _sched(self, **tenants):
+        table = {
+            name: TenantConfig(name=name, **cfg)
+            for name, cfg in tenants.items()
+        }
+        return TenantScheduler(table, clock=_Clock())
+
+    def test_wdrr_respects_weights(self):
+        s = self._sched(a={"weight": 2.0}, b={"weight": 1.0})
+        for i in range(12):
+            s.push(_entry(f"a{i}", "a"))
+            s.push(_entry(f"b{i}", "b"))
+        first = [s.pop().tenant for _ in range(9)]
+        # 2:1 share for a over any window of the drain
+        assert first.count("a") == 6
+        assert first.count("b") == 3
+
+    def test_interactive_strictly_before_batch(self):
+        s = self._sched(a={}, b={})
+        s.push(_entry("a0", "a", "batch"))
+        s.push(_entry("a1", "a", "batch"))
+        s.push(_entry("b0", "b", INTERACTIVE))
+        order = [s.pop().rid for _ in range(3)]
+        assert order[0] == "b0"  # interactive first despite arriving last
+        assert order[1:] == ["a0", "a1"]
+
+    def test_fifo_within_tenant_class(self):
+        s = self._sched(a={})
+        for i in range(5):
+            s.push(_entry(f"a{i}", "a"))
+        assert [s.pop().rid for _ in range(5)] == \
+            [f"a{i}" for i in range(5)]
+
+    def test_queue_depth_rejects(self):
+        s = self._sched(a={"queue_depth": 2})
+        s.push(_entry("a0", "a"))
+        s.push(_entry("a1", "a"))
+        with pytest.raises(TenantQueueFull) as e:
+            s.push(_entry("a2", "a"), retry_after=1.5)
+        assert e.value.retry_after == 1.5
+        assert e.value.tenant == "a"
+
+    def test_throttle_quota_counts_queued_and_inflight(self):
+        s = self._sched(a={"max_inflight": 2})
+        assert s.throttle("a") == (None, 0.0)
+        s.push(_entry("a0", "a"))
+        s.note_submitted("a")
+        reason, wait = s.throttle("a")
+        assert reason is not None and "quota" in reason
+        s.note_finished("a")
+        s.pop()
+        assert s.throttle("a") == (None, 0.0)
+
+    def test_throttle_rate_limit_hints_retry(self):
+        s = self._sched(a={"rate": 2.0, "burst": 1})
+        assert s.throttle("a") == (None, 0.0)
+        reason, wait = s.throttle("a")
+        assert reason is not None and "rate" in reason
+        assert wait == pytest.approx(0.5)
+
+    def test_push_front_keeps_turn(self):
+        s = self._sched(a={}, b={})
+        s.push(_entry("a0", "a"))
+        s.push(_entry("b0", "b"))
+        e = s.pop()
+        s.push_front(e)
+        assert s.pop().rid == e.rid  # refused by the server: same turn
+
+    def test_cancel_removes_queued(self):
+        s = self._sched(a={})
+        s.push(_entry("a0", "a"))
+        s.push(_entry("a1", "a"))
+        assert s.cancel("a0").rid == "a0"
+        assert s.cancel("a0") is None
+        assert s.pop().rid == "a1"
+
+    def test_flood_cannot_starve_other_tenant(self):
+        """The WDRR bound: with equal weights, a tenant flooding 100
+        requests cannot push the other below every-other-admission."""
+        s = self._sched(flood={}, small={})
+        for i in range(100):
+            s.push(_entry(f"f{i}", "flood"))
+        s.push(_entry("s0", "small"))
+        s.push(_entry("s1", "small"))
+        first4 = [s.pop().tenant for _ in range(4)]
+        assert first4.count("small") == 2
+
+    def test_load_tenants_forms(self, tmp_path):
+        table = load_tenants(
+            {"tenants": [{"name": "a", "weight": 2.0},
+                         {"name": "b", "api_key": "kb"}]}
+        )
+        assert set(table) == {"a", "b"}
+        path = tmp_path / "tenants.json"
+        path.write_text(json.dumps(
+            {"tenants": [{"name": "x", "rate": 5.0}]}
+        ))
+        assert load_tenants(str(path))["x"].rate == 5.0
+        # inline JSON (the CLI --tenants form) works without a file
+        inline = load_tenants(
+            '{"tenants": [{"name": "inline", "weight": 3.0}]}'
+        )
+        assert inline["inline"].weight == 3.0
+        with pytest.raises(ValueError, match="duplicate tenant"):
+            load_tenants([{"name": "a"}, {"name": "a"}])
+        with pytest.raises(ValueError, match="share an api_key"):
+            load_tenants([{"name": "a", "api_key": "k"},
+                          {"name": "b", "api_key": "k"}])
+        with pytest.raises(ValueError, match="unknown keys"):
+            load_tenants([{"name": "a", "weigth": 1.0}])
+
+
+class TestAuthenticator:
+    def _auth(self):
+        return Authenticator({
+            "keyed": TenantConfig(name="keyed", api_key="secret"),
+            "open": TenantConfig(name="open"),
+        })
+
+    def test_bearer_key_resolves(self):
+        a = self._auth()
+        cfg = a.resolve({"authorization": "Bearer secret"})
+        assert cfg.name == "keyed"
+        cfg = a.resolve({"x-api-key": "secret"})
+        assert cfg.name == "keyed"
+
+    def test_unknown_key_401(self):
+        with pytest.raises(AuthError) as e:
+            self._auth().resolve({"authorization": "Bearer nope"})
+        assert e.value.status == 401
+
+    def test_open_tenant_by_name(self):
+        assert self._auth().resolve({"x-tenant": "open"}).name == "open"
+
+    def test_keyed_tenant_needs_its_key(self):
+        with pytest.raises(AuthError) as e:
+            self._auth().resolve({"x-tenant": "keyed"})
+        assert e.value.status == 403
+
+    def test_key_for_other_tenant_403(self):
+        with pytest.raises(AuthError) as e:
+            self._auth().resolve({
+                "authorization": "Bearer secret", "x-tenant": "open",
+            })
+        assert e.value.status == 403
+
+    def test_no_credentials_single_open_tenant(self):
+        # exactly one open tenant = the anonymous tier
+        assert self._auth().resolve({}).name == "open"
+        two_open = Authenticator({
+            "a": TenantConfig(name="a"),
+            "b": TenantConfig(name="b"),
+        })
+        with pytest.raises(AuthError) as e:
+            two_open.resolve({})  # ambiguous: must name one
+        assert e.value.status == 401
+        keyed_only = Authenticator({
+            "k": TenantConfig(name="k", api_key="kk"),
+        })
+        with pytest.raises(AuthError) as e:
+            keyed_only.resolve({})
+        assert e.value.status == 401
+
+
+class TestPriorityQueue:
+    """The serve-side half of the priority lane: RequestQueue.take
+    admits interactive ahead of batch, FIFO within a class, and an
+    all-default queue is the round-14 FIFO pass bit for bit."""
+
+    def _tickets(self, specs):
+        return [
+            Ticket(rid, ScenarioRequest("c", priority=prio))
+            for rid, prio in specs
+        ]
+
+    def test_interactive_admitted_first(self):
+        q = RequestQueue(10)
+        for t in self._tickets(
+            [("b0", "batch"), ("b1", "batch"), ("i0", INTERACTIVE)]
+        ):
+            q.push(t, 0.0)
+        taken = q.take(lambda t: "c", {"c": 2})
+        assert [t.request_id for t in taken] == ["i0", "b0"]
+        assert [t.request_id for t in q] == ["b1"]
+
+    def test_default_stream_is_fifo(self):
+        q = RequestQueue(10)
+        for t in self._tickets([(f"r{i}", "batch") for i in range(6)]):
+            q.push(t, 0.0)
+        taken = q.take(lambda t: "c", {"c": 4})
+        assert [t.request_id for t in taken] == \
+            ["r0", "r1", "r2", "r3"]
+        assert [t.request_id for t in q] == ["r4", "r5"]
+
+    def test_skipped_interactive_keeps_position(self):
+        q = RequestQueue(10)
+        i0, b0 = self._tickets([("i0", INTERACTIVE), ("b0", "batch")])
+        i0.waiting = True  # a fork waiting on its prefix
+        q.push(i0, 0.0)
+        q.push(b0, 0.0)
+        taken = q.take(
+            lambda t: "c", {"c": 2}, ready=lambda t: not t.waiting
+        )
+        assert [t.request_id for t in taken] == ["b0"]
+        assert [t.request_id for t in q] == ["i0"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration
+# ---------------------------------------------------------------------------
+
+_TENANTS = [
+    {"name": "acme", "api_key": "acme-key", "weight": 2.0},
+    {"name": "beta", "api_key": "beta-key", "weight": 1.0},
+    {"name": "limited", "api_key": "lim-key", "rate": 1.0,
+     "burst": 1, "max_inflight": 3, "queue_depth": 4},
+]
+
+
+class _Client:
+    """Tiny keep-alive HTTP client for the tests."""
+
+    def __init__(self, port, key=None, tenant=None):
+        self.conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=60
+        )
+        self.headers = {}
+        if key:
+            self.headers["Authorization"] = f"Bearer {key}"
+        if tenant:
+            self.headers["X-Tenant"] = tenant
+
+    def request(self, method, path, body=None):
+        self.conn.request(
+            method, path,
+            body=json.dumps(body) if body is not None else None,
+            headers=self.headers,
+        )
+        r = self.conn.getresponse()
+        raw = r.read()
+        try:
+            payload = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            payload = raw
+        return r.status, payload, dict(r.getheaders())
+
+    def submit(self, body):
+        return self.request("POST", "/v1/requests", body)
+
+    def wait(self, rid, statuses=("done",), timeout=60.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            code, st, _ = self.request("GET", f"/v1/requests/{rid}")
+            assert code == 200, st
+            if st["status"] in statuses:
+                return st
+            time.sleep(0.02)
+        raise AssertionError(
+            f"{rid} never reached {statuses}; last: {st}"
+        )
+
+    def stream(self, rid):
+        """Read one whole SSE stream body (through the end event)."""
+        self.conn.request(
+            "GET", f"/v1/requests/{rid}/stream", headers=self.headers
+        )
+        r = self.conn.getresponse()
+        assert r.status == 200
+        body = r.read()  # http.client de-chunks to EOF-of-stream
+        return decode_record_events(body)
+
+    def close(self):
+        self.conn.close()
+
+
+@pytest.fixture(scope="module")
+def door(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("frontdoor_out"))
+    server = SimServer.single_bucket(
+        "minimal_ode", capacity=4, lanes=2, window=4,
+        sink="log", out_dir=out, sink_errors="request",
+    )
+    fd = FrontDoor(server, tenants=_TENANTS, own_server=True)
+    fd.start()
+    yield fd
+    fd.close()
+
+
+class TestFrontDoorHTTP:
+    def test_submit_status_stream_roundtrip(self, door):
+        c = _Client(door.port, key="acme-key")
+        code, sub, _ = c.submit({"seed": 11, "horizon": 8.0})
+        assert code == 202 and sub["tenant"] == "acme"
+        rid = sub["rid"]
+        st = c.wait(rid)
+        assert st["steps_done"] == 8
+        assert st["timing"]["admitted"] is not None
+        assert st["timing"]["last_streamed"] is not None
+        raw, end = c.stream(rid)
+        assert end["status"] == "done" and end["error"] is None
+        with open(os.path.join(door.server.out_dir, f"{rid}.lens"),
+                  "rb") as f:
+            assert raw == f.read()  # SSE bytes == log file, bitwise
+        c.close()
+
+    def test_validation_error_carries_field_path(self, door):
+        c = _Client(door.port, key="acme-key")
+        cases = [
+            ({"seed": 1, "horizon": 8.0, "emit": {"every": 0}},
+             "emit.every"),
+            ({"seed": 1, "horizon": 8.0, "emit": {"path": []}},
+             "emit.path"),
+            ({"seed": 1, "horizon": 8.0, "prefix": {}},
+             "prefix.horizon"),
+            ({"seed": 1, "horizon": 7.3}, "horizon"),
+            ({"seed": 1, "horizon": 8.0, "priority": "urgent"},
+             "priority"),
+            ({"seed": 1, "horizon": 8.0,
+              "overrides": {"cell": {"nope": 1.0}}}, "overrides"),
+            ({"seed": 1, "horizont": 8.0}, "horizont"),
+        ]
+        for body, path in cases:
+            code, err, _ = c.submit(body)
+            assert code == 400, (body, err)
+            assert err["path"] == path, (body, err)
+            assert err["error"]
+        c.close()
+
+    def test_auth_and_tenant_isolation(self, door):
+        anon = _Client(door.port)
+        code, err, _ = anon.submit({"seed": 1, "horizon": 8.0})
+        assert code == 401
+        wrong = _Client(door.port, key="wrong-key")
+        code, err, _ = wrong.submit({"seed": 1, "horizon": 8.0})
+        assert code == 401
+        acme = _Client(door.port, key="acme-key")
+        code, err, _ = acme.submit(
+            {"seed": 1, "horizon": 8.0, "tenant": "beta"}
+        )
+        assert code == 403  # cannot submit as someone else
+        code, sub, _ = acme.submit({"seed": 12, "horizon": 8.0})
+        assert code == 202
+        rid = sub["rid"]
+        beta = _Client(door.port, key="beta-key")
+        code, _err, _ = beta.request("GET", f"/v1/requests/{rid}")
+        assert code == 404  # foreign rids are invisible, not 403
+        code, _err, _ = beta.request("DELETE", f"/v1/requests/{rid}")
+        assert code == 404
+        acme.wait(rid)
+        for c in (anon, wrong, acme, beta):
+            c.close()
+
+    def test_unknown_rid_and_route(self, door):
+        c = _Client(door.port, key="acme-key")
+        code, _, _ = c.request("GET", "/v1/requests/req-999999")
+        assert code == 404
+        code, _, _ = c.request("GET", "/v2/nope")
+        assert code == 404
+        code, _, _ = c.request("PUT", "/v1/requests/req-000000")
+        assert code == 405
+        c.close()
+
+    def test_429_retry_after_honored_by_retrying_client(self, door):
+        """The throttle contract end to end: a burst past the token
+        bucket gets 429 + Retry-After; sleeping the hinted time and
+        retrying succeeds (the 'healthy client' loop)."""
+        c = _Client(door.port, key="lim-key")
+        results = []
+        for i in range(3):
+            results.append(c.submit({"seed": 100 + i, "horizon": 4.0}))
+        codes = [code for code, _, _ in results]
+        assert 429 in codes, codes  # burst=1: the follow-ups throttle
+        throttled = next(
+            (payload, headers)
+            for code, payload, headers in results if code == 429
+        )
+        payload, headers = throttled
+        assert payload["tenant"] == "limited"
+        retry_after = float(headers["Retry-After"])
+        assert retry_after > 0
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            time.sleep(retry_after)
+            code, sub, headers = c.submit(
+                {"seed": 103, "horizon": 4.0}
+            )
+            if code == 202:
+                break
+            assert code == 429
+            retry_after = float(headers["Retry-After"])
+        assert code == 202  # the retrying client got through
+        for _, payload, _ in results:
+            if isinstance(payload, dict) and "rid" in payload:
+                c.wait(payload["rid"])
+        c.wait(sub["rid"])
+        c.close()
+
+    def test_tenant_counters_surface_everywhere(self, door):
+        """Satellite: per-tenant admitted/rejected/throttled/
+        streamed_bytes in metrics()/status()/prometheus."""
+        snap = door.server.metrics()
+        assert "acme" in snap["tenants"]
+        row = snap["tenants"]["acme"]
+        assert row["admitted"] >= 1
+        assert row["streamed_bytes"] > 0  # the roundtrip test streamed
+        assert snap["tenants"]["limited"]["throttled"] >= 1
+        c = _Client(door.port, key="acme-key")
+        code, text, _ = c.request("GET", "/metrics")
+        text = text.decode() if isinstance(text, bytes) else str(text)
+        assert 'lens_serve_tenant_admitted_total{tenant="acme"}' in text
+        assert 'lens_serve_tenant_throttled_total{tenant="limited"}' \
+            in text
+        code, status, _ = c.request("GET", "/v1/status")
+        assert code == 200
+        assert status["frontdoor"]["tenants"]["acme"]["weight"] == 2.0
+        code, health, _ = c.request("GET", "/healthz")
+        assert code == 200 and health["status"] == "ok"
+        assert health["lanes_total"] == 2
+        c.close()
+
+    def test_cancel_mid_stream(self, door):
+        """Open a stream on a long request, cancel it mid-flight: the
+        stream terminates with an end event carrying the cancelled
+        status, and the lane is reclaimed."""
+        c = _Client(door.port, key="acme-key")
+        code, sub, _ = c.submit({"seed": 21, "horizon": 400.0})
+        assert code == 202
+        rid = sub["rid"]
+        got = {}
+
+        def read_stream():
+            s = _Client(door.port, key="acme-key")
+            try:
+                got["raw"], got["end"] = s.stream(rid)
+            finally:
+                s.close()
+
+        reader = threading.Thread(target=read_stream)
+        reader.start()
+        # wait until the request is actually running, then cancel
+        c.wait(rid, statuses=("running",))
+        code, out, _ = c.request("DELETE", f"/v1/requests/{rid}")
+        assert code == 200
+        reader.join(timeout=60)
+        assert not reader.is_alive(), "stream never terminated"
+        assert got["end"]["status"] == "cancelled"
+        st = c.wait(rid, statuses=("cancelled",))
+        # partial records stream byte-identically too
+        path = os.path.join(door.server.out_dir, f"{rid}.lens")
+        with open(path, "rb") as f:
+            assert got["raw"] == f.read()
+        c.close()
+
+    def test_cancel_while_queued_at_front_door(self, tmp_path):
+        """A rid still waiting in the tenant scheduler (server queue
+        full behind a long run) cancels at the door without ever
+        touching the server, and its stream ends with the cancelled
+        status."""
+        out = str(tmp_path / "door_queue_out")
+        server = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=1, window=4,
+            sink="log", out_dir=out, queue_depth=1,
+        )
+        fd = FrontDoor(server, own_server=True).start()
+        try:
+            c = _Client(fd.port)
+            rids = []
+            for i in range(4):
+                code, sub, _ = c.submit(
+                    {"seed": i, "horizon": 400.0}
+                )
+                assert code == 202
+                rids.append(sub["rid"])
+            # 1 lane + server queue depth 1: the tail rids are still
+            # at the front door (a 400-step run holds the lane)
+            code, st, _ = c.request("GET", f"/v1/requests/{rids[-1]}")
+            assert st["status"] == "queued"
+            assert st.get("stage") == "frontdoor"
+            code, out_p, _ = c.request(
+                "DELETE", f"/v1/requests/{rids[-1]}"
+            )
+            assert code == 200 and out_p["status"] == "cancelled"
+            assert rids[-1] not in server.tickets  # never submitted
+            raw, end = c.stream(rids[-1])
+            assert end["status"] == "cancelled" and raw == b""
+            for rid in rids[:-1]:
+                c.request("DELETE", f"/v1/requests/{rid}")
+            for rid in rids[:-1]:
+                c.wait(rid, statuses=("cancelled", "done"))
+            c.close()
+        finally:
+            fd.close()
+
+    def test_draining_returns_503_with_retry_after(self, door):
+        door._draining = True
+        try:
+            c = _Client(door.port, key="acme-key")
+            code, err, headers = c.submit({"seed": 41, "horizon": 8.0})
+            assert code == 503
+            assert float(headers["Retry-After"]) > 0
+            code, health, _ = c.request("GET", "/healthz")
+            assert code == 503 and health["status"] == "draining"
+            c.close()
+        finally:
+            door._draining = False
+
+    def test_requires_log_sink(self):
+        srv = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=1, window=4
+        )
+        try:
+            with pytest.raises(ValueError, match="sink='log'"):
+                FrontDoor(srv)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fairness end to end
+# ---------------------------------------------------------------------------
+
+
+class TestFairness:
+    def test_interactive_class_not_starved_by_flood(
+        self, tmp_path
+    ):
+        """Starvation-freedom, end to end over HTTP: tenant 'flood'
+        back-fills the server with batch work; tenant 'fast' then
+        submits interactive requests. Every interactive request must
+        be admitted ahead of the still-queued flood (bounded by
+        lanes-in-flight, not by the flood's backlog)."""
+        out = str(tmp_path / "fair_out")
+        server = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4,
+            sink="log", out_dir=out, queue_depth=64,
+        )
+        fd = FrontDoor(
+            server,
+            tenants=[
+                {"name": "flood", "api_key": "fk"},
+                {"name": "fast", "api_key": "ik"},
+            ],
+            own_server=True,
+        ).start()
+        try:
+            flood = _Client(fd.port, key="fk")
+            fast = _Client(fd.port, key="ik")
+            flood_rids = []
+            for i in range(24):
+                code, sub, _ = flood.submit(
+                    {"seed": i, "horizon": 64.0}
+                )
+                assert code == 202
+                flood_rids.append(sub["rid"])
+            fast_rids = []
+            for i in range(3):
+                code, sub, _ = fast.submit(
+                    {"seed": 100 + i, "horizon": 8.0,
+                     "priority": "interactive"}
+                )
+                assert code == 202
+                fast_rids.append(sub["rid"])
+            for rid in fast_rids + flood_rids:
+                (fast if rid in fast_rids else flood).wait(
+                    rid, timeout=300
+                )
+            # admission stamps tell the story: every interactive
+            # request must hit a lane before the flood's tail (the
+            # flood holds ~24 x 16 windows of work across 2 lanes;
+            # the interactive class may wait out at most the runs
+            # already ON a lane, never the queued backlog)
+            admitted = {
+                rid: server.tickets[rid].admitted_at
+                for rid in flood_rids + fast_rids
+            }
+            flood_order = sorted(
+                admitted[rid] for rid in flood_rids
+            )
+            worst_fast = max(admitted[rid] for rid in fast_rids)
+            assert worst_fast < flood_order[12], (
+                "interactive requests were admitted behind the "
+                "flooding tenant's backlog"
+            )
+            # and the flood still made progress afterwards (no
+            # reverse starvation)
+            assert all(
+                server.tickets[rid].status == "done"
+                for rid in flood_rids
+            )
+            flood.close()
+            fast.close()
+        finally:
+            fd.close()
+
+
+# ---------------------------------------------------------------------------
+# bytes under stress: stochastic composite, pipeline on, mesh=2
+# ---------------------------------------------------------------------------
+
+
+class TestStreamBytesStochastic:
+    def test_sse_equals_log_bitwise_stochastic_mesh(self, tmp_path):
+        """The headline byte pin from the issue: a stochastic
+        composite (hybrid_cell: tau-leap Gillespie), pipeline on,
+        mesh=2 — the SSE-fetched record stream of every request is
+        byte-identical to its on-disk log."""
+        out = str(tmp_path / "mesh_out")
+        server = SimServer.single_bucket(
+            "hybrid_cell", capacity=16, lanes=2, window=8,
+            sink="log", out_dir=out, pipeline="on", mesh=2,
+        )
+        fd = FrontDoor(server, own_server=True).start()
+        try:
+            c = _Client(fd.port)
+            rids = []
+            for seed in (3, 5, 9):
+                code, sub, _ = c.submit(
+                    {"seed": seed, "horizon": 16.0}
+                )
+                assert code == 202
+                rids.append(sub["rid"])
+            for rid in rids:
+                c.wait(rid, timeout=180)
+            for rid in rids:
+                raw, end = c.stream(rid)
+                assert end["status"] == "done"
+                with open(os.path.join(out, f"{rid}.lens"),
+                          "rb") as f:
+                    disk = f.read()
+                assert raw == disk, f"{rid}: SSE bytes != log bytes"
+                assert len(raw) > 0
+            c.close()
+        finally:
+            fd.close()
+
+
+# ---------------------------------------------------------------------------
+# scoped sink failures (the chaos-row prerequisite)
+# ---------------------------------------------------------------------------
+
+
+class TestSinkErrorScoping:
+    def test_request_scoped_sink_error_fails_one_request(
+        self, tmp_path
+    ):
+        """sink_errors='request': an injected io_error on one
+        request's sink fails THAT request (FAILED, error recorded)
+        while its co-batched neighbour completes and the server stays
+        healthy — the multi-tenant front-door policy. (The default
+        'fatal' contract is pinned in tests/test_faults.py.)"""
+        from lens_tpu.serve import FaultPlan
+
+        plan = FaultPlan(
+            [{"kind": "io_error", "request": "req-000000"}]
+        )
+        out = str(tmp_path / "sink_out")
+        srv = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4,
+            sink="log", out_dir=out, faults=plan,
+            sink_errors="request",
+        )
+        with srv:
+            bad = srv.submit(ScenarioRequest(
+                composite="minimal_ode", seed=1, horizon=16.0,
+            ))
+            good = srv.submit(ScenarioRequest(
+                composite="minimal_ode", seed=2, horizon=16.0,
+            ))
+            srv.run_until_idle(max_ticks=200)
+            assert srv.status(bad)["status"] == "failed"
+            assert "sink failure" in srv.status(bad)["error"]
+            assert srv.status(good)["status"] == "done"
+            # the healthy request's result is intact and complete
+            from lens_tpu.emit.log import read_records
+            recs = list(read_records(srv.result(good)))
+            assert len(recs) >= 2  # header + segments
+            snap = srv.metrics()
+            assert snap["counters"]["sink_failed"] == 1
+
+    def test_stream_of_sink_failed_request_terminates(self, tmp_path):
+        """The torn stream is FINAL: an SSE stream open on a request
+        whose sink failed must end (status failed + the error), not
+        poll forever for appends that can never come — the front-door
+        chaos bench leans on this."""
+        from lens_tpu.serve import FaultPlan
+
+        plan = FaultPlan(
+            [{"kind": "io_error", "request": "req-000000"}]
+        )
+        out = str(tmp_path / "sink_stream_out")
+        srv = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4,
+            sink="log", out_dir=out, faults=plan,
+            sink_errors="request",
+        )
+        fd = FrontDoor(srv, own_server=True).start()
+        try:
+            c = _Client(fd.port)
+            code, sub, _ = c.submit({"seed": 1, "horizon": 16.0})
+            assert code == 202
+            rid = sub["rid"]
+            raw, end = c.stream(rid)  # must terminate
+            assert end["status"] == "failed"
+            assert "sink failure" in end["error"]
+            c.close()
+        finally:
+            fd.close()
+
+    def test_sync_path_scopes_too(self, tmp_path):
+        from lens_tpu.serve import FaultPlan
+
+        plan = FaultPlan(
+            [{"kind": "io_error", "request": "req-000000"}]
+        )
+        out = str(tmp_path / "sink_sync_out")
+        srv = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4,
+            sink="log", out_dir=out, faults=plan,
+            sink_errors="request", pipeline="off",
+        )
+        with srv:
+            bad = srv.submit(ScenarioRequest(
+                composite="minimal_ode", seed=1, horizon=16.0,
+            ))
+            good = srv.submit(ScenarioRequest(
+                composite="minimal_ode", seed=2, horizon=16.0,
+            ))
+            srv.run_until_idle(max_ticks=200)
+            assert srv.status(bad)["status"] == "failed"
+            assert srv.status(good)["status"] == "done"
