@@ -1378,6 +1378,370 @@ def run_frontdoor_bench(args) -> int:
     return 0
 
 
+def _zipf_draws(n, n_prefixes, alpha, rng):
+    """Seeded skewed-popularity prefix indices: P(i) ~ 1/(i+1)^alpha."""
+    import numpy as np
+
+    probs = 1.0 / np.arange(1, n_prefixes + 1) ** alpha
+    probs /= probs.sum()
+    return rng.choice(n_prefixes, size=n, p=probs)
+
+
+def tier_zipf_ab(
+    composite, capacity, lanes, window, emit_every, horizon_steps,
+    prefix_steps, n, n_prefixes, alpha, reps, tmp_root,
+):
+    """Skewed-popularity A/B: the round-11 LRU-only store vs the
+    tiered store, SAME tight device budget (~3.5 snapshots of the
+    ``n_prefixes`` distinct ones in play). Under Zipf traffic the flat
+    store evicts warm prefixes outright and recomputes them on the
+    next repeat; the tiered store demotes them to host/disk and
+    promotes on the hit — so the claim is higher HIT RATE and lower
+    WALL at identical device memory. Traffic arrives in WAVES of one
+    lane-fill each (submit, run to idle, next wave): within one burst
+    every repeat coalesces onto the in-flight run no matter the
+    store, so only waves expose what the CACHE retained. Interleaved
+    min-of-reps on two warmed servers; fresh seed base per rep (no
+    cross-rep cache reuse), identical per-rep workload for both."""
+    import os
+
+    import numpy as np
+
+    servers = {
+        "lru": _make_server(
+            composite, capacity, lanes, window, emit_every,
+            queue_depth=max(4 * n, 64), pipeline="on",
+        ),
+        "tiered": SimServer.single_bucket(
+            composite, capacity=capacity, lanes=lanes, window=window,
+            emit_every=emit_every, queue_depth=max(4 * n, 64),
+            host_budget_mb=0,  # placeholder; set from the probe below
+            tier_dir=os.path.join(tmp_root, f"tier_{lanes}"),
+        ),
+    }
+    for srv in servers.values():
+        _warm(srv, composite, lanes, window)
+        # probe: one prefix+override fork compiles the whole fork
+        # path (fork-admit per override structure, lane capture)
+        # outside timing, and tells us the snapshot's byte size so
+        # the budget can be quoted in ENTRIES (~3.5) instead of MiB
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=999_999,
+            horizon=float(horizon_steps),
+            prefix={"horizon": float(prefix_steps)},
+            overrides={"global": {"volume": 1.5}},
+        ))
+        srv.run_until_idle(max_ticks=10_000)
+        assert srv.status(rid)["status"] == "done"
+    entry_bytes = servers["lru"].metrics()["snapshot_bytes"]
+    assert entry_bytes > 0
+    device_budget = int(3.5 * entry_bytes)
+    for srv in servers.values():
+        srv.snapshots.budget_bytes = device_budget
+    servers["tiered"].snapshots.host_budget_bytes = device_budget
+
+    def round_workload(srv, seed_base, idx):
+        t0 = time.perf_counter()
+        ids = []
+        for w0 in range(0, len(idx), lanes):
+            ids.extend(
+                srv.submit(ScenarioRequest(
+                    composite=composite,
+                    seed=seed_base + int(k),
+                    horizon=float(horizon_steps),
+                    prefix={"horizon": float(prefix_steps)},
+                    overrides={
+                        "global": {"volume": 1.0 + 0.001 * (w0 + i)}
+                    },
+                ))
+                for i, k in enumerate(idx[w0:w0 + lanes])
+            )
+            srv.run_until_idle(max_ticks=100_000)
+        wall = time.perf_counter() - t0
+        assert all(srv.status(r)["status"] == "done" for r in ids)
+        return wall
+
+    base = {
+        mode: srv.metrics()["counters"] for mode, srv in servers.items()
+    }
+    walls = {mode: float("inf") for mode in servers}
+    for rep in range(reps):
+        rng = np.random.default_rng(1234 + rep)
+        idx = _zipf_draws(n, n_prefixes, alpha, rng)
+        seed_base = 10_000 + rep * 1_000
+        for mode, srv in servers.items():
+            walls[mode] = min(
+                walls[mode], round_workload(srv, seed_base, idx)
+            )
+    row = {
+        "lanes": lanes,
+        "n_requests": n,
+        "n_prefixes": n_prefixes,
+        "zipf_alpha": alpha,
+        "horizon_steps": horizon_steps,
+        "prefix_steps": prefix_steps,
+        "device_budget_entries": 3.5,
+        "walls_s": {m: round(w, 4) for m, w in walls.items()},
+        "tiered_over_lru": round(
+            walls["tiered"] / walls["lru"], 4
+        ),
+    }
+    for mode, srv in servers.items():
+        c = srv.metrics()["counters"]
+        misses = c["prefix_misses"] - base[mode]["prefix_misses"]
+        total = reps * n
+        row[f"{mode}_hit_rate"] = round(1.0 - misses / total, 4)
+        row[f"{mode}_misses"] = misses
+    tiers = servers["tiered"].metrics()["snapshot_tiers"]
+    row["tiered_promotions"] = {
+        t: tiers[t]["promotions"] for t in ("host", "disk")
+    }
+    row["retraces"] = max(
+        s.metrics()["retraces"] for s in servers.values()
+    )
+    for srv in servers.values():
+        srv.close()
+    return row
+
+
+def tier_restart(
+    composite, capacity, lanes, window, emit_every, horizon_steps,
+    prefix_steps, n_prefixes, tmp_root,
+):
+    """The durability row: serve a distinct-prefix workload with
+    every snapshot forced to disk (device/host budgets 0), KILL the
+    server (no close — the rename-protocol spills do not care), then
+    rebuild over the same tier dir with a NORMAL device budget and
+    serve the repeat workload: each prefix promotes off disk once
+    (one orbax restore) instead of recomputing (one prefix run). The
+    claim: zero prefix misses, one DISK hit per prefix, and a wall
+    under the cold control's (same repeat workload, fresh tier dir —
+    it must recompute every prefix)."""
+    import os
+
+    def make(tier, force_disk):
+        return SimServer.single_bucket(
+            composite, capacity=capacity, lanes=lanes, window=window,
+            emit_every=emit_every, queue_depth=max(4 * n_prefixes, 64),
+            # force_disk: page everything out immediately (the
+            # population run, so the kill leaves a full disk tier);
+            # serving runs use an unbounded device tier — the honest
+            # shape, where each prefix pages in at most once
+            **(
+                {"snapshot_budget_mb": 0, "host_budget_mb": 0}
+                if force_disk
+                else {"host_budget_mb": 0}
+            ),
+            tier_dir=os.path.join(tmp_root, tier),
+        )
+
+    def warm_fork(srv):
+        # compile the fork path (fork-admit, lane capture, prefix
+        # machinery) outside every timed phase — per SERVER, so no
+        # store mode rides an earlier mode's compile cache
+        rid = srv.submit(ScenarioRequest(
+            composite=composite, seed=999_998,
+            horizon=float(horizon_steps),
+            prefix={"horizon": float(prefix_steps)},
+            overrides={"global": {"volume": 1.5}},
+        ))
+        srv.run_until_idle(max_ticks=10_000)
+        assert srv.status(rid)["status"] == "done"
+
+    def workload(srv, two_forks=True):
+        t0 = time.perf_counter()
+        ids = []
+        for k in range(n_prefixes):
+            for f in range(2 if two_forks else 1):
+                ids.append(srv.submit(ScenarioRequest(
+                    composite=composite, seed=77_000 + k,
+                    horizon=float(horizon_steps),
+                    prefix={"horizon": float(prefix_steps)},
+                    overrides={
+                        "global": {"volume": 1.0 + 0.01 * (f + 1)}
+                    },
+                )))
+        srv.run_until_idle(max_ticks=100_000)
+        wall = time.perf_counter() - t0
+        assert all(srv.status(r)["status"] == "done" for r in ids)
+        return wall
+
+    srv = make("restart_tier", force_disk=True)
+    _warm(srv, composite, lanes, window)
+    warm_fork(srv)
+    workload(srv)  # populates the disk tier
+    if srv._streamer is not None:
+        srv._streamer.drain()
+    del srv  # simulated kill: no close, durable spills only
+
+    warm_srv = make("restart_tier", force_disk=False)  # re-adopts
+    _warm(warm_srv, composite, lanes, window)
+    warm_fork(warm_srv)
+    snap = warm_srv.metrics()
+    base, base_disk_hits = (
+        snap["counters"], snap["snapshot_tiers"]["disk"]["hits"]
+    )
+    warm_wall = workload(warm_srv)
+    c = warm_srv.metrics()["counters"]
+    tiers = warm_srv.metrics()["snapshot_tiers"]
+    misses = c["prefix_misses"] - base["prefix_misses"]
+    disk_hits = tiers["disk"]["hits"] - base_disk_hits
+    warm_srv.close()
+
+    # control: nothing to adopt
+    cold_srv = make("restart_cold_tier", force_disk=False)
+    _warm(cold_srv, composite, lanes, window)
+    warm_fork(cold_srv)
+    cold_wall = workload(cold_srv)
+    cold_srv.close()
+    return {
+        "lanes": lanes,
+        "n_prefixes": n_prefixes,
+        "horizon_steps": horizon_steps,
+        "prefix_steps": prefix_steps,
+        "restarted_wall_s": round(warm_wall, 4),
+        "cold_wall_s": round(cold_wall, 4),
+        "restarted_over_cold": round(warm_wall / cold_wall, 4),
+        "restarted_misses": misses,
+        "restarted_disk_hits": disk_hits,
+    }
+
+
+def tier_warm_sweep(composite, n_trials, reps, tmp_root):
+    """The speculative-warming row: the same warmup-sharing sweep with
+    and without ``backend.warm`` — warming pre-launches the shared
+    warmup prefix, so the first trials coalesce onto it (speculative
+    hits) instead of paying the miss on their own latency path."""
+    import os
+
+    from lens_tpu.sweep import run_sweep
+
+    def spec(warm):
+        return {
+            "composite": composite,
+            "space": {
+                "kind": "random", "n_trials": n_trials,
+                "params": {
+                    "global/volume": {"low": 0.8, "high": 1.3},
+                },
+            },
+            "seed": 0, "horizon": 384.0, "emit_every": 32,
+            "capacity": 8,
+            "objective": {
+                "path": "global/volume",
+                "reduction": "final_live_sum", "mode": "max",
+            },
+            "backend": {
+                "kind": "server", "lanes": 8, "window": 32,
+                **({"warm": True} if warm else {}),
+            },
+            "warmup": {"horizon": 288.0, "seed": 41},
+        }
+
+    rows = {}
+    walls = {False: float("inf"), True: float("inf")}
+    counters = {}
+    for rep in range(reps):
+        for warm in (False, True):  # interleaved: this clock wanders
+            t0 = time.perf_counter()
+            res = run_sweep(
+                spec(warm),
+                out_dir=os.path.join(
+                    tmp_root, f"sweep_{int(warm)}_{rep}"
+                ),
+            )
+            wall = time.perf_counter() - t0
+            assert all(r["status"] == "done" for r in res.table)
+            walls[warm] = min(walls[warm], wall)
+            counters[warm] = res.metrics["server"]["counters"]
+    for warm in (False, True):
+        c = counters[warm]
+        rows["warm" if warm else "nowarm"] = {
+            "wall_s": round(walls[warm], 4),
+            "trials_per_s": round(n_trials / walls[warm], 3),
+            "warm_hits": c["warm_hits"],
+            "warm_submitted": c["warm_submitted"],
+        }
+    return {"n_trials": n_trials, **rows}
+
+
+def run_tier_bench(args) -> int:
+    import tempfile
+
+    horizon_steps = args.horizon_windows * args.window
+    prefix_windows = int(round(args.prefix_frac * args.horizon_windows))
+    prefix_steps = max(prefix_windows, 1) * args.window
+    record = {
+        "bench": "serve_tiers",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "prefix_steps": prefix_steps,
+        "reps": args.reps,
+        "protocol": "zipf row: interleaved min-of-reps, identical "
+        "per-rep workload + device budget (~3.5 snapshot entries) on "
+        "both stores, fresh prefix seeds per rep; restart row: "
+        "populate the disk tier, del the server without close, "
+        "rebuild over the same dir, repeat the workload (cold "
+        "control on a fresh dir); sweep row: backend.warm A/B",
+        "zipf_ab": [],
+        "restart": [],
+        "warm_sweep": [],
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        for lanes in args.lanes:
+            row = tier_zipf_ab(
+                args.composite, args.capacity, lanes, args.window,
+                args.emit_every, horizon_steps, prefix_steps,
+                n=max(6 * lanes, 48), n_prefixes=args.tier_prefixes,
+                alpha=args.zipf_alpha, reps=args.reps, tmp_root=tmp,
+            )
+            record["zipf_ab"].append(row)
+            print(json.dumps(row), flush=True)
+        # restart row: an all-but-one-window prefix, so one prefix
+        # RECOMPUTE (the cold path) clearly exceeds one disk RESTORE
+        # (the warm path) — the long-warmup regime the tier exists for
+        row = tier_restart(
+            args.composite, args.capacity, max(args.lanes),
+            args.window, args.emit_every, horizon_steps,
+            prefix_steps=horizon_steps - args.window,
+            n_prefixes=args.tier_prefixes, tmp_root=tmp,
+        )
+        record["restart"].append(row)
+        print(json.dumps(row), flush=True)
+        row = tier_warm_sweep(
+            args.composite, args.sweep_sizes[0],
+            max(args.reps, 3), tmp,
+        )
+        record["warm_sweep"].append(row)
+        print(json.dumps(row), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    for e in record["zipf_ab"]:
+        print(
+            f"zipf {e['lanes']} lanes: hit-rate "
+            f"{e['lru_hit_rate']:.3f} -> {e['tiered_hit_rate']:.3f}, "
+            f"wall x{e['tiered_over_lru']:.3f}"
+        )
+    r = record["restart"][0]
+    print(
+        f"restart: x{r['restarted_over_cold']:.3f} of cold, "
+        f"{r['restarted_disk_hits']} disk hits, "
+        f"{r['restarted_misses']} misses"
+    )
+    s = record["warm_sweep"][0]
+    print(
+        f"warm sweep: {s['nowarm']['trials_per_s']} -> "
+        f"{s['warm']['trials_per_s']} trials/s, "
+        f"{s['warm']['warm_hits']} speculative hits"
+    )
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--composite", default="toggle_colony")
@@ -1471,6 +1835,23 @@ def main() -> int:
         "is visible by construction",
     )
     p.add_argument(
+        "--tiers", action="store_true",
+        help="run the round-16 tiered-store bench: a skewed-"
+        "popularity (Zipf) workload A/B of the tiered store vs the "
+        "LRU-only r11 store at identical device budget, a "
+        "kill/restart disk-warmth row, and a speculative-warming "
+        "sweep row (writes BENCH_TIER_CPU_r16.json unless --out is "
+        "given)",
+    )
+    p.add_argument(
+        "--tier-prefixes", type=int, default=12,
+        help="distinct prefixes in the Zipf/restart tier workloads",
+    )
+    p.add_argument(
+        "--zipf-alpha", type=float, default=1.1,
+        help="Zipf popularity exponent for the tier workload",
+    )
+    p.add_argument(
         "--prefix-frac", type=float, default=0.75,
         help="shared-prefix fraction of the horizon (fork A/B), "
         "snapped to whole windows",
@@ -1490,12 +1871,12 @@ def main() -> int:
     # per-mode defaults (None = not explicitly passed)
     if sum(
         1 for m in (args.prefix, args.faults, args.mesh is not None,
-                    args.trace, args.frontdoor)
+                    args.trace, args.frontdoor, args.tiers)
         if m
     ) > 1:
         raise SystemExit(
-            "--prefix / --faults / --mesh / --trace / --frontdoor "
-            "are separate modes"
+            "--prefix / --faults / --mesh / --trace / --frontdoor / "
+            "--tiers are separate modes"
         )
     args.capacity = args.capacity or (
         64 if args.frontdoor else 256
@@ -1520,6 +1901,11 @@ def main() -> int:
         args.lanes = args.lanes or [2, 4, 8]
         args.horizon_windows = args.horizon_windows or 6
         return run_faults_bench(args)
+    if args.tiers:
+        args.out = args.out or "BENCH_TIER_CPU_r16.json"
+        args.lanes = args.lanes or [8]
+        args.horizon_windows = args.horizon_windows or 8
+        return run_tier_bench(args)
     if args.prefix:
         args.out = args.out or "BENCH_FORK_CPU_r11.json"
         args.lanes = args.lanes or [1, 8]

@@ -123,7 +123,35 @@ def _add_server_args(
         help="byte budget (MiB) for the content-addressed snapshot "
         "store behind request prefix caching and hold_state "
         "(unpinned prefix snapshots are evicted LRU-first past it; "
-        "see docs/serving.md, 'Prefix caching & forking')",
+        "see docs/serving.md, 'Prefix caching & forking'). With "
+        "--host-budget-mb/--tier-dir this bounds the DEVICE tier and "
+        "eviction becomes demotion",
+    )
+    p.add_argument(
+        "--host-budget-mb", type=float, default=None,
+        help="arm the host-RAM snapshot tier (MiB): snapshots past "
+        "the device budget demote to host memory instead of "
+        "evicting, and promote back on a hit (docs/serving.md, "
+        "'Tiered snapshots & speculative warming'). Default: no "
+        "host tier",
+    )
+    p.add_argument(
+        "--tier-dir", default=None, metavar="DIR",
+        help="arm the DISK snapshot tier: overflow demotes to DIR "
+        "via the checkpoint rename protocol, and the directory "
+        "survives restarts — a fresh server re-adopts every "
+        "content-addressed snapshot, so repeat traffic after a "
+        "reboot hits warm disk entries instead of recomputing "
+        "prefixes. Default: <recover-dir>/snapshots when tiers are "
+        "armed, else no disk tier",
+    )
+    p.add_argument(
+        "--warm", action="store_true",
+        help="speculative prefix warming: pre-run (serve: the "
+        "request list's distinct prefixes; frontdoor: each tenant's "
+        "repeated prefix shapes) in idle lanes ahead of demand — "
+        "strictly scavenging, never delaying admitted work "
+        "(docs/serving.md, 'Tiered snapshots & speculative warming')",
     )
     p.add_argument(
         "--check-finite", choices=["off", "window"], default="off",
@@ -610,6 +638,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         stream_queue=args.stream_queue,
         flush_every=args.flush_every,
         snapshot_budget_mb=args.snapshot_budget_mb,
+        host_budget_mb=args.host_budget_mb,
+        tier_dir=args.tier_dir,
         check_finite=args.check_finite,
         watchdog_s=args.watchdog,
         sink_errors=args.sink_errors,
@@ -636,6 +666,30 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"resuming at request #{done_already}"
             )
             raw = raw[done_already:]
+        if args.warm:
+            # the REMAINING request list is the future traffic (the
+            # truncation above already dropped what a recovered WAL
+            # knows): pre-launch its distinct prefixes as warm
+            # scavenger runs — the real submits below coalesce onto
+            # (or hit) the warmed snapshots instead of paying their
+            # own prefix misses
+            warmed = set()
+            for req in raw:
+                try:
+                    entry = dict(req or {})
+                    entry.setdefault("composite", args.composite)
+                    spec = ScenarioRequest.from_mapping(
+                        entry
+                    ).prefix_spec()
+                    if spec is None:
+                        continue
+                    fp = json.dumps(spec, sort_keys=True, default=str)
+                    if fp in warmed:
+                        continue
+                    warmed.add(fp)
+                    server.prewarm(spec)
+                except (ValueError, TypeError):
+                    pass  # the real submit will report the bad block
         ids = []
         skipped = 0
         for i, req in enumerate(raw):
@@ -723,6 +777,30 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"resident={snap['snapshots_resident']} "
                 f"({snap['snapshot_bytes'] / 2**20:.1f} MiB)"
             )
+        tiers = snap.get("snapshot_tiers") or {}
+        if any(
+            row.get("promotions") or row.get("demotions")
+            or (t != "device" and row.get("entries"))
+            for t, row in tiers.items()
+        ):
+            print(
+                "snapshot tiers: "
+                + " ".join(
+                    f"{t}={row['entries']}e/"
+                    f"{row['bytes'] / 2**20:.1f}MiB "
+                    f"(hits={row['hits']} promo={row['promotions']} "
+                    f"demo={row['demotions']})"
+                    for t, row in tiers.items()
+                )
+                + f" rejected={c['snapshot_rejected']}"
+            )
+        if c["warm_submitted"] or c["warm_hits"]:
+            print(
+                f"warming: submitted={c['warm_submitted']} "
+                f"completed={c['warm_completed']} "
+                f"hits={c['warm_hits']} "
+                f"preempted={c['warm_preempted']}"
+            )
         if c["diverged"] or c["recovered"]:
             print(
                 f"fault tolerance: diverged={c['diverged']} "
@@ -791,6 +869,8 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
             stream_queue=args.stream_queue,
             flush_every=args.flush_every,
             snapshot_budget_mb=args.snapshot_budget_mb,
+            host_budget_mb=args.host_budget_mb,
+            tier_dir=args.tier_dir,
             check_finite=args.check_finite,
             watchdog_s=args.watchdog,
             sink_errors=args.sink_errors,
@@ -809,6 +889,7 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
             tenants=args.tenants,
             host=args.host,
             port=args.port,
+            warm=args.warm,
         ).start()
     except (ValueError, OSError) as e:
         server.close()

@@ -124,7 +124,22 @@ class FrontDoor:
         under load).
     max_body_bytes:
         Bound on a request body (413 past it).
+    warm:
+        Speculative prefix warming from observed traffic
+        (docs/serving.md, "Tiered snapshots & speculative warming"):
+        the door tracks each tenant's request PREFIX shapes, and a
+        shape seen more than once is ruled popular — whenever the
+        server goes idle, popular prefixes are handed to
+        ``SimServer.prewarm`` so a demoted snapshot is promoted back
+        to the device tier (or a missing one recomputed in an idle
+        lane) BEFORE the next repeat arrives. Strictly scavenging:
+        warm work never delays an admitted request. Default off.
     """
+
+    #: Most-popular prefixes kept per warming pass, and the sighting
+    #: count past which a shape is ruled popular.
+    WARM_TOP_K = 8
+    WARM_MIN_SEEN = 2
 
     def __init__(
         self,
@@ -136,6 +151,7 @@ class FrontDoor:
         idle_sleep_s: float = 0.002,
         max_body_bytes: int = 8 << 20,
         stream_poll_s: float = 0.02,
+        warm: bool = False,
     ):
         if getattr(server, "sink", None) != "log":
             raise ValueError(
@@ -171,6 +187,19 @@ class FrontDoor:
         )
         self._rid_tenant: Dict[str, str] = {}   # rid -> owning tenant
         self._inflight_rids: Dict[str, str] = {}  # submitted, not done
+        # speculative warming (warm=True): per-(tenant, prefix-shape)
+        # sighting counts plus the prewarm spec each shape denotes;
+        # popular shapes are prewarmed at idle (_scheduler_loop)
+        self.warm = bool(warm)
+        self._prefix_seen: Dict[Any, int] = {}
+        self._prefix_spec: Dict[Any, Dict[str, Any]] = {}
+        # one warming pass per idle period, ONE prewarm per loop
+        # iteration: a disk promotion is blocking I/O under the door
+        # lock, so the pass is spread across iterations — an HTTP
+        # request arriving mid-pass waits for at most one promotion,
+        # never the whole popular list
+        self._warm_plan: list = []
+        self._warmed_idle = False
         self._done_at_door: Dict[str, Tuple[str, Optional[str]]] = {}
         self._draining = False
         # a fatal scheduler error (parked stream failure, watchdog):
@@ -340,7 +369,21 @@ class FrontDoor:
                     raise
                 waiting = self.sched.queued() or len(self.server.queue)
             if not busy and not waiting:
+                if self.warm and not self._draining \
+                        and not self._warmed_idle:
+                    # idle: re-warm this door's popular prefixes (a
+                    # demoted one promotes back to device, an evicted
+                    # one re-runs in the now-idle lanes). One shape
+                    # per iteration — the lock is released between
+                    # promotions — and one pass per idle period:
+                    # prewarm is a no-op for anything already
+                    # resident, but no reason to spin on it.
+                    with self._lock:
+                        self._prewarm_popular_step()
                 time.sleep(self.idle_sleep_s)
+            else:
+                self._warmed_idle = False
+                self._warm_plan.clear()
 
     def _pump(self) -> None:
         """Move requests from the tenant scheduler into the server's
@@ -373,6 +416,8 @@ class FrontDoor:
                 continue
             self.sched.note_submitted(entry.tenant)
             self._inflight_rids[entry.rid] = entry.tenant
+            if self.warm:
+                self._note_prefix(entry.tenant, entry.request)
             if self.server.trace:
                 self.server.trace.emit_span(
                     "frontdoor.request", entry.received_at,
@@ -380,6 +425,62 @@ class FrontDoor:
                     aid=entry.rid, rid=entry.rid,
                     tenant=entry.tenant, priority=entry.priority,
                 )
+
+    def _note_prefix(self, tenant: str, request: Any) -> None:
+        """Record one accepted request's prefix shape against its
+        tenant — repeated shapes are the door's traffic oracle (an
+        HTTP client re-running what-if forks off one scenario submits
+        the same prefix block over and over)."""
+        spec = request.prefix_spec()
+        if spec is None:
+            return
+        shape = (
+            tenant,
+            json.dumps(spec, sort_keys=True, default=str),
+        )
+        self._prefix_seen[shape] = self._prefix_seen.get(shape, 0) + 1
+        self._prefix_spec[shape] = spec
+        if len(self._prefix_seen) > self.DOOR_TERMINAL_RETENTION:
+            # evict the LEAST-SEEN shapes: insertion order would purge
+            # the oldest entries, which are exactly the long-lived
+            # popular prefixes the oracle exists to remember
+            for _, old in sorted(
+                (seen, shape)
+                for shape, seen in self._prefix_seen.items()
+            )[:1000]:
+                del self._prefix_seen[old]
+                self._prefix_spec.pop(old, None)
+
+    def _prewarm_popular_step(self) -> None:
+        """Hand ONE popular prefix to ``SimServer.prewarm`` per call
+        (caller holds the scheduler lock; a step is at most one disk
+        promotion). The first step of an idle period plans the pass —
+        the top popular shapes by sighting count — and the pass marks
+        itself done when the plan drains. Advisory end to end: a
+        validation error just drops the shape from the table."""
+        if not self._warm_plan:
+            self._warm_plan = [
+                shape
+                for _, shape in sorted(
+                    (
+                        (seen, shape)
+                        for shape, seen in self._prefix_seen.items()
+                        if seen >= self.WARM_MIN_SEEN
+                    ),
+                    reverse=True,
+                )[: self.WARM_TOP_K]
+            ]
+            if not self._warm_plan:
+                self._warmed_idle = True
+                return
+        shape = self._warm_plan.pop(0)
+        try:
+            self.server.prewarm(self._prefix_spec[shape])
+        except (ValueError, KeyError):
+            self._prefix_seen.pop(shape, None)
+            self._prefix_spec.pop(shape, None)
+        if not self._warm_plan:
+            self._warmed_idle = True
 
     #: Retention bounds for the per-request maps a long-running door
     #: would otherwise grow forever (one entry per request EVER

@@ -29,6 +29,7 @@ from lens_tpu.serve.metrics import ServerMetrics, write_server_meta
 from lens_tpu.serve.server import SimServer
 from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
 from lens_tpu.serve.streamer import Streamer, WatchdogTimeout
+from lens_tpu.serve.tiers import TieredSnapshotStore
 from lens_tpu.serve.wal import ServeWal
 
 __all__ = [
@@ -52,6 +53,7 @@ __all__ = [
     "SimulationDiverged",
     "SnapshotStore",
     "Streamer",
+    "TieredSnapshotStore",
     "WatchdogTimeout",
     "snapshot_key",
     "write_server_meta",

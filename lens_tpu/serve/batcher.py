@@ -252,6 +252,24 @@ class ScenarioRequest:
     tenant: Optional[str] = None
     priority: str = BATCH
 
+    def prefix_spec(self) -> Optional[Dict[str, Any]]:
+        """The ``SimServer.prewarm`` mapping for this request's shared
+        prefix (None without one) — the ONE place the prefix's
+        content-address-relevant field set is encoded for the warm
+        drivers (front door, serve CLI), so a future prefix field
+        cannot silently diverge between what clients submit and what
+        warming precomputes."""
+        if not self.prefix:
+            return None
+        prefix = dict(self.prefix)
+        return {
+            "composite": self.composite,
+            "seed": int(self.seed),
+            "horizon": float(prefix["horizon"]),
+            "overrides": prefix.get("overrides") or {},
+            "n_agents": self.n_agents,
+        }
+
     @classmethod
     def from_mapping(
         cls, request: Mapping[str, Any]
@@ -392,6 +410,10 @@ class Ticket:
     held_key: Any = None
     waiting: bool = False
     internal: bool = False
+    # a speculative prefix-warming run (SimServer.prewarm): internal,
+    # admitted only into lanes no client ticket wants, preemptible —
+    # its product is a warmed snapshot, never a result
+    warm: bool = False
     parent: Optional[str] = None
     # quarantine (check_finite): the per-window finite check flagged
     # this ticket's lane; result() raises SimulationDiverged

@@ -62,6 +62,11 @@ _COUNTER_HELP = {
     "prefix_coalesced": "prefix submits attached to an in-flight run",
     "prefix_forks": "lanes seeded by scattering a cached snapshot",
     "snapshot_evictions": "snapshot-store entries dropped to budget",
+    "snapshot_rejected": "snapshot puts not retained (over budget)",
+    "warm_submitted": "speculative prefix warm runs launched",
+    "warm_completed": "warm runs that published their snapshot",
+    "warm_hits": "prefix submits served by speculative warming",
+    "warm_preempted": "warm lanes preempted for client admissions",
     "diverged": "lanes quarantined by the per-window finite check",
     "recovered": "unfinished WAL requests re-admitted at startup",
     "requeued": "requests displaced from a quarantined device",
@@ -143,9 +148,13 @@ class ServerMetrics:
         self.lanes_total = 0
         self.retraces = 0
         # snapshot-store gauges (refreshed by the server alongside
-        # queue depth / busy lanes)
+        # queue depth / busy lanes); snapshot_tiers: one dict per
+        # storage tier (entries/bytes/hits/promotions/demotions —
+        # round 16, docs/serving.md "Tiered snapshots & speculative
+        # warming"), exported like the per-shard gauges
         self.snapshots_resident = 0
         self.snapshot_bytes = 0
+        self.snapshot_tiers: Dict[str, Dict[str, int]] = {}
         # mesh gauges: one dict per device shard (index, device,
         # quarantined, lanes, occupancy, windows, diverged,
         # snapshot_bytes) + the quarantined-device count
@@ -361,6 +370,9 @@ class ServerMetrics:
             "retraces": self.retraces,
             "snapshots_resident": self.snapshots_resident,
             "snapshot_bytes": self.snapshot_bytes,
+            "snapshot_tiers": {
+                t: dict(row) for t, row in self.snapshot_tiers.items()
+            },
             "shards": [dict(s) for s in self.shards],
             "quarantined_devices": self.quarantined_devices,
             "uptime_seconds": time.perf_counter() - self._t0,
@@ -396,6 +408,10 @@ class ServerMetrics:
         }
         if self.shards:
             point["shards"] = [dict(s) for s in self.shards]
+        if self.snapshot_tiers:
+            point["snapshot_tiers"] = {
+                t: dict(row) for t, row in self.snapshot_tiers.items()
+            }
         tenants = self.tenants
         if tenants:
             point["tenants"] = tenants
@@ -427,6 +443,17 @@ class ServerMetrics:
                     f"{ns}_shard_quarantined{label} "
                     f"{int(bool(s.get('quarantined')))}"
                 )
+        if self.snapshot_tiers:
+            ns = self.registry.namespace
+            for col in (
+                "entries", "bytes", "hits", "promotions", "demotions",
+            ):
+                lines.append(f"# TYPE {ns}_snapshot_tier_{col} gauge")
+                for t, row in sorted(self.snapshot_tiers.items()):
+                    lines.append(
+                        f'{ns}_snapshot_tier_{col}{{tier="{t}"}} '
+                        f"{row.get(col, 0)}"
+                    )
         tenants = self.tenants
         if tenants:
             ns = self.registry.namespace

@@ -123,7 +123,12 @@ from lens_tpu.serve.metrics import (
     request_timing_row,
     write_server_meta,
 )
-from lens_tpu.serve.snapshots import SnapshotStore, snapshot_key
+from lens_tpu.serve.snapshots import (
+    DEVICE,
+    SnapshotStore,
+    snapshot_key,
+)
+from lens_tpu.serve.tiers import TieredSnapshotStore
 from lens_tpu.serve.streamer import (
     LaneSlice,
     Streamer,
@@ -148,7 +153,6 @@ from lens_tpu.serve.wal import (
     buckets_fingerprint,
     key_from_json,
     key_to_json,
-    spill_name,
 )
 from lens_tpu.utils.dicts import flatten_paths, get_path, set_path
 from lens_tpu.utils.hostio import copy_tree_to_host_async
@@ -448,6 +452,27 @@ class SimServer:
         "Prefix caching & forking"). Unpinned prefix snapshots are
         evicted LRU-first past the budget; pinned held states are the
         client's working set and always land. ``None`` = unbounded.
+        With the TIERED store armed (below), the budget bounds the
+        DEVICE tier and eviction becomes demotion.
+    host_budget_mb:
+        Arm the host-RAM snapshot tier (docs/serving.md, "Tiered
+        snapshots & speculative warming"): snapshots past the device
+        budget demote device->host (one async ``device_get``) instead
+        of evicting, and a hit on a host-resident entry promotes it
+        back onto the admitting shard's device. ``None`` (default):
+        no host tier — the round-15 store, bit for bit.
+    tier_dir:
+        Arm the DISK snapshot tier: host-tier overflow (or device
+        overflow, with no host tier) demotes to disk via the
+        checkpoint rename protocol, and the directory SURVIVES
+        RESTARTS — a fresh server over the same ``tier_dir`` re-adopts
+        every content-addressed snapshot at construction, so repeat
+        traffic after a reboot forks from warm disk entries instead
+        of recomputing prefixes. Defaults to ``<recover_dir>/snapshots``
+        when ``recover_dir`` is set AND a host budget armed the tiers;
+        a plain ``recover_dir`` (no tier knobs) keeps round-15
+        eviction semantics while still unifying hold spills with the
+        tier's on-disk object format.
     check_finite:
         ``"window"`` arms the lane quarantine: after every window a
         jitted per-lane finite check rides the trajectory's
@@ -545,6 +570,8 @@ class SimServer:
         pipeline: str = "on",
         stream_queue: int = 2,
         snapshot_budget_mb: Optional[float] = None,
+        host_budget_mb: Optional[float] = None,
+        tier_dir: Optional[str] = None,
         check_finite: str = "off",
         watchdog_s: Optional[float] = None,
         sink_errors: str = "fatal",
@@ -585,6 +612,10 @@ class SimServer:
         if device_watchdog_s is not None and device_watchdog_s <= 0:
             raise ValueError(
                 f"device_watchdog_s={device_watchdog_s} must be > 0"
+            )
+        if host_budget_mb is not None and host_budget_mb < 0:
+            raise ValueError(
+                f"host_budget_mb={host_budget_mb} must be >= 0"
             )
         if metrics_interval_s is not None:
             if metrics_interval_s < 0:
@@ -655,13 +686,46 @@ class SimServer:
             if pipeline == "on"
             else None
         )
-        self.snapshots = SnapshotStore(
-            budget_bytes=None
+        # -- snapshot store: flat (round 15) or tiered (round 16) --
+        # The tiered store arms when any tier knob is given OR a
+        # recover_dir exists (hold spills and the disk tier share one
+        # on-disk object, so recovery adopts spills INTO the store);
+        # with no tier knobs demote_to_disk stays off and the store
+        # behaves exactly like the round-15 flat one.
+        self._fingerprint = buckets_fingerprint(
+            {n: b.cfg for n, b in self.buckets.items()}
+        )
+        budget_bytes = (
+            None
             if snapshot_budget_mb is None
             else int(float(snapshot_budget_mb) * 2**20)
         )
+        self.tier_dir = tier_dir
+        tiers_on = host_budget_mb is not None or tier_dir is not None
+        disk_dir = tier_dir or (
+            os.path.join(recover_dir, SPILL_DIR) if recover_dir
+            else None
+        )
+        if tiers_on or disk_dir is not None:
+            self.snapshots: SnapshotStore = TieredSnapshotStore(
+                budget_bytes=budget_bytes,
+                host_budget_bytes=(
+                    int(float(host_budget_mb) * 2**20)
+                    if host_budget_mb
+                    else 0
+                ),
+                dir=disk_dir,
+                demote_to_disk=tiers_on and disk_dir is not None,
+                fingerprint=self._fingerprint,
+            )
+        else:
+            self.snapshots = SnapshotStore(budget_bytes=budget_bytes)
         if self.trace:
             self.snapshots.trace = self.trace
+        # counters mirrored from the store into the metrics registry
+        # (delta-synced at gauge refresh: the store is scheduler-
+        # thread-only, the registry is the export surface)
+        self._rejected_seen = 0
         # scheduler tick sequence: the correlation coordinate every
         # span/instant and every stage breadcrumb carries (counters
         # track it too; this mirror avoids a dict build per event)
@@ -669,6 +733,15 @@ class SimServer:
         # in-flight prefix coalescing: snapshot key -> fork tickets
         # waiting for the (single) internal prefix run computing it
         self._pending_prefix: Dict[Any, List[Ticket]] = {}
+        # speculative warming (docs/serving.md, "Tiered snapshots &
+        # speculative warming"): warm tickets wait OUTSIDE the bounded
+        # client queue (scavengers must not consume client depth) and
+        # admit only into lanes no admissible client ticket wants;
+        # _warm_pending tracks the keys whose snapshot is being
+        # computed by a warm run, so a client prefix submit that
+        # coalesces onto one counts as a speculative hit
+        self._warm_queue: List[Ticket] = []
+        self._warm_pending: set = set()
         self.tickets: Dict[str, Ticket] = {}
         self._results: Dict[str, Any] = {}
         # per-request stream-completion events (pipelined): set once
@@ -689,9 +762,7 @@ class SimServer:
             )
             had_events = self._wal.replayed()
             self._wal.begin(
-                buckets_fingerprint(
-                    {n: b.cfg for n, b in self.buckets.items()}
-                ),
+                self._fingerprint,
                 {n: {"composite": b.cfg["composite"] or n}
                  for n, b in self.buckets.items()},
             )
@@ -711,7 +782,8 @@ class SimServer:
         server_keys = (
             "queue_depth", "out_dir", "sink", "stream_flush",
             "flush_every", "pipeline", "stream_queue",
-            "snapshot_budget_mb", "check_finite", "watchdog_s",
+            "snapshot_budget_mb", "host_budget_mb", "tier_dir",
+            "check_finite", "watchdog_s",
             "sink_errors", "recover_dir", "faults", "mesh",
             "device_watchdog_s", "trace_dir", "metrics_interval_s",
         )
@@ -975,8 +1047,13 @@ class SimServer:
             self.snapshots.acquire(key)
             t.carry_key = key
             self._metrics.inc("prefix_hits")
+            if self.snapshots.is_warmed(key):
+                # the snapshot exists (or is device-resident) because
+                # warming put it there ahead of this submit
+                self._metrics.inc("warm_hits")
             self.trace.instant(
-                "prefix.hit", rid=t.request_id, tick=self._ticks
+                "prefix.hit", rid=t.request_id, tick=self._ticks,
+                tier=self.snapshots.tier_of(key),
             )
             return
         waiters = self._pending_prefix.get(key)
@@ -984,6 +1061,18 @@ class SimServer:
             waiters.append(t)
             t.waiting = True
             self._metrics.inc("prefix_coalesced")
+            if key in self._warm_pending:
+                # coalesced onto an in-flight WARM run: the prefix
+                # compute this submit would have launched was already
+                # speculatively in progress — and it is CLIENT work
+                # from this moment. A still-queued warm ticket must
+                # stop waiting for leftover lanes (under sustained
+                # load there are none, and the fork would starve
+                # behind later-submitted requests): promote it into
+                # the client queue, where a plain miss's internal run
+                # would have gone.
+                self._metrics.inc("warm_hits")
+                self._promote_warm_run(key, t.request.priority)
             self.trace.instant(
                 "prefix.coalesced", rid=t.request_id, tick=self._ticks
             )
@@ -1168,6 +1257,127 @@ class SimServer:
             # held requests and reclaimed with the recover_dir
             self._wal.append({"event": RELEASE, "rid": request_id})
 
+    def prewarm(
+        self,
+        spec: Optional[Mapping[str, Any]] = None,
+        **kw: Any,
+    ) -> Optional[str]:
+        """Speculatively warm one scenario prefix (docs/serving.md,
+        "Tiered snapshots & speculative warming"): callers that know
+        future traffic — the sweep driver's warmup block, the front
+        door's repeated request shapes, a CLI request list — hand the
+        prefix here as ``{composite, seed, horizon, overrides,
+        n_agents}`` (mapping or kwargs), where ``horizon`` is the
+        PREFIX length, and the server makes it device-resident ahead
+        of demand without ever delaying admitted work:
+
+        - already device-resident: no-op (returns None);
+        - resident on a lower tier: promoted now and tagged warmed —
+          the prefetch half of warming;
+        - absent: an internal WARM ticket is queued OUTSIDE the
+          bounded client queue, admitted only into lanes no admissible
+          client ticket wants, and PREEMPTED (exact progress captured
+          on-device, resumed later) the moment clients outnumber free
+          lanes. Client prefix submits that arrive meanwhile coalesce
+          onto the warm run exactly like any in-flight prefix.
+          Returns the warm ticket's id.
+
+        Warming changes WORK PLACEMENT only, never bits: a warmed
+        snapshot is the same content-addressed entry a client miss
+        would have computed (co-batching is bitwise-invariant, pinned
+        in tests/test_tiers.py). Scheduler-thread discipline applies —
+        call from the thread that drives ``tick()``.
+
+        Raises ``ValueError`` for an unknown composite or a malformed
+        prefix spec (off-grid horizon, bad override paths), exactly
+        like ``submit`` would for the equivalent ``prefix`` block.
+        """
+        merged = {**(dict(spec) if spec else {}), **kw}
+        unknown = set(merged) - {
+            "composite", "seed", "horizon", "overrides", "n_agents",
+        }
+        if unknown:
+            raise ValueError(
+                f"unknown prewarm keys {sorted(unknown)}; known: "
+                f"composite, seed, horizon, overrides, n_agents"
+            )
+        missing = {"composite", "horizon"} - set(merged)
+        if missing:
+            raise ValueError(
+                f"prewarm needs {sorted(missing)} (got "
+                f"{sorted(merged)})"
+            )
+        req = ScenarioRequest(
+            composite=merged["composite"],
+            seed=int(merged.get("seed", 0)),
+            horizon=float(merged["horizon"]),
+            overrides=merged.get("overrides") or {},
+            n_agents=merged.get("n_agents"),
+        )
+        bucket = self.buckets.get(req.composite)
+        if bucket is None:
+            raise RequestValidationError(
+                f"no bucket serves composite {req.composite!r}; "
+                f"configured: {sorted(self.buckets)}",
+                path="composite",
+            )
+        if not bucket.active_shards():
+            return None  # advisory: a dead bucket just skips warming
+        steps = self._horizon_steps(bucket, req.horizon)
+        bucket.pool.validate_overrides(req.overrides, what="override")
+        agents = self._request_agents(bucket, req)
+        bucket.pool.validate_agents(agents)
+        key = snapshot_key(
+            req.composite, int(req.seed), agents, req.overrides, steps
+        )
+        if key in self.snapshots:
+            if self.snapshots.tier_of(key) != DEVICE:
+                # the prefetch half: promote the demoted entry back to
+                # the device tier during idle, onto the emptiest shard
+                shard = bucket.place()
+                try:
+                    self.snapshots.fetch(
+                        key, shard=shard.index, device=shard.device
+                    )
+                except OSError:
+                    # warming is ADVISORY: a torn/missing spill under
+                    # a long-lived tier dir must not take the caller
+                    # down (the front door's scheduler thread, the
+                    # serve CLI startup). Forget the unpromotable
+                    # entry when nothing pins it, so later submits
+                    # MISS and recompute instead of tripping on it.
+                    if self.snapshots.refs(key) == 0:
+                        self.snapshots.drop(key)
+                    self.trace.instant(
+                        "warm.prefetch_failed", tick=self._ticks,
+                    )
+                    return None
+                self.snapshots.mark_warmed(key)
+                self.trace.instant(
+                    "warm.promoted", tick=self._ticks,
+                    shard=shard.index,
+                )
+            return None
+        if key in self._pending_prefix:
+            return None  # already being computed (warm or client run)
+        ticket = Ticket(
+            request_id=self.queue.next_id(),
+            request=req,
+            horizon_steps=steps,
+            content_key=key,
+            internal=True,
+            warm=True,
+        )
+        self.tickets[ticket.request_id] = ticket
+        self._warm_queue.append(ticket)
+        self._pending_prefix[key] = []
+        self._warm_pending.add(key)
+        self._metrics.inc("warm_submitted")
+        self.trace.instant(
+            "warm.launch", rid=ticket.request_id, tick=self._ticks,
+        )
+        return ticket.request_id
+
     def status(self, request_id: str) -> Dict[str, Any]:
         t = self._ticket(request_id)
         return {
@@ -1221,6 +1431,17 @@ class SimServer:
                 "coalesced": c["prefix_coalesced"],
                 "forks": c["prefix_forks"],
                 "evictions": c["snapshot_evictions"],
+                "rejected": c["snapshot_rejected"],
+                "tiers": {
+                    t: dict(row)
+                    for t, row in self._metrics.snapshot_tiers.items()
+                },
+                "warm": {
+                    "submitted": c["warm_submitted"],
+                    "completed": c["warm_completed"],
+                    "hits": c["warm_hits"],
+                    "preempted": c["warm_preempted"],
+                },
             },
             "tenants": self._metrics.tenants,
         }
@@ -1251,6 +1472,21 @@ class SimServer:
         )
         self._metrics.snapshots_resident = len(self.snapshots)
         self._metrics.snapshot_bytes = self.snapshots.resident_bytes()
+        stats = self.snapshots.tier_stats()
+        if getattr(self.snapshots, "tiers_armed", False):
+            # tier rows only when paging is in play: a flat-store (or
+            # plain-recover_dir) server must not grow zero-valued
+            # host/disk gauges in every scrape and time-series point
+            self._metrics.snapshot_tiers = stats["tiers"]
+        if stats["rejected"] > self._rejected_seen:
+            # delta-sync the store's rejection count into the
+            # monotonic registry counter (the store is scheduler-
+            # thread-only; the registry is the export surface)
+            self._metrics.inc(
+                "snapshot_rejected",
+                stats["rejected"] - self._rejected_seen,
+            )
+            self._rejected_seen = stats["rejected"]
         self._metrics.quarantined_devices = len(self._quarantined)
         self._metrics.shards = self._shard_gauges()
 
@@ -1470,6 +1706,18 @@ class SimServer:
                             self._metrics.inc("timeouts")
                         did_work = True
 
+        # 2b. warm preemption: a lane running a SPECULATIVE prefix
+        #     must never make an admissible client ticket wait — if
+        #     clients outnumber free lanes, preempt warm lanes (exact
+        #     progress captured on-device, the run resumes later in an
+        #     idle lane) before admission runs. Warm runs that real
+        #     forks have coalesced onto are client work now and are
+        #     never preempted. Gated on _warm_pending (a warm run in a
+        #     lane always has its key there), so a server that never
+        #     warms pays one empty-set check per tick, not a lane scan.
+        if self._warm_pending:
+            did_work |= self._preempt_warm_lanes()
+
         # 3. admission: FIFO over the queue, per-bucket free lanes;
         #    forks waiting on an in-flight prefix are skipped in place
         free = {
@@ -1482,6 +1730,18 @@ class SimServer:
             did_work = True
             self._admit(t, now)
         self._metrics.queue_depth = len(self.queue)
+
+        # 3b. speculative warming scavenges what is left: warm tickets
+        #     admit only into lanes the client admission pass above
+        #     left free (a free lane here means no admissible client
+        #     ticket wanted it this tick)
+        if self._warm_queue:
+            for t in list(self._warm_queue):
+                bucket = self.buckets[t.request.composite]
+                if bucket.free_lanes() > 0:
+                    self._warm_queue.remove(t)
+                    self._admit(t, now)
+                    did_work = True
 
         # 4. one window per (bucket, shard) with any occupied lane —
         #    each shard is its own device program, so the dispatches
@@ -1632,9 +1892,16 @@ class SimServer:
             admit_t0 = time.perf_counter()
         try:
             if t.carry_key is not None:
+                # fetch, not state: a host/disk-resident snapshot
+                # promotes onto THIS shard's device here — the paging
+                # moment (device_put / restore_tree), counted per-tier
+                # by the store
                 shard.pool.admit_state(
                     lane,
-                    self.snapshots.state(t.carry_key),
+                    self.snapshots.fetch(
+                        t.carry_key, shard=shard.index,
+                        device=shard.device,
+                    ),
                     arm_steps,
                     overrides=fork_overrides,
                 )
@@ -1659,9 +1926,22 @@ class SimServer:
                     overrides=t.request.overrides or None,
                 )
         except Exception as e:  # bad overrides/counts: fail the REQUEST
+            carry = t.carry_key
             t.error = f"{type(e).__name__}: {e}"
-            self._finish(t, FAILED)
+            self._finish(t, FAILED)  # releases the carry pin
             self._metrics.inc("failed")
+            if (
+                isinstance(e, OSError)
+                and carry is not None
+                and carry in self.snapshots
+                and self.snapshots.refs(carry) == 0
+            ):
+                # a torn disk spill must fail at most the requests
+                # already pinned to it, never every future fork of
+                # the prefix: forget the unpromotable entry so later
+                # submits MISS and recompute (prewarm's prefetch path
+                # applies the same repair)
+                self.snapshots.drop(carry)
             return
         if self.trace:
             self.trace.emit_span(
@@ -1686,6 +1966,64 @@ class SimServer:
                 self._stream_done[t.request_id] = threading.Event()
         self._metrics.inc("admitted")
         self.faults.kill("admitted")
+
+    def _promote_warm_run(self, key, priority: str) -> None:
+        """A client fork now depends on a speculative run. If its warm
+        ticket is still waiting for scraps on the warm queue, move it
+        into the CLIENT queue (force-pushed, exactly where a plain
+        miss's internal prefix run goes) under the fork's admission
+        class — the run is on a real request's latency path now.
+        RUNNING warm tickets need nothing: the waiter check in
+        ``_preempt_warm_lanes`` already shields them."""
+        for w in self._warm_queue:
+            if w.content_key == key:
+                self._warm_queue.remove(w)
+                w.request = dc_replace(w.request, priority=priority)
+                self.queue.push(w, retry_after=0.0, force=True)
+                return
+
+    def _preempt_warm_lanes(self) -> bool:
+        """Free lanes running waiter-less warm tickets for the
+        admissible client tickets queued this tick. The preempted
+        run's exact progress is captured on-device (one jitted lane
+        slice, the hold_state mechanism) and carried back onto the
+        warm queue, so resuming later costs nothing but the scatter —
+        and the resumed run is bitwise the run that was interrupted."""
+        preempted = False
+        for name, bucket in self.buckets.items():
+            shortfall = sum(
+                1 for t in self.queue
+                if t.request.composite == name and not t.waiting
+            ) - bucket.free_lanes()
+            if shortfall <= 0:
+                continue
+            for shard in bucket.active_shards():
+                if shortfall <= 0:
+                    break
+                for lane, t in list(shard.assignments.items()):
+                    if shortfall <= 0:
+                        break
+                    if not t.warm:
+                        continue
+                    if self._pending_prefix.get(t.content_key):
+                        continue  # real forks wait on it: client work
+                    t.carry_state = shard.pool.lane_state_device(lane)
+                    t.carry_shard = shard.index
+                    shard.pool.release(lane)
+                    del shard.assignments[lane]
+                    t.status = QUEUED
+                    t.lane = None
+                    t.shard = None
+                    self._warm_queue.append(t)
+                    self._metrics.inc("warm_preempted")
+                    self.trace.instant(
+                        "warm.preempted", rid=t.request_id,
+                        tick=self._ticks, shard=shard.index,
+                        lane=lane, steps=t.steps_done,
+                    )
+                    shortfall -= 1
+                    preempted = True
+        return preempted
 
     def _make_sink(self, t: Ticket):
         if self.sink == "ram":
@@ -1887,6 +2225,23 @@ class SimServer:
             displaced.extend(s.assignments.values())
             s.assignments.clear()
         self._failover_snapshots(shard)
+        # any QUEUED ticket may hold a device tree captured on the
+        # dead device — a preempted warm ticket's progress capture
+        # (warm queue, or client queue after _promote_warm_run) or a
+        # coalesced fork's seeded carry_state — and scattering dead
+        # buffers fails on real hardware. Void the capture: warm runs
+        # restart from scratch, forks re-resolve their prefix against
+        # the (just failed-over) store, exactly like the carry_KEY
+        # repair in _repair_lost_refs.
+        for w in list(self._warm_queue) + list(self.queue):
+            if w.carry_shard == shard and w.carry_state is not None:
+                w.carry_state = None
+                w.carry_shard = None
+                w.steps_done = w.steps_base
+                if w.prefix_key is not None and w.status == QUEUED:
+                    self._resolve_prefix(
+                        w, self.buckets[w.request.composite]
+                    )
         # re-queue in submission order — failover preserves the FIFO
         # fairness the queue had before the device died
         for t in sorted(displaced, key=lambda t: t.request_id):
@@ -1949,39 +2304,13 @@ class SimServer:
 
     def _failover_snapshots(self, dead: int) -> None:
         """Re-home every snapshot whose buffers lived in the dead
-        device's memory: rehydrate from its durable spill onto a
-        surviving device where one exists (same key, same refs, new
-        residency — outstanding pins keep working), otherwise declare
-        it lost and repair the tickets that depended on it."""
-        from lens_tpu.checkpoint import restore_tree
-
-        target = next(
-            (
-                k for k in range(self.n_shards)
-                if k not in self._quarantined
-            ),
-            None,
-        )
-        for key in self.snapshots.keys_on_shard(dead):
-            path = (
-                os.path.join(
-                    self.recover_dir, SPILL_DIR, spill_name(key)
-                )
-                if self.recover_dir
-                else None
-            )
-            if (
-                target is not None
-                and path is not None
-                and os.path.isdir(path)
-            ):
-                self.snapshots.reassign(
-                    key,
-                    restore_tree(path, device=self.devices[target]),
-                    shard=target,
-                )
-                continue
-            orphaned = self.snapshots.discard(key)
+        device's memory. With the tiered store, an entry with a
+        durable disk copy simply DEMOTES to the disk tier (same key,
+        same refs — the admission that next needs it restores onto a
+        surviving device, lazily); only entries with no copy anywhere
+        else are lost, and the tickets that depended on their exact
+        bits are repaired with descriptive failures."""
+        for key, orphaned in self.snapshots.device_lost(dead):
             self._metrics.inc("snapshot_evictions")
             if orphaned:
                 self._repair_lost_refs(key)
@@ -2287,6 +2616,12 @@ class SimServer:
                             t.content_key, snap, shard=shard.index
                         ),
                     )
+                    if t.warm:
+                        # a speculative run's product: tag it so later
+                        # hits count as warming successes
+                        self.snapshots.mark_warmed(t.content_key)
+                        self._warm_pending.discard(t.content_key)
+                        self._metrics.inc("warm_completed")
                     self._resolve_waiters(
                         t.content_key, snap, shard=shard.index
                     )
@@ -2308,7 +2643,7 @@ class SimServer:
                     )
                     t.held_key = held
                     if self._wal is not None:
-                        self._spill_hold(t, held, snap)
+                        self._spill_hold(t, held)
             del shard.assignments[lane]
             self._finish(t, DONE)
             self._metrics.inc("retired")
@@ -2362,19 +2697,20 @@ class SimServer:
             ),
         )
 
-    def _spill_hold(self, t: Ticket, key, snap) -> None:
-        """Durably spill a held snapshot (checkpoint rename protocol)
-        and WAL the hold, so a killed server's ``resubmit`` chain can
-        rehydrate the exact bits. Runs on the scheduler thread at
-        retirement — a synchronous host fetch + orbax save, paid only
-        by ``hold_state`` requests under a ``recover_dir``. The spill
-        lands BEFORE the retire event (file order = replay order), so
-        a resubmit event in the WAL always implies a complete spill."""
-        from lens_tpu.checkpoint import save_tree
-
-        name = spill_name(key)
+    def _spill_hold(self, t: Ticket, key) -> None:
+        """Durably spill a held snapshot and WAL the hold, so a killed
+        server's ``resubmit`` chain can rehydrate the exact bits. The
+        spill IS a disk-tier object now (round 16): the store's
+        ``persist`` writes the same tmp+rename directory a budget
+        demotion would, plus the content sidecar — one on-disk format,
+        whether the bytes got there by durability or by paging (the
+        round-12 double-spill is gone). Runs on the scheduler thread
+        at retirement, paid only by ``hold_state`` requests under a
+        ``recover_dir``; lands BEFORE the retire event (file order =
+        replay order), so a resubmit event in the WAL always implies a
+        complete spill."""
         t0 = time.perf_counter()
-        save_tree(os.path.join(self.recover_dir, SPILL_DIR, name), snap)
+        name = self.snapshots.persist(key)
         if self.trace:
             self.trace.emit_span(
                 "hold.spill", t0, time.perf_counter(),
@@ -2571,6 +2907,7 @@ class SimServer:
             # a failed/killed prefix run: every coalesced fork waiting
             # on it can never be seeded — fail them with the cause
             # rather than leaving them queued forever
+            self._warm_pending.discard(t.content_key)
             for w in self._pending_prefix.pop(t.content_key, []):
                 if w.status == QUEUED and self.queue.drop(w):
                     w.error = t.error or f"prefix run {status}"
@@ -2684,40 +3021,21 @@ class SimServer:
         )
 
     def _rehydrate(self, hold: Mapping[str, Any], pin: bool):
-        """Load one spilled snapshot back into the store; returns its
-        key. Idempotent across multiple continuations of one parent.
-        The restored tree is re-pinned onto the first healthy device —
-        the shard layout the spill was captured under need not exist
-        anymore (a recovered server may have a different mesh)."""
-        from lens_tpu.checkpoint import restore_tree
-
+        """Re-pin one spilled snapshot INTO the disk tier (round 16:
+        ``adopt`` registers the existing spill without restoring it —
+        the held state is promoted lazily, at the admission that
+        actually scatters it, so recovery memory is bounded by what
+        runs instead of by everything ever held); returns its key.
+        Idempotent across multiple continuations of one parent."""
         key = key_from_json(hold["key"])
-        if key not in self.snapshots:
-            path = os.path.join(
-                self.recover_dir, SPILL_DIR, str(hold["name"])
-            )
-            if not os.path.isdir(path):
-                raise FileNotFoundError(
-                    f"held snapshot spill {path} is missing — the WAL "
-                    f"records a hold for request {hold.get('rid')!r} "
-                    f"but its spill directory is gone; recovery "
-                    f"cannot rebuild the held state"
-                )
-            target = next(
-                (
-                    k for k in range(self.n_shards)
-                    if k not in self._quarantined
-                ),
-                0,
-            )
-            self.snapshots.put(
-                key,
-                restore_tree(path, device=self.devices[target]),
-                pin=pin,
-                shard=target,
-            )
-        elif pin:
-            self.snapshots.put(key, self.snapshots.state(key), pin=True)
+        try:
+            self.snapshots.adopt(key, str(hold["name"]), pin=pin)
+        except FileNotFoundError as e:
+            raise FileNotFoundError(
+                f"{e} — the WAL records this hold for request "
+                f"{hold.get('rid')!r}; recovery cannot rebuild the "
+                f"held state"
+            ) from None
         return key
 
     def _materialize(self, rid, recs, fin, holds, released) -> None:

@@ -113,6 +113,16 @@ def tree_nbytes(tree: Any) -> int:
     return total
 
 
+#: Storage-tier names, hot to cold. The base store is DEVICE-only; the
+#: tiered subclass (lens_tpu.serve.tiers) adds host RAM and disk, but
+#: both speak the same per-tier stats vocabulary so the metrics surface
+#: is uniform.
+DEVICE = "device"
+HOST = "host"
+DISK = "disk"
+TIERS = (DEVICE, HOST, DISK)
+
+
 @dataclass
 class _Entry:
     state: Any
@@ -120,6 +130,9 @@ class _Entry:
     refs: int = 0
     used: int = 0  # LRU stamp (monotonic per store)
     shard: int = 0  # device shard whose memory holds the state tree
+    tier: str = DEVICE  # which tier holds `state` (base store: device)
+    disk_name: Optional[str] = None  # durable spill dir (tiered store)
+    warmed: bool = False  # produced/prefetched by speculative warming
 
 
 class SnapshotStore:
@@ -140,6 +153,17 @@ class SnapshotStore:
         self.budget_bytes = budget_bytes
         self._entries: Dict[SnapshotKey, _Entry] = {}
         self._clock = 0
+        # observability counters (monotonic over the store's lifetime):
+        # `rejected` — puts whose entry was NOT retained (an unpinned
+        # tree too big for the budget; before round 16 this was a
+        # silent drop); `hits`/`promotions`/`demotions` — per-tier
+        # traffic, counted at acquire/fetch/demote time (the base
+        # store only ever hits its device tier; the tiered subclass
+        # moves entries between all three).
+        self.rejected = 0
+        self.hits: Dict[str, int] = {t: 0 for t in TIERS}
+        self.promotions: Dict[str, int] = {t: 0 for t in TIERS}
+        self.demotions: Dict[str, int] = {t: 0 for t in TIERS}
         # a span tracer (lens_tpu.obs) the owning server installs:
         # inserts and budget evictions become timeline instants (a
         # thrashing store is a scheduling story, not just a counter).
@@ -197,11 +221,15 @@ class SnapshotStore:
     def acquire(self, key: SnapshotKey) -> Any:
         """Pin an entry (evicting it becomes impossible) and return its
         state. Every ``acquire`` must be paired with exactly one
-        ``release``."""
+        ``release``. Counts a hit against the tier the entry currently
+        lives in — acquire is the moment a consumer committed to these
+        bits (warming success is counted server-side, per prefix
+        submit, where the policy lives)."""
         entry = self._entries[key]
         entry.refs += 1
         self._clock += 1
         entry.used = self._clock
+        self.hits[entry.tier] += 1
         return entry.state
 
     def release(self, key: SnapshotKey) -> int:
@@ -227,6 +255,75 @@ class SnapshotStore:
         """Outstanding pins on one entry (0 for an absent key)."""
         entry = self._entries.get(key)
         return entry.refs if entry is not None else 0
+
+    def fetch(
+        self,
+        key: SnapshotKey,
+        shard: int = 0,
+        device: Any = None,
+    ) -> Any:
+        """The entry's state as a DEVICE tree ready to scatter into a
+        lane on ``shard``. In the base store every entry already is one
+        (``device``/``shard`` are advisory — ``admit_state`` migrates
+        across devices itself, a byte copy); the tiered subclass
+        PROMOTES host/disk-resident entries onto the given device
+        here. KeyError if absent, like :meth:`state`."""
+        return self.state(key)
+
+    def tier_of(self, key: SnapshotKey) -> Optional[str]:
+        """Which tier holds an entry's resident bytes (None if
+        absent)."""
+        entry = self._entries.get(key)
+        return entry.tier if entry is not None else None
+
+    def mark_warmed(self, key: SnapshotKey) -> None:
+        """Tag an entry as produced (or prefetched) by speculative
+        warming, so later hits on it count as speculative successes.
+        No-op for an absent key (an oversized warm snapshot may have
+        been rejected by the budget)."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            entry.warmed = True
+
+    def is_warmed(self, key: SnapshotKey) -> bool:
+        entry = self._entries.get(key)
+        return entry.warmed if entry is not None else False
+
+    def device_lost(self, shard: int) -> List[Tuple[SnapshotKey, int]]:
+        """A device died: every entry whose resident bytes lived in its
+        memory is gone. Returns ``[(key, orphaned_refs), ...]`` for the
+        entries LOST outright — the caller must repair every ticket
+        that held a ref (the tiered subclass saves entries with a
+        host/disk copy by demoting them instead of losing them)."""
+        lost = []
+        for key in self.keys_on_shard(shard):
+            refs = self._entries.pop(key).refs
+            lost.append((key, refs))
+        return lost
+
+    def tier_stats(self) -> Dict[str, Any]:
+        """The per-tier observability dict the server's metrics embed:
+        resident entries/bytes plus lifetime hit/promotion/demotion
+        counts per tier, and the store-wide rejected count. Uniform
+        across base and tiered stores (the base store simply never
+        populates host/disk)."""
+        resident: Dict[str, List[int]] = {t: [0, 0] for t in TIERS}
+        for e in self._entries.values():
+            resident[e.tier][0] += 1
+            resident[e.tier][1] += e.nbytes
+        return {
+            "rejected": self.rejected,
+            "tiers": {
+                t: {
+                    "entries": resident[t][0],
+                    "bytes": resident[t][1],
+                    "hits": self.hits[t],
+                    "promotions": self.promotions[t],
+                    "demotions": self.demotions[t],
+                }
+                for t in TIERS
+            },
+        }
 
     # -- writes --------------------------------------------------------------
 
@@ -272,31 +369,18 @@ class SnapshotStore:
         # newest, so only after every older evictable is gone): an
         # unpinned snapshot that cannot fit is simply not retained —
         # the caller still holds the tree for its immediate consumers.
-        return self._evict_to_budget()
-
-    def reassign(
-        self, key: SnapshotKey, state: Any, shard: int
-    ) -> None:
-        """Replace an entry's buffers in place (same content, new
-        device residency) — the failover path: a quarantined shard's
-        spilled snapshot rehydrates onto a survivor while every
-        outstanding ref (queued continuations, held parents) keeps
-        pointing at the same key."""
-        entry = self._entries[key]
-        entry.state = state
-        entry.nbytes = tree_nbytes(state)
-        entry.shard = int(shard)
-        self._clock += 1
-        entry.used = self._clock
-
-    def discard(self, key: SnapshotKey) -> int:
-        """Forget an entry EVEN IF PINNED; returns the orphaned ref
-        count. Reserved for device loss (the buffers are gone no
-        matter who still holds a pin) — the caller must repair every
-        ticket that held a ref, which is why the count comes back.
-        ``drop`` stays the checked single-device path."""
-        entry = self._entries.pop(key, None)
-        return entry.refs if entry is not None else 0
+        # Counted (`rejected`, additive to the eviction count the
+        # return value always carried) rather than silently dropped: a
+        # store whose budget rejects every insert serves zero hits
+        # while looking healthy on the hit counters alone.
+        evicted = self._evict_to_budget()
+        if key not in self._entries:
+            self.rejected += 1
+            if self.trace:
+                self.trace.instant(
+                    "snapshot.rejected", bytes=entry.nbytes,
+                )
+        return evicted
 
     def drop(self, key: SnapshotKey) -> None:
         """Forget an unpinned entry now (explicit invalidation)."""

@@ -1,0 +1,514 @@
+"""Tiered snapshot store: device -> host RAM -> disk paging for
+scenario prefixes, with durable disk entries that survive restarts.
+
+Round 11's :class:`~lens_tpu.serve.snapshots.SnapshotStore` treats
+device RAM as the only home a snapshot can have: the byte budget
+EVICTS warm state outright, and every entry dies with the process —
+a restarted server recomputes every popular prefix from t=0. This
+module is the paged-KV-cache shape (an LLM server demotes cold KV
+blocks to host memory and pages them back on a hit) applied to the
+simulation-state cache, built from two pieces the repo already had:
+
+- **host tier** — demotion is one ``jax.device_get`` (started async
+  via the shared :func:`~lens_tpu.utils.hostio.copy_tree_to_host_async`
+  hint), promotion one ``jax.device_put`` onto the admitting shard's
+  device. Bits are placement-independent, so a demote/promote
+  round-trip is bitwise free — pinned by tests/test_tiers.py.
+- **disk tier** — the round-12 held-snapshot spill protocol
+  (:func:`lens_tpu.checkpoint.save_tree`, tmp+rename) promoted from a
+  recovery side-channel to a first-class storage tier. A WAL hold
+  spill and a budget demotion now produce the SAME on-disk object
+  (``snap_<digest>/`` under the spill dir) plus a ``.meta.json``
+  sidecar recording the content address, so a fresh server over the
+  same directory re-adopts every content-addressed entry at
+  construction and serves repeat traffic with warm disk hits — no WAL
+  required, and recovery re-pins held spills INTO the tier instead of
+  eagerly rehydrating them to device RAM (recovery memory stays
+  bounded by what actually gets scattered).
+
+Eviction becomes demotion: past the device byte budget, LRU entries
+move device->host (unpinned first; pinned entries may demote too —
+demotion never loses bits, so a held state parked on disk is still a
+held state); past the host budget they move host->disk; only an entry
+with nowhere lower to go is dropped (and only unpinned ones may be).
+A hit on a lower tier promotes back to the device tier at admission
+(:meth:`TieredSnapshotStore.fetch` — the server passes the admitting
+shard's device, so mesh placement rules ride along unchanged).
+
+Tiers off == round 15: the server only constructs this class when a
+host budget, a tier dir, or a recover dir is given; and with
+``demote_to_disk=False`` + ``host_budget_bytes=0`` (the plain
+``recover_dir`` shape) demotion degenerates to the base store's
+evict-unpinned/keep-pinned behavior exactly, with the disk tier used
+only for explicit hold spills (:meth:`persist`) and recovery adoption
+(:meth:`adopt`).
+
+See docs/serving.md, "Tiered snapshots & speculative warming".
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from lens_tpu.serve.snapshots import (
+    DEVICE,
+    DISK,
+    HOST,
+    SnapshotKey,
+    SnapshotStore,
+    _Entry,
+    tree_nbytes,
+)
+from lens_tpu.serve.wal import key_from_json, key_to_json, spill_name
+from lens_tpu.utils.hostio import copy_tree_to_host_async
+
+#: The tier directory's identity file: recovering prefixes into a
+#: server whose buckets would compute DIFFERENT bits must be refused,
+#: exactly like the WAL's begin-fingerprint check (a disk entry's
+#: content address includes the bucket NAME, not its bits-relevant
+#: config, so the directory itself carries the fingerprint).
+TIER_META = "tier_meta.json"
+
+_META_SUFFIX = ".meta.json"
+
+
+class TieredSnapshotStore(SnapshotStore):
+    """Device -> host -> disk snapshot paging over the base store.
+
+    Parameters
+    ----------
+    budget_bytes:
+        Device-tier byte budget (None = unbounded, like the base
+        store). Past it, LRU entries DEMOTE instead of evicting.
+    host_budget_bytes:
+        Host-RAM tier byte budget. ``0`` (default) disables the host
+        tier — device demotions go straight to disk (or evict, when
+        there is no disk tier either).
+    dir:
+        Disk-tier directory: spill dirs (``snap_<digest>/``, the
+        checkpoint rename protocol) plus one ``.meta.json`` sidecar
+        per entry. ``None`` = no disk tier.
+    demote_to_disk:
+        Whether BUDGET pressure may write to disk. ``False`` is the
+        plain-``recover_dir`` compatibility mode: the disk tier only
+        holds explicit spills (``persist``/``adopt``), ordinary
+        eviction behaves exactly like the round-15 store, and the
+        construction-time sidecar scan is skipped.
+    fingerprint:
+        The server's bits-relevant bucket fingerprint
+        (:func:`lens_tpu.serve.wal.buckets_fingerprint`), pinned into
+        (or verified against) ``<dir>/tier_meta.json``. A mismatch is
+        refused at construction — stale snapshots from a different
+        simulation must not serve hits under new keys.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: Optional[int] = None,
+        host_budget_bytes: int = 0,
+        dir: Optional[str] = None,
+        demote_to_disk: bool = True,
+        fingerprint: Optional[str] = None,
+    ):
+        super().__init__(budget_bytes=budget_bytes)
+        if host_budget_bytes < 0:
+            raise ValueError(
+                f"host_budget_bytes={host_budget_bytes} must be >= 0"
+            )
+        self.host_budget_bytes = int(host_budget_bytes)
+        self.dir = os.path.abspath(dir) if dir else None
+        self.demote_to_disk = bool(demote_to_disk) and self.dir is not None
+        if self.dir is not None:
+            os.makedirs(self.dir, exist_ok=True)
+            if fingerprint is not None:
+                self._check_fingerprint(fingerprint)
+        if self.demote_to_disk:
+            self._scan_dir()
+
+    @property
+    def tiers_armed(self) -> bool:
+        """Whether paging is actually in play (a host budget or disk
+        demotion) — what gates the per-tier metrics export. False in
+        the plain-``recover_dir`` compatibility shape, whose disk use
+        (hold spills only) keeps the round-15 export surface."""
+        return self.demote_to_disk or self.host_budget_bytes > 0
+
+    # -- disk-tier plumbing --------------------------------------------------
+
+    def _check_fingerprint(self, fingerprint: str) -> None:
+        path = os.path.join(self.dir, TIER_META)
+        if os.path.exists(path):
+            with open(path) as f:
+                have = json.load(f).get("fingerprint")
+            if have != fingerprint:
+                raise ValueError(
+                    f"{self.dir} holds snapshots for a server with "
+                    f"bucket fingerprint {have!r}, not "
+                    f"{fingerprint!r} — the bucket configuration "
+                    f"changed in a bits-relevant way, so its cached "
+                    f"prefixes would serve a different simulation. "
+                    f"Use a fresh tier dir (or restore the original "
+                    f"buckets)."
+                )
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"fingerprint": fingerprint}, f)
+        os.replace(tmp, path)
+
+    def _spill_path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def _write_sidecar(self, name: str, key: SnapshotKey,
+                       nbytes: int) -> None:
+        path = self._spill_path(name) + _META_SUFFIX
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({
+                "key": key_to_json(key),
+                "nbytes": int(nbytes),
+                # only CONTENT-ADDRESSED entries (the 5-coordinate
+                # snapshot_key form) may be re-adopted by a fresh
+                # server's scan: a per-request ("held", rid) key is
+                # only meaningful to the WAL that recorded the rid —
+                # a new server's id space would collide with it
+                "content_addressed": len(key) == 5,
+            }, f)
+        os.replace(tmp, path)
+
+    def _scan_dir(self) -> None:
+        """Adopt every content-addressed spill the directory already
+        holds (unpinned disk-tier entries) — the restart-warm path: a
+        rebooted server serves repeat prefixes from disk without
+        recomputing them. Torn spills (sidecar without its data dir,
+        or vice versa) are skipped; the rename protocol guarantees a
+        present data dir is complete."""
+        for meta in sorted(
+            glob.glob(os.path.join(self.dir, f"snap_*{_META_SUFFIX}"))
+        ):
+            try:
+                with open(meta) as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue  # torn sidecar: the entry never happened
+            if not data.get("content_addressed"):
+                continue
+            name = os.path.basename(meta)[: -len(_META_SUFFIX)]
+            if not os.path.isdir(self._spill_path(name)):
+                continue  # sidecar outlived its spill
+            key = key_from_json(data.get("key"))
+            if key in self._entries:
+                continue
+            self._clock += 1
+            self._entries[key] = _Entry(
+                state=None,
+                nbytes=int(data.get("nbytes", 0)),
+                used=self._clock,
+                tier=DISK,
+                disk_name=name,
+            )
+
+    def persist(self, key: SnapshotKey) -> str:
+        """Ensure a durable disk copy of one entry (the unified spill:
+        WAL hold spills and budget demotions write the same object);
+        returns the spill-directory name. Idempotent — an entry whose
+        ``disk_name`` is already set is already durable (the content
+        address guarantees the bytes match). The entry's RESIDENCY is
+        untouched: a device-tier entry stays device-resident with a
+        disk copy behind it."""
+        entry = self._entries[key]
+        if entry.disk_name is not None:
+            return entry.disk_name
+        if self.dir is None:
+            raise RuntimeError(
+                f"cannot persist snapshot {key!r}: the store has no "
+                f"disk tier (no dir configured)"
+            )
+        from lens_tpu.checkpoint import save_tree
+
+        name = spill_name(key)
+        save_tree(self._spill_path(name), entry.state)
+        self._write_sidecar(name, key, entry.nbytes)
+        entry.disk_name = name
+        return name
+
+    def adopt(
+        self,
+        key: SnapshotKey,
+        name: str,
+        pin: bool = False,
+        warmed: bool = False,
+    ) -> None:
+        """Register an EXISTING spill as a disk-tier entry without
+        restoring it (WAL recovery's re-pin path: the held state is
+        promoted lazily, at the admission that actually scatters it,
+        so recovery memory is bounded by what runs — not by what was
+        ever held). Idempotent across multiple continuations of one
+        parent: a present entry just absorbs the pin."""
+        entry = self._entries.get(key)
+        if entry is not None:
+            if entry.disk_name is None:
+                entry.disk_name = str(name)
+            if pin:
+                entry.refs += 1
+            self._clock += 1
+            entry.used = self._clock
+            return
+        path = self._spill_path(str(name))
+        if not os.path.isdir(path):
+            raise FileNotFoundError(
+                f"held snapshot spill {path} is missing — a hold is "
+                f"recorded for snapshot {key!r} but its spill "
+                f"directory is gone; the held state cannot be rebuilt"
+            )
+        nbytes = 0
+        try:
+            with open(path + _META_SUFFIX) as f:
+                nbytes = int(json.load(f).get("nbytes", 0))
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass  # pre-round-16 spill: no sidecar; sized at promotion
+        self._clock += 1
+        self._entries[key] = _Entry(
+            state=None,
+            nbytes=nbytes,
+            refs=1 if pin else 0,
+            used=self._clock,
+            tier=DISK,
+            disk_name=str(name),
+            warmed=warmed,
+        )
+
+    # -- tier-aware reads ----------------------------------------------------
+
+    def _tier_bytes(self, tier: str) -> int:
+        return sum(
+            e.nbytes for e in self._entries.values() if e.tier == tier
+        )
+
+    def resident_bytes(self, shard: Optional[int] = None) -> int:
+        """RAM actually held (device + host tiers; disk entries cost no
+        memory). With ``shard``, the device-tier bytes on that shard —
+        what the per-shard mesh gauges report."""
+        if shard is not None:
+            return sum(
+                e.nbytes
+                for e in self._entries.values()
+                if e.tier == DEVICE and e.shard == shard
+            )
+        return sum(
+            e.nbytes
+            for e in self._entries.values()
+            if e.tier in (DEVICE, HOST)
+        )
+
+    def shard_of(self, key: SnapshotKey) -> Optional[int]:
+        """The device shard owning an entry's buffers — only
+        meaningful while the entry is device-resident (a host/disk
+        entry can promote onto ANY shard, so admission placement is
+        free to balance)."""
+        entry = self._entries.get(key)
+        if entry is None or entry.tier != DEVICE:
+            return None
+        return entry.shard
+
+    def keys_on_shard(self, shard: int) -> List[SnapshotKey]:
+        return [
+            k
+            for k, e in self._entries.items()
+            if e.tier == DEVICE and e.shard == shard
+        ]
+
+    def state(self, key: SnapshotKey) -> Any:
+        """The cached state as a DEVICE tree (promoting from a lower
+        tier onto the DEFAULT device if needed, recorded as shard 0 —
+        the residency bookkeeping must name where the buffers actually
+        land, and the pre-demotion shard index is stale by now) — kept
+        for callers that predate placement-aware :meth:`fetch`; the
+        server's admission path always fetches with an explicit
+        shard/device."""
+        return self.fetch(key, shard=0)
+
+    def fetch(
+        self,
+        key: SnapshotKey,
+        shard: int = 0,
+        device: Any = None,
+    ) -> Any:
+        """The entry's state as a device tree on ``device``, PROMOTING
+        a host/disk-resident entry back to the device tier (host: one
+        ``device_put``; disk: ``restore_tree`` straight onto the
+        target). The promotion is counted against the SOURCE tier and
+        may itself demote colder device entries to stay under the
+        device budget — paging, not growth."""
+        entry = self._entries[key]
+        self._clock += 1
+        entry.used = self._clock
+        if entry.tier == DEVICE:
+            return entry.state
+        src = entry.tier
+        if src == HOST:
+            state = jax.device_put(entry.state, device)
+        else:
+            from lens_tpu.checkpoint import restore_tree
+
+            state = restore_tree(
+                self._spill_path(entry.disk_name), device=device
+            )
+        entry.state = state
+        entry.tier = DEVICE
+        entry.shard = int(shard)
+        entry.nbytes = tree_nbytes(state)
+        self.promotions[src] += 1
+        if self.trace:
+            self.trace.instant(
+                "snapshot.promote", tier=src, shard=int(shard),
+                bytes=entry.nbytes,
+            )
+        self._evict_to_budget()
+        return state
+
+    # -- writes --------------------------------------------------------------
+
+    def put(
+        self,
+        key: SnapshotKey,
+        state: Any,
+        pin: bool = False,
+        shard: int = 0,
+    ) -> int:
+        """Base-store semantics, plus: inserting a key that is
+        currently host/disk-resident upgrades its residency in place —
+        the caller just recomputed (or captured) the same bits on
+        device, so the store takes the free promotion instead of
+        keeping the colder copy authoritative."""
+        entry = self._entries.get(key)
+        if entry is not None and entry.tier != DEVICE:
+            entry.state = state
+            entry.tier = DEVICE
+            entry.shard = int(shard)
+            entry.nbytes = tree_nbytes(state)
+            self._clock += 1
+            entry.used = self._clock
+            if pin:
+                entry.refs += 1
+            return self._evict_to_budget()
+        return super().put(key, state, pin=pin, shard=shard)
+
+    def device_lost(self, shard: int) -> List[Tuple[SnapshotKey, int]]:
+        """A device died. Entries whose only bytes lived there but
+        have a durable disk copy DEMOTE to the disk tier (same key,
+        same refs — a queued continuation's pin keeps working and the
+        admission that scatters it restores onto a survivor); entries
+        without one are lost, returned as ``(key, orphaned_refs)`` for
+        the server to repair. Host/disk-resident entries are
+        untouched — they never depended on the dead device."""
+        lost: List[Tuple[SnapshotKey, int]] = []
+        for key in self.keys_on_shard(shard):
+            entry = self._entries[key]
+            if entry.disk_name is not None and os.path.isdir(
+                self._spill_path(entry.disk_name)
+            ):  # trust a spill only if it still exists on disk
+                entry.state = None
+                entry.tier = DISK
+                self.demotions[DEVICE] += 1
+                if self.trace:
+                    self.trace.instant(
+                        "snapshot.demote", tier=DEVICE, to=DISK,
+                        bytes=entry.nbytes, reason="device_lost",
+                    )
+            else:
+                lost.append((key, entry.refs))
+                del self._entries[key]
+        return lost
+
+    # -- demotion (the budget enforcer) --------------------------------------
+
+    def _evict_to_budget(self) -> int:
+        """Enforce both RAM budgets, coldest-first: device excess
+        demotes to host (or straight to disk when the host tier is
+        disabled), then host excess demotes to disk. Only entries with
+        nowhere lower to go are dropped — unpinned ones count in the
+        returned eviction total (the ``snapshot_evictions`` feed);
+        pinned undemotable entries stay and overshoot, exactly like
+        the base store."""
+        evicted = self._shrink_tier(DEVICE, self.budget_bytes)
+        evicted += self._shrink_tier(HOST, self.host_budget_bytes)
+        if evicted and self.trace:
+            self.trace.instant("snapshot.evicted", count=evicted)
+        return evicted
+
+    def _shrink_tier(self, tier: str, budget: Optional[int]) -> int:
+        if budget is None:
+            return 0
+        excess = self._tier_bytes(tier) - budget
+        if excess <= 0:
+            return 0
+        # unpinned LRU first (they cost nothing to lose), pinned LRU
+        # after (demotable only — demotion preserves their bits)
+        victims = sorted(
+            (e.refs > 0, e.used, k)
+            for k, e in self._entries.items()
+            if e.tier == tier
+        )
+        if tier == DEVICE:
+            # start every prospective victim's device->host DMA before
+            # the first blocking device_get — the copies overlap
+            remaining = excess
+            for _, _, key in victims:
+                if remaining <= 0:
+                    break
+                e = self._entries[key]
+                copy_tree_to_host_async(e.state)
+                remaining -= e.nbytes
+        evicted = 0
+        for pinned, _, key in victims:
+            if excess <= 0:
+                break
+            entry = self._entries[key]
+            nbytes = entry.nbytes
+            if self._demote(key, entry):
+                excess -= nbytes
+            elif not pinned:
+                del self._entries[key]
+                evicted += 1
+                excess -= nbytes
+            # pinned with nowhere to go: stays, budget overshoots
+        return evicted
+
+    def _demote(self, key: SnapshotKey, entry: _Entry) -> bool:
+        """Move one entry a tier down; False when no lower tier will
+        take it (then eviction rules apply)."""
+        src = entry.tier
+        if src == DEVICE and self.host_budget_bytes > 0:
+            target = HOST
+        elif self.demote_to_disk:
+            # an already-durable entry (a spilled hold) just drops its
+            # RAM copy; others persist first — but only when disk
+            # PAGING is armed: the plain-recover_dir compatibility
+            # shape keeps round-15 residency behavior exactly (pinned
+            # entries overshoot the budget and stay device-resident;
+            # device LOSS still falls back to a hold's spill, that
+            # path does not come through here)
+            target = DISK
+        else:
+            return False
+        if target == HOST:
+            entry.state = jax.device_get(entry.state)
+            entry.tier = HOST
+        else:
+            if entry.disk_name is None:
+                self.persist(key)
+            entry.state = None
+            entry.tier = DISK
+        self.demotions[src] += 1
+        if self.trace:
+            self.trace.instant(
+                "snapshot.demote", tier=src, to=target,
+                bytes=entry.nbytes,
+            )
+        return True

@@ -252,6 +252,13 @@ class _ServerSweep:
         backend = dict(spec.backend or {})
         backend.pop("kind", None)
         self.max_in_flight = backend.pop("max_in_flight", None)
+        # speculative warming (round 16): the driver KNOWS the first
+        # thing every trial will need — the shared warmup prefix — so
+        # `"warm": true` pre-launches it via SimServer.prewarm before
+        # the first trial submits. A scheduling knob: it changes when
+        # the prefix runs, never any trial's bits (and so stays out of
+        # the resume fingerprint, like lanes/window).
+        self.warm = bool(backend.pop("warm", False))
         self.owns_server = server is None
         if server is None:
             # a driver-owned store needs a finite budget: released
@@ -425,6 +432,18 @@ class _ServerSweep:
             on_trial(index, event)
 
     def run(self, on_trial=None) -> Tuple[Dict[int, Dict], Dict[str, Any]]:
+        if self.warm and self.warmup is not None:
+            # prewarm the shared warmup prefix: the first trial
+            # submits moments later and COALESCES onto the warm run
+            # (a speculative hit) instead of paying the miss on its
+            # own latency path. n_agents deliberately None — trials
+            # submit with None too, so the content addresses match.
+            self.server.prewarm(
+                composite=self.spec.composite,
+                seed=int(self.warmup.get("seed", self.spec.seed)),
+                horizon=float(self.warmup["horizon"]),
+                overrides=self.warmup.get("overrides") or {},
+            )
         if self.spec.asha:
             ts = self._run_halving(on_trial)
         else:

@@ -40,6 +40,7 @@ tests/test_colony.py
 tests/test_serve.py
 tests/test_streamer.py
 tests/test_snapshots.py
+tests/test_tiers.py
 tests/test_faults.py
 tests/test_recovery.py
 tests/test_frontdoor.py
@@ -64,7 +65,7 @@ BATCHES=(
   "tests/test_adi.py"
   "tests/test_parallel.py tests/test_distributed.py"
   "tests/test_multispecies.py tests/test_ensemble.py"
-  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_faults.py tests/test_recovery.py tests/test_frontdoor.py tests/test_metrics.py tests/test_obs.py"
+  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_tiers.py tests/test_faults.py tests/test_recovery.py tests/test_frontdoor.py tests/test_metrics.py tests/test_obs.py"
   "tests/test_sweep.py tests/test_cli.py"
   "tests/test_experiment.py"
   "tests/test_bridge.py"

@@ -96,6 +96,28 @@ class TestParsing:
         )
         assert args.snapshot_budget_mb == 16.5
 
+    def test_serve_tier_and_warm_args(self):
+        # defaults: tiers and warming off (the round-15 serve shape)
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json"]
+        )
+        assert args.host_budget_mb is None
+        assert args.tier_dir is None
+        assert args.warm is False
+        args = _build_parser().parse_args(
+            ["serve", "--requests", "r.json",
+             "--host-budget-mb", "64", "--tier-dir", "/tmp/tier",
+             "--warm"]
+        )
+        assert args.host_budget_mb == 64.0
+        assert args.tier_dir == "/tmp/tier"
+        assert args.warm is True
+        # frontdoor shares the server knob set, warming included
+        args = _build_parser().parse_args(
+            ["frontdoor", "--host-budget-mb", "8", "--warm"]
+        )
+        assert args.host_budget_mb == 8.0 and args.warm is True
+
     def test_serve_fault_tolerance_args(self):
         """Round 12: quarantine / watchdog / WAL / fault-plan flags."""
         args = _build_parser().parse_args(

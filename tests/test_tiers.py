@@ -1,0 +1,780 @@
+"""Tiered snapshot store + speculative warming (round 16).
+
+The contracts, in this repo's bitwise culture:
+
+- paging is invisible to results: a demote/promote round-trip through
+  any tier returns the exact bytes that went in, so a fork seeded from
+  a host- or disk-resident snapshot is BITWISE the fork a device hit
+  would have produced (which is itself bitwise the tail of a cold solo
+  run — round 11's pin, inherited);
+- the disk tier is durable: a server killed (or simply gone) and
+  rebuilt over the same directory serves repeat prefixes from disk —
+  zero prefix misses, >0 disk-tier hits, same bytes;
+- speculative warming changes WORK PLACEMENT only: warmed serving is
+  bitwise unwarmed serving, warm lanes are preempted the moment a
+  client wants the lane, and a preempted-then-resumed warm run's
+  snapshot equals an uninterrupted one's.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lens_tpu.serve import (
+    DONE,
+    ScenarioRequest,
+    SimServer,
+    SnapshotStore,
+    TieredSnapshotStore,
+)
+from lens_tpu.serve.snapshots import DEVICE, DISK, HOST
+from lens_tpu.serve.tiers import TIER_META
+
+
+def _leaves_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+def _tail(ts, n):
+    return jax.tree.map(lambda x: np.asarray(x)[-n:], ts)
+
+
+def _state(nbytes=800, fill=0.0):
+    return {"x": jnp.full(nbytes // 4, float(fill), jnp.float32)}
+
+
+def _toggle_server(**kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+class TestTieredStoreUnit:
+    """Pure store mechanics: demotion order, promotion, durability."""
+
+    def test_device_overflow_demotes_lru_to_host(self):
+        store = TieredSnapshotStore(
+            budget_bytes=2000, host_budget_bytes=4000
+        )
+        for i in range(3):  # 800 each: the third insert demotes ONE
+            store.put(("k", i), _state(fill=i))
+        assert store.tier_of(("k", 0)) == HOST  # LRU went down first
+        assert store.tier_of(("k", 1)) == DEVICE
+        assert store.tier_of(("k", 2)) == DEVICE
+        stats = store.tier_stats()
+        assert stats["tiers"][DEVICE]["demotions"] == 1
+        assert stats["tiers"][HOST]["entries"] == 1
+        assert len(store) == 3  # nothing evicted, only demoted
+
+    def test_host_overflow_cascades_to_disk(self, tmp_path):
+        store = TieredSnapshotStore(
+            budget_bytes=2000, host_budget_bytes=800,
+            dir=str(tmp_path / "tier"),
+        )
+        for i in range(4):
+            store.put(("k", i), _state(fill=i))
+        tiers = {i: store.tier_of(("k", i)) for i in range(4)}
+        assert tiers == {0: DISK, 1: HOST, 2: DEVICE, 3: DEVICE}
+        entry_dirs = [
+            p for p in os.listdir(tmp_path / "tier")
+            if p.startswith("snap_") and not p.endswith(".meta.json")
+        ]
+        assert len(entry_dirs) == 1  # the disk entry's spill landed
+        assert store.tier_stats()["tiers"][HOST]["demotions"] == 1
+
+    def test_fetch_promotes_bitwise_from_every_tier(self, tmp_path):
+        store = TieredSnapshotStore(
+            budget_bytes=900, host_budget_bytes=900,
+            dir=str(tmp_path / "tier"),
+        )
+        originals = {}
+        for i in range(3):
+            originals[i] = _state(fill=10 + i)
+            store.put(("k", i), originals[i])
+        assert store.tier_of(("k", 0)) == DISK
+        assert store.tier_of(("k", 1)) == HOST
+        assert store.tier_of(("k", 2)) == DEVICE
+        for i in (0, 1, 2):
+            got = store.fetch(("k", i))
+            assert _leaves_equal(got, originals[i])
+        stats = store.tier_stats()["tiers"]
+        # every fetch promoted from a lower tier (each promotion
+        # cascades colder entries down, so the exact source tiers
+        # shift — the TOTAL is what the budget math guarantees)
+        assert stats[DISK]["promotions"] >= 1
+        assert (
+            stats[HOST]["promotions"] + stats[DISK]["promotions"] == 3
+        )
+
+    def test_pinned_entries_demote_but_never_drop(self, tmp_path):
+        store = TieredSnapshotStore(
+            budget_bytes=900, host_budget_bytes=0,
+            dir=str(tmp_path / "tier"),
+        )
+        pinned = _state(fill=7)
+        store.put(("pin",), pinned, pin=True)
+        store.put(("cache", 0), _state(fill=8))
+        # unpinned entries page first: the cache entry demoted
+        # straight to disk (host tier disabled), the pinned one stays
+        assert store.tier_of(("cache", 0)) == DISK
+        assert store.tier_of(("pin",)) == DEVICE
+        # but pins do NOT anchor an entry to device RAM the way they
+        # anchored it to existence: under pressure from another pin,
+        # the LRU pinned entry demotes too — refs intact, bits intact
+        store.put(("pin", 2), _state(fill=9), pin=True)
+        assert store.tier_of(("pin",)) == DISK
+        assert store.refs(("pin",)) == 1
+        assert _leaves_equal(store.fetch(("pin",)), pinned)
+        store.release(("pin",))
+        store.release(("pin", 2))
+
+    def test_no_lower_tier_keeps_round15_eviction(self):
+        # host tier off, no dir: the tiered store must degrade to the
+        # flat store's behavior exactly — evict unpinned, keep pinned
+        store = TieredSnapshotStore(budget_bytes=2000)
+        store.put(("pin", 0), _state(), pin=True)
+        store.put(("pin", 1), _state(), pin=True)
+        assert store.put(("cache", 0), _state()) == 1
+        assert ("cache", 0) not in store
+        assert store.rejected == 1
+        assert ("pin", 0) in store and ("pin", 1) in store
+
+    def test_oversized_put_counts_rejected(self):
+        # the round-16 satellite: the silent drop is now counted, on
+        # the flat store too
+        store = SnapshotStore(budget_bytes=100)
+        assert store.put(("big",), _state(800)) == 1
+        assert len(store) == 0
+        assert store.rejected == 1
+        assert store.tier_stats()["rejected"] == 1
+
+    def test_compat_mode_disk_is_spill_only(self, tmp_path):
+        # demote_to_disk=False (a plain recover_dir): budget pressure
+        # must NOT page to disk — only explicit persist/adopt touches
+        # it, and eviction behaves like round 15
+        store = TieredSnapshotStore(
+            budget_bytes=900, dir=str(tmp_path / "tier"),
+            demote_to_disk=False,
+        )
+        store.put(("k", 0), _state(fill=1))
+        store.put(("k", 1), _state(fill=2))
+        assert ("k", 0) not in store  # evicted, not paged
+        name = store.persist(("k", 1))
+        assert os.path.isdir(tmp_path / "tier" / name)
+        # a PINNED spilled hold keeps round-15 residency under budget
+        # pressure: it overshoots and stays device-resident (no
+        # silent restore_tree on a later resubmit's latency path)
+        store.put(("pin",), _state(fill=3), pin=True)
+        store.persist(("pin",))
+        store.put(("k", 2), _state(fill=4))
+        assert store.tier_of(("pin",)) == DEVICE
+        store.release(("pin",))
+        # a fresh compat-mode store does NOT scan-adopt
+        again = TieredSnapshotStore(
+            budget_bytes=900, dir=str(tmp_path / "tier"),
+            demote_to_disk=False,
+        )
+        assert ("k", 1) not in again
+
+    def test_scan_adopts_content_addressed_entries_only(self, tmp_path):
+        from lens_tpu.serve.snapshots import snapshot_key
+
+        tier = str(tmp_path / "tier")
+        store = TieredSnapshotStore(
+            budget_bytes=0, host_budget_bytes=0, dir=tier,
+        )
+        ck = snapshot_key("bucket", 3, 1, {"g": {"x": 1.0}}, 8)
+        content = _state(fill=3)
+        store.put(ck, content)  # budget 0: demotes straight to disk
+        assert store.tier_of(ck) == DISK
+        held = _state(fill=4)
+        store.put(("held", "req-000001"), held, pin=True)
+        store.persist(("held", "req-000001"))
+
+        fresh = TieredSnapshotStore(
+            budget_bytes=0, host_budget_bytes=0, dir=tier,
+        )
+        # the content-addressed entry came back, durable
+        assert fresh.tier_of(ck) == DISK
+        assert _leaves_equal(fresh.fetch(ck), content)
+        # the per-request held key did NOT (a new server's rid space
+        # would collide with it); WAL replay is its only way back
+        assert ("held", "req-000001") not in fresh
+        fresh.adopt(
+            ("held", "req-000001"),
+            store._entries[("held", "req-000001")].disk_name,
+            pin=True,
+        )
+        assert _leaves_equal(
+            fresh.fetch(("held", "req-000001")), held
+        )
+
+    def test_adopt_missing_spill_raises(self, tmp_path):
+        store = TieredSnapshotStore(dir=str(tmp_path / "tier"))
+        with pytest.raises(FileNotFoundError, match="missing"):
+            store.adopt(("k",), "snap_nope")
+
+    def test_fingerprint_mismatch_refused(self, tmp_path):
+        tier = str(tmp_path / "tier")
+        TieredSnapshotStore(dir=tier, fingerprint="aaaa")
+        with pytest.raises(ValueError, match="fingerprint"):
+            TieredSnapshotStore(dir=tier, fingerprint="bbbb")
+        assert os.path.exists(os.path.join(tier, TIER_META))
+
+    def test_device_lost_demotes_durable_entries(self, tmp_path):
+        store = TieredSnapshotStore(dir=str(tmp_path / "tier"))
+        store.put(("durable",), _state(fill=1), pin=True, shard=1)
+        store.persist(("durable",))
+        store.put(("volatile",), _state(fill=2), pin=True, shard=1)
+        store.put(("elsewhere",), _state(fill=3), shard=0)
+        lost = store.device_lost(1)
+        assert lost == [(("volatile",), 1)]
+        assert store.tier_of(("durable",)) == DISK
+        assert store.refs(("durable",)) == 1  # pins survive demotion
+        assert store.tier_of(("elsewhere",)) == DEVICE
+
+    def test_refcounts_exact_across_paging(self, tmp_path):
+        store = TieredSnapshotStore(
+            budget_bytes=0, host_budget_bytes=0,
+            dir=str(tmp_path / "tier"),
+        )
+        store.put(("k",), _state(), pin=True)
+        assert store.tier_of(("k",)) == DISK
+        store.acquire(("k",))
+        assert store.refs(("k",)) == 2
+        store.release(("k",))
+        store.release(("k",))
+        with pytest.raises(RuntimeError, match="double release"):
+            store.release(("k",))
+        assert store.refs_total() == 0
+
+
+class TestTieredServing:
+    """The store under the server: paging must be invisible to bits."""
+
+    PREFIX = 8.0
+    HORIZON = 16.0
+
+    def _fork(self, seed, volume=None):
+        return ScenarioRequest(
+            composite="toggle_colony",
+            seed=seed,
+            horizon=self.HORIZON,
+            prefix={
+                "horizon": self.PREFIX,
+                "overrides": {"global": {"volume": 1.05}},
+            },
+            overrides=(
+                {"global": {"volume": volume}} if volume else {}
+            ),
+        )
+
+    def test_demoted_prefix_hits_promote_bitwise(self, tmp_path):
+        # ~668-byte toggle snapshots; ~1 KiB device and host budgets
+        # hold ONE each — three distinct prefixes force constant
+        # paging across all three tiers, and nothing may be lost
+        srv = _toggle_server(
+            snapshot_budget_mb=0.001, host_budget_mb=0.001,
+            tier_dir=str(tmp_path / "tier"),
+        )
+        first = {
+            s: srv.submit(self._fork(s)) for s in (1, 2, 3)
+        }
+        srv.run_until_idle(max_ticks=500)
+        repeat = {
+            s: srv.submit(self._fork(s)) for s in (1, 2, 3)
+        }
+        srv.run_until_idle(max_ticks=500)
+        m = srv.metrics()
+        assert m["counters"]["prefix_hits"] == 3  # repeats all hit
+        tiers = m["snapshot_tiers"]
+        # at least one repeat was served from a demoted tier and
+        # promoted back (budget fits one: two of three MUST page)
+        assert (
+            tiers[HOST]["promotions"] + tiers[DISK]["promotions"] > 0
+            or tiers[HOST]["hits"] + tiers[DISK]["hits"] > 0
+        )
+        for s in (1, 2, 3):
+            assert _leaves_equal(
+                srv.result(first[s]), srv.result(repeat[s])
+            )
+        # pure fork (no divergent overrides): the suffix is bitwise
+        # the tail of a cold solo run under the prefix overrides
+        solo_srv = _toggle_server()
+        solo = solo_srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=self.HORIZON,
+            overrides={"global": {"volume": 1.05}},
+        ))
+        solo_srv.run_until_idle(max_ticks=200)
+        suffix_rows = int(self.HORIZON - self.PREFIX)
+        assert _leaves_equal(
+            srv.result(repeat[1]),
+            _tail(solo_srv.result(solo), suffix_rows),
+        )
+        solo_srv.close()
+        srv.close()
+
+    def test_tiers_off_is_the_flat_store(self):
+        off = _toggle_server()
+        assert type(off.snapshots) is SnapshotStore
+        off.close()
+        on = _toggle_server(host_budget_mb=1)
+        assert isinstance(on.snapshots, TieredSnapshotStore)
+        on.close()
+
+    def test_disk_tier_survives_crash_and_restart(self, tmp_path):
+        tier = str(tmp_path / "tier")
+        kw = dict(
+            snapshot_budget_mb=0, host_budget_mb=0, tier_dir=tier,
+        )
+        srv = _toggle_server(**kw)
+        a = srv.submit(self._fork(5, volume=1.1))
+        srv.run_until_idle(max_ticks=200)
+        ref = srv.result(a)
+        if srv._streamer is not None:
+            srv._streamer.drain()
+        del srv  # crash: no close, the disk tier must not care
+
+        srv2 = _toggle_server(**kw)
+        b = srv2.submit(self._fork(5, volume=1.1))
+        srv2.run_until_idle(max_ticks=200)
+        m = srv2.metrics()
+        assert m["counters"]["prefix_misses"] == 0
+        assert m["counters"]["prefix_hits"] == 1
+        assert m["snapshot_tiers"][DISK]["hits"] == 1
+        assert _leaves_equal(ref, srv2.result(b))
+        srv2.close()
+
+    def test_changed_bucket_config_refuses_stale_tier_dir(
+        self, tmp_path
+    ):
+        tier = str(tmp_path / "tier")
+        srv = _toggle_server(host_budget_mb=1, tier_dir=tier)
+        srv.close()
+        with pytest.raises(ValueError, match="fingerprint"):
+            _toggle_server(
+                host_budget_mb=1, tier_dir=tier, capacity=32
+            )
+
+    def test_metrics_surface(self, tmp_path):
+        srv = _toggle_server(
+            snapshot_budget_mb=0, host_budget_mb=0,
+            tier_dir=str(tmp_path / "tier"),
+        )
+        rid = srv.submit(self._fork(1))
+        srv.run_until_idle(max_ticks=200)
+        assert srv.status(rid)["status"] == DONE
+        snap = srv.metrics()
+        assert set(snap["snapshot_tiers"]) == {DEVICE, HOST, DISK}
+        gauges = srv.status(rid)["server"]["snapshots"]
+        assert "tiers" in gauges and "warm" in gauges
+        text = srv.prometheus_metrics()
+        assert 'lens_serve_snapshot_tier_bytes{tier="disk"}' in text
+        assert "lens_serve_snapshot_rejected_total" in text
+        srv.close()
+
+
+class TestWarming:
+    """Speculative warming: placement only, never bits, never delay."""
+
+    def test_prewarm_then_client_hit_bitwise(self):
+        srv = _toggle_server()
+        wid = srv.prewarm(
+            composite="toggle_colony", seed=7, horizon=8.0
+        )
+        assert wid is not None
+        srv.run_until_idle(max_ticks=200)
+        req = ScenarioRequest(
+            composite="toggle_colony", seed=7, horizon=16.0,
+            prefix={"horizon": 8.0},
+            overrides={"global": {"volume": 1.1}},
+        )
+        rid = srv.submit(req)
+        srv.run_until_idle(max_ticks=200)
+        c = srv.metrics()["counters"]
+        assert c["warm_submitted"] == 1 and c["warm_completed"] == 1
+        assert c["prefix_misses"] == 0
+        assert c["prefix_hits"] == 1 and c["warm_hits"] == 1
+        warm_result = srv.result(rid)
+        srv.close()
+        # bitwise: warming never touches results
+        cold = _toggle_server()
+        rid0 = cold.submit(req)
+        cold.run_until_idle(max_ticks=200)
+        assert _leaves_equal(warm_result, cold.result(rid0))
+        cold.close()
+
+    def test_prewarm_is_idempotent_and_coalesces(self):
+        srv = _toggle_server()
+        assert srv.prewarm(
+            composite="toggle_colony", seed=7, horizon=8.0
+        ) is not None
+        # second prewarm of an in-flight key: no second run
+        assert srv.prewarm(
+            composite="toggle_colony", seed=7, horizon=8.0
+        ) is None
+        # a client submit meanwhile coalesces onto the warm run
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=7, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        srv.run_until_idle(max_ticks=200)
+        c = srv.metrics()["counters"]
+        assert srv.status(rid)["status"] == DONE
+        assert c["warm_submitted"] == 1
+        assert c["prefix_coalesced"] == 1 and c["warm_hits"] == 1
+        # resident now: prewarming again is a no-op
+        assert srv.prewarm(
+            composite="toggle_colony", seed=7, horizon=8.0
+        ) is None
+        srv.close()
+
+    def test_prewarm_promotes_demoted_entry(self):
+        # budget fits ONE snapshot: running prefix B demotes A; a
+        # prewarm of A is then the prefetch path — promote, not re-run
+        srv = _toggle_server(
+            snapshot_budget_mb=0.001, host_budget_mb=0.01,
+        )
+        spec_a = dict(composite="toggle_colony", seed=1, horizon=8.0)
+        for seed in (1, 2):
+            rid = srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=seed, horizon=16.0,
+                prefix={"horizon": 8.0},
+            ))
+            srv.run_until_idle(max_ticks=200)
+        base = srv.metrics()["counters"]
+        assert srv.prewarm(spec_a) is None  # promoted, no run needed
+        c = srv.metrics()["counters"]
+        assert c["warm_submitted"] == base["warm_submitted"]
+        assert (
+            srv.metrics()["snapshot_tiers"][HOST]["promotions"] > 0
+        )
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        srv.run_until_idle(max_ticks=200)
+        c = srv.metrics()["counters"]
+        assert c["warm_hits"] == base["warm_hits"] + 1
+        assert srv.status(rid)["status"] == DONE
+        srv.close()
+
+    def test_preemption_yields_to_clients_and_resumes_bitwise(self):
+        srv = _toggle_server(lanes=1, window=4)
+        wid = srv.prewarm(
+            composite="toggle_colony", seed=11, horizon=64.0
+        )
+        srv.tick()
+        srv.tick()  # the warm run owns the only lane now
+        cid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=12, horizon=8.0,
+        ))
+        srv.tick()  # preemption + client admission happen this tick
+        assert srv.tickets[cid].status == "running"
+        srv.run_until_idle(max_ticks=500)
+        c = srv.metrics()["counters"]
+        assert srv.status(cid)["status"] == DONE
+        assert c["warm_preempted"] >= 1
+        assert srv.tickets[wid].status == DONE  # resumed and finished
+        resumed = srv.snapshots.fetch(srv.tickets[wid].content_key)
+
+        clean_srv = _toggle_server(lanes=1, window=4)
+        w2 = clean_srv.prewarm(
+            composite="toggle_colony", seed=11, horizon=64.0
+        )
+        clean_srv.run_until_idle(max_ticks=500)
+        clean = clean_srv.snapshots.fetch(
+            clean_srv.tickets[w2].content_key
+        )
+        assert _leaves_equal(resumed, clean)
+        srv.close()
+        clean_srv.close()
+
+    def test_coalesced_fork_promotes_queued_warm_run(self):
+        """A client fork depending on a STILL-QUEUED warm run must not
+        wait for scrap lanes behind later client traffic: the warm
+        ticket moves into the client queue (where a plain miss's
+        internal run would be) the moment the fork coalesces."""
+        srv = _toggle_server(lanes=1, window=4)
+        blocker = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=32.0,
+        ))
+        srv.tick()  # blocker owns the only lane
+        wid = srv.prewarm(
+            composite="toggle_colony", seed=2, horizon=8.0
+        )
+        assert any(t.request_id == wid for t in srv._warm_queue)
+        fork = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=2, horizon=16.0,
+            prefix={"horizon": 8.0},
+        ))
+        # promoted: out of the warm queue, into the client FIFO
+        assert not any(t.request_id == wid for t in srv._warm_queue)
+        assert any(t.request_id == wid for t in srv.queue)
+        srv.run_until_idle(max_ticks=500)
+        assert srv.status(fork)["status"] == DONE
+        assert srv.status(blocker)["status"] == DONE
+        c = srv.metrics()["counters"]
+        assert c["prefix_coalesced"] == 1 and c["warm_hits"] == 1
+        srv.close()
+
+    def test_flat_store_exports_no_tier_rows(self):
+        srv = _toggle_server()
+        rid = srv.submit(ScenarioRequest(
+            composite="toggle_colony", seed=1, horizon=8.0,
+        ))
+        srv.run_until_idle(max_ticks=100)
+        assert srv.status(rid)["status"] == DONE
+        assert srv.metrics()["snapshot_tiers"] == {}
+        assert "snapshot_tier_" not in srv.prometheus_metrics()
+        srv.close()
+
+    def test_preempted_warm_capture_voided_on_device_loss(self):
+        """A preempted warm ticket's on-device progress capture lives
+        in ONE device's memory; quarantining that device must void
+        the capture (restart from scratch on a survivor), like every
+        other failover path does for carry state."""
+        srv = _toggle_server(lanes=1, window=4, mesh=2)
+        wid = srv.prewarm(
+            composite="toggle_colony", seed=21, horizon=32.0
+        )
+        srv.tick()
+        srv.tick()  # warm running on some shard
+        w = srv.tickets[wid]
+        shard = w.shard
+        # force a preemption: one client per lane of every shard
+        blockers = [
+            srv.submit(ScenarioRequest(
+                composite="toggle_colony", seed=30 + i, horizon=16.0,
+            ))
+            for i in range(2)
+        ]
+        srv.tick()
+        assert w in srv._warm_queue and w.carry_shard == shard
+        srv.quarantine_device(shard, reason="test")
+        assert w.carry_state is None and w.steps_done == 0
+        srv.run_until_idle(max_ticks=500)
+        for b in blockers:
+            assert srv.status(b)["status"] == DONE
+        assert srv.tickets[wid].status == DONE  # re-ran on survivor
+        # and the snapshot equals an unfaulted run's
+        snap = srv.snapshots.fetch(w.content_key)
+        ref_srv = _toggle_server(lanes=1, window=4)
+        w2 = ref_srv.prewarm(
+            composite="toggle_colony", seed=21, horizon=32.0
+        )
+        ref_srv.run_until_idle(max_ticks=500)
+        assert _leaves_equal(
+            snap, ref_srv.snapshots.fetch(ref_srv.tickets[w2].content_key)
+        )
+        srv.close()
+        ref_srv.close()
+
+    def test_prewarm_validates_like_submit(self):
+        srv = _toggle_server()
+        with pytest.raises(ValueError, match="composite"):
+            srv.prewarm(composite="nope", seed=1, horizon=8.0)
+        with pytest.raises(ValueError, match="horizon"):
+            srv.prewarm(
+                composite="toggle_colony", seed=1, horizon=0.3
+            )
+        with pytest.raises(ValueError, match="prewarm keys"):
+            srv.prewarm(
+                composite="toggle_colony", seed=1, horizon=8.0,
+                hold_state=True,
+            )
+        with pytest.raises(ValueError, match="prewarm needs"):
+            srv.prewarm(horizon=8.0)  # composite missing
+        srv.close()
+
+    def test_frontdoor_repeated_shape_prewarms(self, tmp_path):
+        from lens_tpu.frontdoor import FrontDoor
+
+        srv = _toggle_server(
+            out_dir=str(tmp_path / "out"), sink="log"
+        )
+        fd = FrontDoor(srv, warm=True)  # never started: unit-level
+        req = ScenarioRequest(
+            composite="toggle_colony", seed=4, horizon=16.0,
+            prefix={"horizon": 8.0},
+        )
+        fd._note_prefix("acme", req)
+        with fd._lock:
+            fd._prewarm_popular_step()
+        assert srv.metrics()["counters"]["warm_submitted"] == 0
+        fd._note_prefix("acme", req)  # second sighting: popular
+        with fd._lock:
+            fd._prewarm_popular_step()
+        assert srv.metrics()["counters"]["warm_submitted"] == 1
+        assert fd._warmed_idle  # one-shape plan drained in one step
+        srv.run_until_idle(max_ticks=200)
+        rid = srv.submit(req)
+        srv.run_until_idle(max_ticks=200)
+        c = srv.metrics()["counters"]
+        assert srv.status(rid)["status"] == DONE
+        assert c["warm_hits"] == 1 and c["prefix_misses"] == 0
+        srv.close()
+
+    def test_sweep_backend_warm_scores_speculative_hits(self, tmp_path):
+        from lens_tpu.sweep import run_sweep
+
+        spec = {
+            "composite": "toggle_colony",
+            "space": {
+                "kind": "random", "n_trials": 4,
+                "params": {
+                    "global/volume": {"low": 0.9, "high": 1.2},
+                },
+            },
+            "seed": 0, "horizon": 16.0, "capacity": 8,
+            "objective": {
+                "path": "global/volume",
+                "reduction": "final_live_sum", "mode": "max",
+            },
+            "backend": {
+                "kind": "server", "lanes": 2, "window": 4,
+                "warm": True,
+            },
+            "warmup": {"horizon": 8.0, "seed": 3},
+        }
+        res = run_sweep(spec, out_dir=str(tmp_path / "sweep"))
+        assert all(r["status"] == "done" for r in res.table)
+        c = res.metrics["server"]["counters"]
+        assert c["warm_submitted"] == 1
+        assert c["warm_hits"] > 0  # trials rode the speculative run
+        # and bits match the unwarmed sweep
+        spec_cold = dict(spec, backend={
+            "kind": "server", "lanes": 2, "window": 4,
+        })
+        cold = run_sweep(spec_cold, out_dir=str(tmp_path / "cold"))
+        warm_t = {r["trial"]: r["objective"] for r in res.table}
+        cold_t = {r["trial"]: r["objective"] for r in cold.table}
+        assert warm_t == cold_t
+
+
+# -- restart-warm through a REAL SIGKILL (the acceptance drill) ----------
+
+
+def _run_cli(args, cwd, expect_kill=False, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "lens_tpu", "serve", *args],
+        cwd=cwd, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    else:
+        assert proc.returncode == 0, (
+            f"rc={proc.returncode}\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}"
+        )
+    return proc
+
+
+def _lens_records(out_dir):
+    """Each client log's RECORD frame payloads, in submission order
+    (client rids ascend with list position either way). The header
+    frame is dropped: it embeds the request id, and a warm server
+    mints DIFFERENT rids than a cold one (prefix hits launch no
+    internal tickets, so the id sequence compresses) — the records
+    are the bits the determinism contract pins."""
+    from lens_tpu.emit.log import iter_frames
+
+    return [
+        list(iter_frames(os.path.join(out_dir, name)))[1:]
+        for name in sorted(os.listdir(out_dir))
+        if name.endswith(".lens")
+    ]
+
+
+@pytest.fixture(scope="module")
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRestartWarmSigkill:
+    """SIGKILL a tier-serving server mid-workload, restart it over the
+    same directories, and pin the acceptance claims: the re-run is
+    bitwise an uninterrupted run, and a THIRD, fresh-WAL invocation of
+    the same repeat traffic serves its prefixes from the disk tier —
+    zero misses, >0 disk hits, same bytes."""
+
+    REQS = [
+        {"seed": 5, "horizon": 16.0, "prefix": {"horizon": 8.0},
+         "overrides": {"global": {"volume": 1.1}}},
+        {"seed": 5, "horizon": 16.0, "prefix": {"horizon": 8.0},
+         "overrides": {"global": {"volume": 1.2}}},
+        {"seed": 6, "horizon": 16.0, "prefix": {"horizon": 8.0}},
+    ]
+
+    def test_sigkill_restart_serves_warm_disk_hits(
+        self, tmp_path, repo_root
+    ):
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps(self.REQS))
+        base = [
+            "--composite", "toggle_colony", "--capacity", "8",
+            "--lanes", "2", "--window", "4", "--requests", str(reqs),
+            # device+host budgets 0: every snapshot pages to disk the
+            # moment it is published, so the tier is populated well
+            # before the kill
+            "--snapshot-budget-mb", "0", "--host-budget-mb", "0",
+        ]
+        ref_out = tmp_path / "ref_out"
+        _run_cli(
+            base + ["--out-dir", str(ref_out),
+                    "--tier-dir", str(tmp_path / "ref_tier"),
+                    "--recover-dir", str(tmp_path / "ref_wal")],
+            repo_root,
+        )
+        ref = _lens_records(str(ref_out))
+
+        tier = tmp_path / "tier"
+        out, wal = tmp_path / "out", tmp_path / "wal"
+        faults = tmp_path / "faults.json"
+        faults.write_text(json.dumps(
+            [{"kind": "kill", "at": "retired.walled"}]
+        ))
+        crashed = base + [
+            "--out-dir", str(out), "--tier-dir", str(tier),
+            "--recover-dir", str(wal),
+        ]
+        _run_cli(
+            crashed + ["--faults", str(faults)],
+            repo_root, expect_kill=True,
+        )
+        # restart over the same dirs: WAL recovery + disk-tier warmth
+        _run_cli(crashed, repo_root)
+        assert _lens_records(str(out)) == ref
+
+        # repeat traffic against the SURVIVING tier dir (fresh WAL and
+        # out dir — this server never computed these prefixes): every
+        # prefix must come from disk
+        out3, wal3 = tmp_path / "out3", tmp_path / "wal3"
+        _run_cli(
+            base + ["--out-dir", str(out3), "--tier-dir", str(tier),
+                    "--recover-dir", str(wal3)],
+            repo_root,
+        )
+        assert _lens_records(str(out3)) == ref
+        meta = json.load(open(out3 / "server_meta.json"))
+        assert meta["counters"]["prefix_misses"] == 0
+        assert meta["counters"]["prefix_hits"] >= 1
+        assert meta["snapshot_tiers"]["disk"]["hits"] >= 1
