@@ -1742,6 +1742,297 @@ def run_tier_bench(args) -> int:
     return 0
 
 
+# -- multi-host cluster (round 17) -------------------------------------------
+
+
+def run_cluster_bench(args) -> int:
+    """Round-17 cluster bench (docs/serving.md, "Cluster serving").
+
+    Rows per requested host count, every worker a REAL process behind
+    the router over localhost TCP:
+
+    - aggregate served row-steps/s vs TWO baselines: the in-process
+      single-host server (the absolute ceiling of this box) and the
+      1-host cluster (the same worker-process shape without fan-out —
+      the apples-to-apples baseline for what ADDING hosts costs).
+      On this box every "host" shares the same core(s), so the honest
+      expectation is parity with the 1-host cluster, not scaling —
+      the constant gap to the in-process ceiling is the cost of
+      process isolation + RPC, and real scaling needs real chips;
+    - a work-stealing A/B under a SKEWED offered load (every request
+      pinned to host 0 — the shape a sticky tenant/locality pile-up
+      produces): stealing on migrates queued work to the idle hosts
+      (stolen counted, per-host retirement distribution shown),
+      stealing off strands it on the one host;
+    - a kill-one-host chaos row: a FaultPlan ``host_down`` SIGKILLs
+      one worker mid-load; every request must complete and its log
+      must be BYTE-EQUAL to the single-host no-fault oracle (the
+      1-host cluster, same router id mint).
+    """
+    import shutil
+    import tempfile
+
+    from lens_tpu.cluster import ClusterServer
+    from lens_tpu.serve.faults import FaultPlan
+
+    sizes = args.cluster or [2, 4]
+    lanes = args.lanes[0] if args.lanes else 2  # lanes PER HOST
+    horizon_steps = args.horizon_windows * args.window
+    # sync pipeline on BOTH sides: bitwise-identical results either
+    # way (r10 pin) and one thread fewer per process on a box where
+    # every process shares one core
+    bucket = {
+        "capacity": args.capacity, "lanes": lanes,
+        "window": args.window, "emit_every": args.emit_every,
+    }
+    worker = {"pipeline": "off"}
+    tmp_root = tempfile.mkdtemp(prefix="bench_cluster_")
+    record = {
+        "bench": "serve-cluster",
+        "backend": jax.default_backend(),
+        "cores": os.cpu_count(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "lanes_per_host": lanes,
+        "note": (
+            "every 'host' is a process on ONE box sharing "
+            f"{os.cpu_count()} core(s): the in-process single server "
+            "is the compute ceiling, the 1-host cluster isolates the "
+            "constant process+RPC cost, and parity of the 2/4-host "
+            "rows with the 1-host row means the multi-host fan-out "
+            "itself is nearly free. Real scaling needs real chips."
+        ),
+        "single_host": None,
+        "cluster_one_host": None,
+        "cluster": [],
+        "stealing": [],
+        "failover": [],
+    }
+
+    def _round(srv, n, seed0):
+        return _serve_round(
+            srv, args.composite, n, horizon_steps, seed0
+        )
+
+    def _warm_cluster(cl, n):
+        # like _warm, but the first windows compile inside worker
+        # processes while the router ticks at poll cadence — the
+        # tight in-process max_ticks bound does not apply
+        for s in range(n):
+            cl.submit(ScenarioRequest(
+                composite=args.composite, seed=s,
+                horizon=float(args.window),
+            ))
+        cl.run_until_idle(max_ticks=1_000_000)
+        cl.reset_samples()
+
+    def _make_cluster(tag, n_hosts, **kw):
+        return ClusterServer(
+            {args.composite: bucket}, hosts=n_hosts,
+            cluster_dir=os.path.join(tmp_root, tag),
+            queue_depth=256, worker=dict(worker), **kw,
+        )
+
+    def _rate(n, wall):
+        return round(n * horizon_steps * args.capacity / wall)
+
+    # baseline 1: the in-process single host (same per-host shape)
+    srv = SimServer.single_bucket(
+        args.composite, **bucket, queue_depth=256, pipeline="off",
+    )
+    _warm(srv, args.composite, lanes, args.window)
+    n1 = args.fill_rounds * lanes
+    wall1 = min(
+        _round(srv, n1, 1000 + rep * n1) for rep in range(args.reps)
+    )
+    srv.close()
+    single_rows_s = _rate(n1, wall1)
+    record["single_host"] = {
+        "lanes": lanes, "requests": n1,
+        "served_row_steps_s": single_rows_s,
+    }
+    print(json.dumps(record["single_host"]), flush=True)
+
+    # baseline 2: the 1-host cluster — one real worker process behind
+    # the router, no fan-out
+    cl = _make_cluster("c1", 1)
+    _warm_cluster(cl, lanes)
+    wall = min(
+        _round(cl, n1, 1500 + rep * n1) for rep in range(args.reps)
+    )
+    cl.close()
+    one_host_rows_s = _rate(n1, wall)
+    record["cluster_one_host"] = {
+        "lanes": lanes, "requests": n1,
+        "served_row_steps_s": one_host_rows_s,
+        "vs_single_host": round(one_host_rows_s / single_rows_s, 3),
+    }
+    print(json.dumps(record["cluster_one_host"]), flush=True)
+
+    for n_hosts in sizes:
+        n = args.fill_rounds * n_hosts * lanes
+        cl = _make_cluster(f"c{n_hosts}", n_hosts)
+        _warm_cluster(cl, n_hosts * lanes)
+        wall = min(
+            _round(cl, n, 2000 + rep * n) for rep in range(args.reps)
+        )
+        snap = cl.metrics()
+        rate = _rate(n, wall)
+        row = {
+            "hosts": n_hosts,
+            "lanes_total": n_hosts * lanes,
+            "requests": n,
+            "served_row_steps_s": rate,
+            "vs_single_host": round(rate / single_rows_s, 3),
+            "vs_one_host_cluster": round(rate / one_host_rows_s, 3),
+            "stolen": snap["counters"].get("router_stolen", 0),
+            "retired_per_host": [
+                h["counters"].get("retired", 0)
+                for h in snap["hosts"]
+            ],
+        }
+        record["cluster"].append(row)
+        print(json.dumps(row), flush=True)
+        cl.close()
+
+        # stealing A/B: the same skewed load (every request pinned to
+        # host 0), stealing on vs off
+        ab = {"hosts": n_hosts, "requests": n}
+        for steal_on in (True, False):
+            cl = _make_cluster(
+                f"s{n_hosts}_{'on' if steal_on else 'off'}", n_hosts,
+                steal_threshold=2 if steal_on else 10**9,
+            )
+            _warm_cluster(cl, n_hosts * lanes)
+            walls = []
+            for rep in range(max(args.reps // 2, 1)):
+                t0 = time.perf_counter()
+                rids = [
+                    cl.submit(ScenarioRequest(
+                        composite=args.composite,
+                        seed=3000 + rep * n + i,
+                        horizon=float(horizon_steps),
+                    ), host=0)
+                    for i in range(n)
+                ]
+                cl.run_until_idle(max_ticks=1_000_000)
+                walls.append(time.perf_counter() - t0)
+                assert all(
+                    cl.status(r)["status"] == "done" for r in rids
+                )
+            snap = cl.metrics()
+            tag = "steal_on" if steal_on else "steal_off"
+            ab[tag] = {
+                "wall_s": round(min(walls), 3),
+                "stolen": snap["counters"].get("router_stolen", 0),
+                "retired_per_host": [
+                    h["counters"].get("retired", 0)
+                    for h in snap["hosts"]
+                ],
+            }
+            cl.close()
+        ab["steal_speedup"] = round(
+            ab["steal_off"]["wall_s"] / ab["steal_on"]["wall_s"], 3
+        )
+        record["stealing"].append(ab)
+        print(json.dumps(ab), flush=True)
+
+        # kill-one-host chaos row, bytes pinned vs the 1-host oracle
+        chaos_reqs = [
+            dict(seed=7000 + i, horizon=float(horizon_steps))
+            for i in range(n)
+        ]
+        with ClusterServer(
+            {args.composite: bucket}, hosts=1,
+            cluster_dir=os.path.join(tmp_root, f"o{n_hosts}"),
+            queue_depth=256, local=True, worker=dict(worker),
+        ) as oracle:
+            orids = [
+                oracle.submit(ScenarioRequest(
+                    composite=args.composite, **r
+                ))
+                for r in chaos_reqs
+            ]
+            oracle.run_until_idle(max_ticks=1_000_000)
+            ref = {
+                r: open(oracle.result(r), "rb").read()
+                for r in orids
+            }
+        drill = _make_cluster(
+            f"k{n_hosts}", n_hosts,
+            faults=FaultPlan([{
+                "kind": "host_down", "host": 1, "occurrence": 4,
+            }]),
+        )
+        t0 = time.perf_counter()
+        rids = [
+            drill.submit(ScenarioRequest(
+                composite=args.composite, **r
+            ))
+            for r in chaos_reqs
+        ]
+        drill.run_until_idle(max_ticks=1_000_000)
+        drill_wall = time.perf_counter() - t0
+        dsnap = drill.metrics()
+        all_done = all(
+            drill.status(r)["status"] == "done" for r in rids
+        )
+        pins = all(
+            open(drill.result(r), "rb").read() == ref[r]
+            for r in rids
+        )
+        frow = {
+            "hosts": n_hosts,
+            "victim_host": 1,
+            "requests": n,
+            "wall_s": round(drill_wall, 3),
+            "all_done": bool(all_done),
+            "bitwise_vs_oracle": bool(pins),
+            "requeued": dsnap["counters"].get("router_requeued", 0),
+            "hosts_down": dsnap["hosts_down"],
+        }
+        record["failover"].append(frow)
+        print(json.dumps(frow), flush=True)
+        drill.close()
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    print(
+        f"baselines: in-process {single_rows_s} row-steps/s, 1-host "
+        f"cluster {one_host_rows_s} "
+        f"({record['cluster_one_host']['vs_single_host']:.2f}x — the "
+        f"constant process+RPC cost on this box)"
+    )
+    for row in record["cluster"]:
+        print(
+            f"cluster {row['hosts']}: {row['served_row_steps_s']} "
+            f"row-steps/s ({row['vs_one_host_cluster']:.2f}x the "
+            f"1-host cluster, {row['vs_single_host']:.2f}x the "
+            f"in-process ceiling)"
+        )
+    for ab in record["stealing"]:
+        on, off = ab["steal_on"], ab["steal_off"]
+        print(
+            f"stealing {ab['hosts']} hosts: stolen={on['stolen']} "
+            f"retired {on['retired_per_host']} vs off "
+            f"{off['retired_per_host']}; wall {on['wall_s']}s vs "
+            f"{off['wall_s']}s ({ab['steal_speedup']:.2f}x)"
+        )
+    ok = all(
+        r["all_done"] and r["bitwise_vs_oracle"]
+        for r in record["failover"]
+    ) and all(
+        ab["steal_on"]["stolen"] > 0 for ab in record["stealing"]
+    )
+    print(f"all cluster pins green: {ok}")
+    shutil.rmtree(tmp_root, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--composite", default="toggle_colony")
@@ -1835,6 +2126,16 @@ def main() -> int:
         "is visible by construction",
     )
     p.add_argument(
+        "--cluster", type=int, nargs="*", default=None,
+        help="run the round-17 multi-host cluster bench at these "
+        "simulated host counts (bare flag: 2 4; each host is a REAL "
+        "worker process behind the router): aggregate throughput vs "
+        "the single-host ceiling, a work-stealing A/B under skewed "
+        "load, and a kill-one-host chaos row with bitwise oracle "
+        "pins. Writes BENCH_CLUSTER_CPU_r17.json unless --out is "
+        "given; --lanes sets lanes PER HOST (default 2)",
+    )
+    p.add_argument(
         "--tiers", action="store_true",
         help="run the round-16 tiered-store bench: a skewed-"
         "popularity (Zipf) workload A/B of the tiered store vs the "
@@ -1871,12 +2172,13 @@ def main() -> int:
     # per-mode defaults (None = not explicitly passed)
     if sum(
         1 for m in (args.prefix, args.faults, args.mesh is not None,
-                    args.trace, args.frontdoor, args.tiers)
+                    args.trace, args.frontdoor, args.tiers,
+                    args.cluster is not None)
         if m
     ) > 1:
         raise SystemExit(
             "--prefix / --faults / --mesh / --trace / --frontdoor / "
-            "--tiers are separate modes"
+            "--tiers / --cluster are separate modes"
         )
     args.capacity = args.capacity or (
         64 if args.frontdoor else 256
@@ -1891,6 +2193,11 @@ def main() -> int:
         args.lanes = args.lanes or [2, 4, 8]
         args.horizon_windows = args.horizon_windows or 6
         return run_trace_bench(args)
+    if args.cluster is not None:
+        args.cluster = args.cluster or [2, 4]
+        args.out = args.out or "BENCH_CLUSTER_CPU_r17.json"
+        args.horizon_windows = args.horizon_windows or 6
+        return run_cluster_bench(args)
     if args.mesh is not None:
         args.mesh = args.mesh or [2, 4, 8]
         args.out = args.out or "BENCH_MESH_CPU_r13.json"

@@ -350,6 +350,15 @@ def _build_parser() -> argparse.ArgumentParser:
         "--out-dir", default="out/serve",
         help="per-request .lens result logs + server_meta.json land here",
     )
+    serve.add_argument(
+        "--hosts", type=int, default=None, metavar="N",
+        help="CLUSTER mode: spawn N serve worker processes (one "
+        "simulated host each, own SimServer/WAL/tiers) behind a "
+        "locality-aware router with work-stealing and whole-host "
+        "failover (docs/serving.md, 'Cluster serving'). --out-dir "
+        "becomes the cluster root. Default: single-host in-process "
+        "serving, bit for bit the pre-cluster path",
+    )
     _add_server_args(serve)
 
     frontdoor = sub.add_parser(
@@ -387,7 +396,45 @@ def _build_parser() -> argparse.ArgumentParser:
         "in-flight requests to finish before closing anyway "
         "(default: wait indefinitely; a second signal force-quits)",
     )
+    frontdoor.add_argument(
+        "--hosts", type=int, default=None, metavar="N",
+        help="CLUSTER mode: the door fronts N spawned serve worker "
+        "processes behind the cluster router instead of one "
+        "in-process SimServer (docs/serving.md, 'Cluster serving')",
+    )
     _add_server_args(frontdoor, frontdoor_defaults=True)
+
+    wal = sub.add_parser(
+        "wal",
+        help="human-readable, seq-merged dump of a serve write-ahead "
+        "log: per-shard files merge on the global seq stamp; a "
+        "cluster dir dumps every host's WAL (docs/serving.md)",
+    )
+    wal.add_argument(
+        "wal",
+        help="a --recover-dir (or its serve.wal), or a cluster dir "
+        "holding host<k>/wal/ subdirectories",
+    )
+    wal.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the merged event list as JSON instead of text",
+    )
+    wal.add_argument(
+        "--rid", default=None,
+        help="only events for this request id (and its ancestry)",
+    )
+
+    cw = sub.add_parser(
+        "cluster-worker",
+        help="one cluster serve worker (normally spawned by the "
+        "router; run by hand only to join an external router — "
+        "docs/serving.md, 'Cluster serving')",
+    )
+    cw.add_argument(
+        "--config", required=True,
+        help="worker config JSON written by the router (buckets, "
+        "server knobs, host identity, join address)",
+    )
 
     trace = sub.add_parser(
         "trace",
@@ -580,78 +627,16 @@ class _DrainSignals:
             _signal.signal(sig, prior)
 
 
-def _cmd_serve(args: argparse.Namespace) -> int:
-    """Drive a SimServer over a JSON request list: submit (respecting
-    backpressure by retrying after the hinted delay), run to idle,
-    report. Results stream to per-request ``.lens`` logs while the
-    scheduler is still running — tail them with
-    ``lens_tpu.emit.log.tail_records``. SIGTERM/SIGINT drain: no
-    further list entries are submitted, everything in flight finishes
-    and closes cleanly (the WAL, if armed, lets a rerun pick up the
-    skipped tail)."""
+def _serve_requests(args, server, raw) -> int:
+    """The serve CLI's drive loop, shared by the single-host SimServer
+    and the --hosts cluster router (both present the same client
+    surface)."""
     import time
 
-    from lens_tpu.serve import (
-        FaultPlan,
-        QueueFull,
-        ScenarioRequest,
-        SimServer,
-    )
+    from lens_tpu.serve import QueueFull, ScenarioRequest
 
-    if args.requests == "-":
-        raw = json.load(sys.stdin)
-    else:
-        with open(args.requests) as f:
-            raw = json.load(f)
-    if not isinstance(raw, list):
-        raise SystemExit(
-            f"--requests must be a JSON list of request objects, got "
-            f"{type(raw).__name__}"
-        )
-    faults = None
-    if args.faults is not None:
-        if args.faults == "-" and args.requests == "-":
-            raise SystemExit(
-                "--requests - and --faults - cannot both read stdin; "
-                "put at least one in a file"
-            )
-        try:
-            faults = FaultPlan.from_spec(
-                json.load(sys.stdin) if args.faults == "-"
-                else args.faults
-            )
-        except (ValueError, OSError, json.JSONDecodeError) as e:
-            raise SystemExit(f"--faults: {e}")
-
-    server = SimServer.single_bucket(
-        args.composite,
-        config=json.loads(args.config),
-        capacity=args.capacity,
-        lanes=args.lanes,
-        window=args.window,
-        timestep=args.timestep,
-        emit_every=args.emit_every,
-        queue_depth=args.queue_depth,
-        out_dir=args.out_dir,
-        sink="log",
-        pipeline=args.pipeline,
-        stream_queue=args.stream_queue,
-        flush_every=args.flush_every,
-        snapshot_budget_mb=args.snapshot_budget_mb,
-        host_budget_mb=args.host_budget_mb,
-        tier_dir=args.tier_dir,
-        check_finite=args.check_finite,
-        watchdog_s=args.watchdog,
-        sink_errors=args.sink_errors,
-        recover_dir=args.recover_dir,
-        faults=faults,
-        mesh=args.mesh,
-        device_watchdog_s=args.device_watchdog,
-        trace_dir=args.trace_dir,
-        metrics_interval_s=args.metrics_interval,
-    )
     with server, _DrainSignals("requests") as drain:
-        if server.recovered or any(
+        if getattr(server, "recovered", 0) or any(
             not t.internal for t in server.tickets.values()
         ):
             # recovery replayed part of a previous invocation's list:
@@ -662,7 +647,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             )
             print(
                 f"recovered {done_already} request(s) from "
-                f"{args.recover_dir} ({server.recovered} re-queued); "
+                f"{args.recover_dir or args.out_dir} "
+                f"({getattr(server, 'recovered', 0)} re-queued); "
                 f"resuming at request #{done_already}"
             )
             raw = raw[done_already:]
@@ -774,8 +760,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"coalesced={c['prefix_coalesced']} "
                 f"forks={c['prefix_forks']} "
                 f"evictions={c['snapshot_evictions']} "
-                f"resident={snap['snapshots_resident']} "
-                f"({snap['snapshot_bytes'] / 2**20:.1f} MiB)"
+                f"resident={snap.get('snapshots_resident', 0)} "
+                f"({snap.get('snapshot_bytes', 0) / 2**20:.1f} MiB)"
             )
         tiers = snap.get("snapshot_tiers") or {}
         if any(
@@ -806,7 +792,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"fault tolerance: diverged={c['diverged']} "
                 f"recovered={c['recovered']}"
             )
-        if args.mesh is not None and args.mesh > 1:
+        if args.mesh is not None and args.mesh > 1 \
+                and "shards" in snap:
             rows = " ".join(
                 f"shard{s['shard']}"
                 f"{'[QUARANTINED]' if s['quarantined'] else ''}="
@@ -818,8 +805,26 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 f"quarantined={snap['quarantined_devices']} "
                 f"requeued={c['requeued']}"
             )
-        print(f"results: {args.out_dir}/<request-id>.lens")
-        print(f"meta:    {args.out_dir}/server_meta.json")
+        cl = snap.get("cluster")
+        if cl:
+            rows = " ".join(
+                f"host{h['host']}{'' if h['alive'] else '[DOWN]'}="
+                f"{h['adopted']}a/{h['stolen']}s"
+                for h in cl["hosts"]
+            )
+            print(
+                f"cluster {len(cl['hosts'])} hosts: {rows} "
+                f"stolen={cl['stolen']} requeued={cl['requeued']} "
+                f"hosts_down={len(cl['hosts_down'])}"
+            )
+        print(f"results: {server.out_dir}/<request-id>.lens")
+        if cl:
+            print(f"meta:    {args.out_dir}/cluster_meta.json "
+                  f"(+ host<k>/server_meta.json)")
+            print(f"wal:     {args.out_dir}/host<k>/wal "
+                  f"(dump: python -m lens_tpu wal {args.out_dir})")
+        else:
+            print(f"meta:    {args.out_dir}/server_meta.json")
         if args.recover_dir:
             print(f"wal:     {args.recover_dir}/serve.wal")
         if args.trace_dir:
@@ -835,6 +840,182 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     return 0
 
 
+
+
+def _split_fault_spec(spec):
+    """Split a CLI fault spec between the cluster router (host_down)
+    and the workers (everything else). Returns (router_faults,
+    worker_spec) — either may be None."""
+    import json as _json
+
+    if spec is None:
+        return None, None
+    if isinstance(spec, str):
+        with open(spec) as f:
+            spec = _json.load(f)
+    if isinstance(spec, dict):
+        seed = spec.get("seed", 0)
+        faults = spec.get("faults") or []
+    else:
+        seed, faults = 0, list(spec)
+    router = [f for f in faults if f.get("kind") == "host_down"]
+    workers = [f for f in faults if f.get("kind") != "host_down"]
+    from lens_tpu.serve import FaultPlan
+
+    return (
+        FaultPlan(router, seed=seed) if router else None,
+        {"seed": seed, "faults": workers} if workers else None,
+    )
+
+
+def _build_cluster(args, frontdoor_defaults=False):
+    """ClusterServer from the shared serve/frontdoor CLI knobs
+    (--hosts N; docs/serving.md, "Cluster serving"). --out-dir is the
+    cluster root: shared logs in out/, shared snapshot tier in
+    tiers/, per-host WAL dirs in host<k>/."""
+    from lens_tpu.cluster import ClusterServer
+
+    if args.recover_dir:
+        raise SystemExit(
+            "--hosts and --recover-dir are exclusive: cluster mode "
+            "always arms one WAL per host under the cluster dir "
+            "(<out-dir>/host<k>/wal)"
+        )
+    router_faults, worker_faults = None, None
+    if args.faults is not None:
+        try:
+            router_faults, worker_faults = _split_fault_spec(
+                json.load(sys.stdin) if args.faults == "-"
+                else args.faults
+            )
+        except (ValueError, OSError) as e:
+            raise SystemExit(f"--faults: {e}")
+    worker = {
+        "pipeline": args.pipeline,
+        "stream_queue": args.stream_queue,
+        "flush_every": args.flush_every,
+        "snapshot_budget_mb": args.snapshot_budget_mb,
+        "check_finite": args.check_finite,
+        "watchdog_s": args.watchdog,
+        "sink_errors": args.sink_errors,
+    }
+    if args.host_budget_mb is not None:
+        worker["host_budget_mb"] = args.host_budget_mb
+    if args.tier_dir:
+        worker["tier_dir"] = args.tier_dir
+    if args.mesh is not None:
+        worker["mesh"] = args.mesh
+    if args.device_watchdog is not None:
+        worker["device_watchdog_s"] = args.device_watchdog
+    if worker_faults is not None:
+        worker["faults"] = worker_faults
+    if args.metrics_interval is not None:
+        if args.trace_dir:
+            worker["metrics_interval_s"] = args.metrics_interval
+        else:
+            print(
+                "cluster mode samples per-host metrics.jsonl only "
+                "under --trace-dir (the shared out dir would clobber); "
+                "skipping --metrics-interval",
+                file=sys.stderr,
+            )
+    return ClusterServer(
+        {
+            args.composite: {
+                "config": json.loads(args.config),
+                "capacity": args.capacity,
+                "lanes": args.lanes,
+                "window": args.window,
+                "timestep": args.timestep,
+                "emit_every": args.emit_every,
+            }
+        },
+        hosts=args.hosts,
+        cluster_dir=args.out_dir,
+        queue_depth=args.queue_depth,
+        worker=worker,
+        faults=router_faults,
+        trace_dir=args.trace_dir,
+    )
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Drive a SimServer over a JSON request list: submit (respecting
+    backpressure by retrying after the hinted delay), run to idle,
+    report. Results stream to per-request ``.lens`` logs while the
+    scheduler is still running — tail them with
+    ``lens_tpu.emit.log.tail_records``. SIGTERM/SIGINT drain: no
+    further list entries are submitted, everything in flight finishes
+    and closes cleanly (the WAL, if armed, lets a rerun pick up the
+    skipped tail)."""
+    import time
+
+    from lens_tpu.serve import (
+        FaultPlan,
+        QueueFull,
+        ScenarioRequest,
+        SimServer,
+    )
+
+    if args.requests == "-":
+        raw = json.load(sys.stdin)
+    else:
+        with open(args.requests) as f:
+            raw = json.load(f)
+    if not isinstance(raw, list):
+        raise SystemExit(
+            f"--requests must be a JSON list of request objects, got "
+            f"{type(raw).__name__}"
+        )
+    faults = None
+    if args.faults is not None:
+        if args.faults == "-" and args.requests == "-":
+            raise SystemExit(
+                "--requests - and --faults - cannot both read stdin; "
+                "put at least one in a file"
+            )
+    if args.hosts:
+        server = _build_cluster(args)
+        return _serve_requests(args, server, raw)
+    if args.faults is not None:
+        try:
+            faults = FaultPlan.from_spec(
+                json.load(sys.stdin) if args.faults == "-"
+                else args.faults
+            )
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            raise SystemExit(f"--faults: {e}")
+
+    server = SimServer.single_bucket(
+        args.composite,
+        config=json.loads(args.config),
+        capacity=args.capacity,
+        lanes=args.lanes,
+        window=args.window,
+        timestep=args.timestep,
+        emit_every=args.emit_every,
+        queue_depth=args.queue_depth,
+        out_dir=args.out_dir,
+        sink="log",
+        pipeline=args.pipeline,
+        stream_queue=args.stream_queue,
+        flush_every=args.flush_every,
+        snapshot_budget_mb=args.snapshot_budget_mb,
+        host_budget_mb=args.host_budget_mb,
+        tier_dir=args.tier_dir,
+        check_finite=args.check_finite,
+        watchdog_s=args.watchdog,
+        sink_errors=args.sink_errors,
+        recover_dir=args.recover_dir,
+        faults=faults,
+        mesh=args.mesh,
+        device_watchdog_s=args.device_watchdog,
+        trace_dir=args.trace_dir,
+        metrics_interval_s=args.metrics_interval,
+    )
+    return _serve_requests(args, server, raw)
+
+
 def _cmd_frontdoor(args: argparse.Namespace) -> int:
     """Run the HTTP front door until a signal, then drain gracefully:
     stop accepting (503 + Retry-After), finish queued + in-flight
@@ -844,6 +1025,12 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
     from lens_tpu.frontdoor import FrontDoor
     from lens_tpu.serve import FaultPlan, SimServer
 
+    if args.hosts:
+        try:
+            server = _build_cluster(args)
+        except (ValueError, RuntimeError, TimeoutError) as e:
+            raise SystemExit(str(e))
+        return _run_frontdoor(args, server)
     faults = None
     if args.faults is not None:
         try:
@@ -883,6 +1070,16 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
         )
     except ValueError as e:
         raise SystemExit(str(e))
+    return _run_frontdoor(args, server)
+
+
+def _run_frontdoor(args, server) -> int:
+    """The front-door CLI's serve loop, shared by the single-host
+    SimServer and the --hosts cluster router."""
+    import threading
+
+    from lens_tpu.frontdoor import FrontDoor
+
     try:
         fd = FrontDoor(
             server,
@@ -905,9 +1102,16 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
         )
         print(f"front door listening on {base}")
         print(f"tenants: {tenant_note}")
-        print(f"bucket:  {args.composite} x{args.lanes} lanes "
-              f"(window {args.window})")
-        print(f"results: {args.out_dir}/<request-id>.lens")
+        if args.hosts:
+            print(
+                f"cluster: {args.hosts} hosts x {args.composite} "
+                f"x{args.lanes} lanes (window {args.window}); "
+                f"wal/tiers under {args.out_dir}"
+            )
+        else:
+            print(f"bucket:  {args.composite} x{args.lanes} lanes "
+                  f"(window {args.window})")
+        print(f"results: {server.out_dir}/<request-id>.lens")
         print("endpoints: POST /v1/requests | GET /v1/requests/RID"
               "[/stream] | DELETE /v1/requests/RID | /healthz | "
               "/metrics | /v1/status")
@@ -940,8 +1144,129 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
                 f"work still in flight; closed anyway",
                 file=sys.stderr,
             )
-    print(f"meta:    {args.out_dir}/server_meta.json")
+    if args.hosts:
+        print(f"meta:    {args.out_dir}/cluster_meta.json "
+              f"(+ host<k>/server_meta.json)")
+    else:
+        print(f"meta:    {args.out_dir}/server_meta.json")
     return 0 if drained else 1
+
+
+def _cmd_wal(args: argparse.Namespace) -> int:
+    """Dump serve WALs human-readably: per-shard files of one server
+    merge on the global seq stamp (the scheduler's exact total order);
+    a cluster directory dumps every host's WAL in host order — the
+    day-one debugging surface for multi-host recovery."""
+    import glob
+    import os
+
+    from lens_tpu.serve.wal import classify_events, read_events
+
+    target = args.wal
+    wals = []
+    if os.path.isfile(target) or os.path.exists(
+        os.path.join(target, "serve.wal")
+    ):
+        wals.append((target, read_events(target)))
+    else:
+        for hw in sorted(
+            glob.glob(os.path.join(target, "host*", "wal"))
+        ):
+            if os.path.exists(os.path.join(hw, "serve.wal")):
+                host = os.path.basename(os.path.dirname(hw))
+                wals.append((f"{host} ({hw})", read_events(hw)))
+    if not wals:
+        print(
+            f"no serve.wal under {target!r} (expected a --recover-dir "
+            f"or a cluster dir with host*/wal/)",
+            file=sys.stderr,
+        )
+        return 2
+
+    def ancestry(events, rid):
+        """rid plus its resubmit parent chain (the events worth
+        reading when debugging one request)."""
+        _, recs, *_ = classify_events(events)
+        keep = set()
+        walk = rid
+        while walk is not None and walk not in keep:
+            keep.add(walk)
+            walk = (recs.get(walk) or {}).get("parent")
+        return keep
+
+    def detail(ev):
+        kind = ev.get("event")
+        if kind == "server_begin":
+            return (
+                f"fingerprint={ev.get('fingerprint')} "
+                f"buckets={sorted(ev.get('buckets') or {})}"
+            )
+        if kind == "submit":
+            r = ev.get("request") or {}
+            bits = [
+                f"composite={r.get('composite')}",
+                f"seed={r.get('seed', 0)}",
+                f"horizon={r.get('horizon')}",
+            ]
+            if r.get("prefix"):
+                bits.append(
+                    f"prefix@{dict(r['prefix']).get('horizon')}"
+                )
+            if r.get("hold_state"):
+                bits.append("hold_state")
+            if r.get("tenant"):
+                bits.append(f"tenant={r['tenant']}")
+            return " ".join(bits)
+        if kind == "resubmit":
+            return (
+                f"parent={ev.get('parent')} "
+                f"extra_horizon={ev.get('extra_horizon')}"
+            )
+        if kind == "retire":
+            out = f"status={ev.get('status')} steps={ev.get('steps')}"
+            if ev.get("error"):
+                out += f" error={ev['error']!r}"
+            return out
+        if kind == "hold":
+            return f"spill={ev.get('name')}"
+        if kind == "device_quarantined":
+            return f"shard={ev.get('shard')} reason={ev.get('reason')}"
+        return ""
+
+    if args.as_json:
+        out = []
+        for label, events in wals:
+            if args.rid:
+                keep = ancestry(events, args.rid)
+                events = [
+                    e for e in events
+                    if e.get("rid") in keep
+                    or e.get("event") == "server_begin"
+                ]
+            out.append({"wal": label, "events": events})
+        print(json.dumps(out, indent=1, default=str))
+        return 0
+    for label, events in wals:
+        keep = ancestry(events, args.rid) if args.rid else None
+        print(f"== {label}: {len(events)} event(s)")
+        shown = 0
+        for ev in events:
+            if keep is not None and ev.get("rid") not in keep \
+                    and ev.get("event") != "server_begin":
+                continue
+            seq = ev.get("seq", "-")
+            shard = ev.get("shard", "")
+            shard_s = f"shard{shard}" if shard != "" else ""
+            print(
+                f"  {seq!s:>6} {shard_s:<8} "
+                f"{ev.get('event', '?'):<20} "
+                f"{ev.get('rid') or '-':<14} {detail(ev)}"
+            )
+            shown += 1
+        if args.rid:
+            print(f"  ({shown} of {len(events)} events match "
+                  f"{args.rid} + ancestry)")
+    return 0
 
 
 def _cmd_trace(args: argparse.Namespace) -> int:
@@ -1112,6 +1437,14 @@ def main(argv=None) -> int:
 
     if args.command == "trace":
         return _cmd_trace(args)
+
+    if args.command == "wal":
+        return _cmd_wal(args)
+
+    if args.command == "cluster-worker":
+        from lens_tpu.cluster import run_worker
+
+        return run_worker(args.config)
 
     if args.command == "sweep":
         return _cmd_sweep(args)

@@ -38,6 +38,24 @@ from lens_tpu.environment.spatial import SpatialState
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY: the tmp->rename commit protocol's last step.
+    ``os.rename`` makes the new name visible, but the directory entry
+    itself is metadata the filesystem may still hold in memory — on
+    power loss an un-synced rename can roll back, leaving the old name
+    (or nothing). Syncing the parent directory fd makes the rename
+    durable. Best-effort on filesystems whose directory fds refuse
+    fsync (some network mounts): losing the sync there degrades to the
+    pre-round-17 guarantee, never corrupts."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _to_plain(state: Any) -> Any:
     """NamedTuples -> dicts so orbax sees vanilla containers.
 
@@ -96,21 +114,29 @@ def _from_plain(plain: Any) -> Any:
 
 def save_tree(path: str, state: Any) -> str:
     """Crash-safe orbax save of ONE state tree at an arbitrary path
-    (no step indexing): write ``<path>.tmp-save``, rename into place —
-    the same protocol as :meth:`Checkpointer.save`, factored out for
-    trees that are not steps of a run. The serve layer's held-snapshot
-    spill (``lens_tpu.serve.wal``) is the client: a ``hold_state``
-    request's pinned final state lands here at retirement, so a killed
-    server's ``resubmit`` chain can continue from the exact bits after
-    recovery. Single-process only (the serve layer is single-host; the
-    multi-host promotion barrier lives in ``Checkpointer.save``)."""
+    (no step indexing). The commit protocol, in order: (1) orbax-save
+    the full tree into ``<path>.tmp-save`` (orbax fsyncs the array
+    files), (2) ``os.rename`` it into place — readers never see a torn
+    tree, (3) **fsync the parent directory**, making the rename itself
+    durable: without it a power loss can roll the directory entry back
+    even though the data blocks were synced, and cross-host failover
+    (docs/serving.md, "Cluster serving") trusts that a spill another
+    host observed on the shared tier directory STAYS there. The serve
+    layer's held-snapshot spill (``lens_tpu.serve.wal``) is the
+    client: a ``hold_state`` request's pinned final state lands here
+    at retirement, so a killed server's ``resubmit`` chain can
+    continue from the exact bits after recovery. Single-process only
+    (the serve layer's scheduler is one process per host; the
+    multi-host promotion barrier lives in :meth:`Checkpointer.save`)."""
     path = os.path.abspath(path)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
     tmp = f"{path}.tmp-save"
     ocp.PyTreeCheckpointer().save(tmp, _to_plain(state), force=True)
     if os.path.exists(path):
         shutil.rmtree(path)
     os.rename(tmp, path)
+    _fsync_dir(parent)
     return path
 
 
@@ -166,6 +192,9 @@ class Checkpointer:
             if os.path.exists(path):
                 shutil.rmtree(path)
             os.rename(tmp, path)
+            # the rename is only durable once the parent directory's
+            # entry is synced (same protocol as save_tree)
+            _fsync_dir(self.directory)
         if jax.process_count() > 1:
             # every host must observe the promotion before its save()
             # returns — without the barrier a non-coordinator could

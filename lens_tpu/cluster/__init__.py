@@ -1,0 +1,29 @@
+"""lens_tpu.cluster: multi-host serving — one serve worker per host
+behind a locality-aware router with work-stealing and whole-host
+failover.
+
+The mesh scheduler (``SimServer(mesh=N)``) scales to every device in
+one process; this package scales past the process. Each HOST runs one
+worker — its own process with its own :class:`~lens_tpu.serve.SimServer`
+(mesh, snapshot tiers, per-host WAL directory) — and a
+:class:`ClusterServer` routes requests across them: placement scores
+queue depth and snapshot locality, work-stealing migrates queued
+requests off a backed-up host's FIFO, and a host that dies (heartbeat
+loss, a ``FaultPlan`` ``host_down``, a real SIGKILL) is drained from
+routing while its WAL-known unfinished work re-queues onto survivors
+under original ids. See docs/serving.md, "Cluster serving".
+
+The architectural reference is Podracer's Sebulba split (PAPERS.md):
+independent per-host actors behind a thin central work source, with
+per-host state kept host-local and only routing/health crossing hosts.
+"""
+
+from lens_tpu.cluster.router import ClusterServer, HostDown
+from lens_tpu.cluster.worker import WorkerCore, run_worker
+
+__all__ = [
+    "ClusterServer",
+    "HostDown",
+    "WorkerCore",
+    "run_worker",
+]

@@ -1,0 +1,133 @@
+"""Cluster wire protocol: length-prefixed JSON messages over TCP.
+
+One frame = a 4-byte big-endian payload length + UTF-8 JSON. Requests
+are ``{"op": ..., **params}``; replies are ``{"ok": true, **result}``
+or ``{"ok": false, "error_type": ..., "error": ..., ...}`` — the
+error envelope round-trips the serve layer's typed exceptions
+(``QueueFull`` keeps its retry-after hint, ``RequestValidationError``
+its machine-readable field path) so the router re-raises exactly what
+an in-process ``SimServer`` call would have raised.
+
+Deliberately minimal: localhost TCP is the simulated-hosts transport
+this box can actually test, and the frame layout is transport-agnostic
+enough that a real deployment can carry it over whatever its hosts
+already speak (the jax.distributed bring-up in
+``lens_tpu.parallel.distributed`` solves identity, not serving RPC).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Mapping, Optional
+
+from lens_tpu.serve.batcher import (
+    QueueFull,
+    RequestValidationError,
+    SimulationDiverged,
+)
+from lens_tpu.serve.streamer import WatchdogTimeout
+
+_LEN = struct.Struct(">I")
+
+#: Refuse frames past this (a corrupt length prefix must not look like
+#: a multi-GiB allocation). WAL adoption payloads are the largest real
+#: message: thousands of events, still far under this.
+MAX_FRAME = 256 * 2**20
+
+
+def send_msg(sock: socket.socket, obj: Mapping[str, Any]) -> None:
+    payload = json.dumps(obj, default=str).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    """One frame, honoring the socket's own timeout (``socket.timeout``
+    propagates — the router's heartbeat-loss signal)."""
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds {MAX_FRAME}")
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+#: Exception types that cross the wire by name. Anything else arrives
+#: as RuntimeError carrying the original type in its message.
+_ERRORS = {
+    "QueueFull": QueueFull,
+    "RequestValidationError": RequestValidationError,
+    "SimulationDiverged": SimulationDiverged,
+    "WatchdogTimeout": WatchdogTimeout,
+    "ValueError": ValueError,
+    "KeyError": KeyError,
+    "FileNotFoundError": FileNotFoundError,
+}
+
+
+def encode_error(exc: BaseException) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "ok": False,
+        "error_type": type(exc).__name__,
+        "error": str(exc),
+    }
+    if isinstance(exc, QueueFull):
+        out["retry_after"] = float(exc.retry_after)
+        out["depth"] = int(getattr(exc, "depth", 0))
+    if isinstance(exc, RequestValidationError):
+        out["path"] = exc.path
+    return out
+
+
+def raise_error(reply: Mapping[str, Any]) -> None:
+    """Re-raise a worker-side error head-side, typed."""
+    name = reply.get("error_type", "RuntimeError")
+    message = reply.get("error", "worker error")
+    if name == "QueueFull":
+        raise QueueFull(
+            float(reply.get("retry_after", 1.0)),
+            int(reply.get("depth", 0)),
+        )
+    if name == "RequestValidationError":
+        raise RequestValidationError(message, path=reply.get("path"))
+    cls = _ERRORS.get(name)
+    if cls is KeyError:
+        # KeyError str()s to its repr'd key; rewrap cleanly
+        raise KeyError(message)
+    if cls is not None:
+        raise cls(message)
+    raise RuntimeError(f"{name}: {message}")
+
+
+def rpc(
+    sock: socket.socket,
+    op: str,
+    timeout: Optional[float] = None,
+    **params: Any,
+) -> Dict[str, Any]:
+    """One request/reply exchange. ``timeout`` bounds the whole
+    exchange (None = the socket's current default); worker-side errors
+    re-raise typed, transport errors propagate as
+    ``ConnectionError``/``socket.timeout`` for the router's health
+    logic to interpret."""
+    prev = sock.gettimeout()
+    if timeout is not None:
+        sock.settimeout(timeout)
+    try:
+        send_msg(sock, {"op": op, **params})
+        reply = recv_msg(sock)
+    finally:
+        if timeout is not None:
+            sock.settimeout(prev)
+    if not reply.get("ok"):
+        raise_error(reply)
+    return reply

@@ -1,0 +1,1721 @@
+"""The cluster router: locality-aware placement, work-stealing, and
+whole-host failover over per-host serve workers.
+
+``ClusterServer`` presents the ``SimServer`` client surface (submit /
+status / result / cancel / resubmit / metrics / tick / close) while
+fanning the work across one worker PER HOST (docs/serving.md, "Cluster
+serving"):
+
+- **placement** scores live hosts by queue depth and free lanes, with
+  one override: a request declaring a shared prefix routes to the host
+  whose snapshot tier already owns that prefix (sticky locality map) —
+  UNLESS that host is backed up past ``steal_threshold``, in which
+  case the request falls back to the least-loaded host and re-resolves
+  there (recompute, or a shared-tier disk hit).
+- **work-stealing** runs every router tick: when one host's FIFO backs
+  up past ``steal_threshold`` while another sits idle with free lanes,
+  the router withdraws queued requests from the rich host's tail
+  (``SimServer.withdraw`` — WAL'd as MIGRATED locally) and resubmits
+  them to the idle host under their original ids, so a skewed tenant
+  cannot strand cluster capacity.
+- **whole-host failover** generalizes device quarantine one level up:
+  heartbeat loss (the health connection stops answering), a worker
+  process exit, a scheduler-thread death, or a ``FaultPlan``
+  ``host_down`` (which SIGKILLs the spawned worker — the drill is a
+  real kill) drains the host from routing; its per-host WAL is read
+  back, every WAL-known unfinished request re-queues onto survivors
+  under its original id (``SimServer.adopt_displaced`` — the
+  merge-on-recover semantics of device failover, now per host), and
+  spill-backed snapshots re-adopt from the shared tier directory.
+
+Two host transports share one op dispatch (``WorkerCore``):
+``local=True`` runs simulated hosts in-process (the router ticks each
+core — fast, no process spawns; the unit-test tier), ``local=False``
+spawns one real worker process per host over localhost TCP (the drill
+tier and the CLI/front-door deployment shape on one box; on real
+fleets the same worker joins from each host via
+``python -m lens_tpu cluster-worker``).
+
+Thread model: the router is NOT internally locked — its callers
+serialize (the front door's admission lock, or a single-threaded
+driver), exactly like ``SimServer``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from dataclasses import replace as dc_replace
+from typing import Any, Dict, List, Mapping, Optional
+
+from lens_tpu.cluster.protocol import (
+    raise_error,
+    recv_msg,
+    rpc,
+    send_msg,
+)
+from lens_tpu.cluster.worker import ID_SPAN, WorkerCore, _offset_ids
+from lens_tpu.obs.trace import NullTracer, Tracer
+from lens_tpu.serve.batcher import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    MIGRATED,
+    QUEUED,
+    QueueFull,
+    RUNNING,
+    ScenarioRequest,
+    SimulationDiverged,
+    TIMEOUT,
+)
+from lens_tpu.serve.faults import FaultPlan
+from lens_tpu.serve.metrics import ServerMetrics
+from lens_tpu.serve.wal import classify_events, read_events, unfinished
+
+_TERMINAL = (DONE, FAILED, TIMEOUT, CANCELLED)
+
+#: Cluster layout inside ``cluster_dir`` (one shared filesystem in the
+#: simulated-hosts mode; a real fleet points these at shared storage).
+OUT_DIR = "out"          # every host's per-request .lens logs
+TIER_DIR = "tiers"       # shared snapshot tier + hold spills
+HOST_DIR = "host{:02d}"  # per-host WAL dir, worker config/log/meta
+
+
+class HostDown(ConnectionError):
+    """A control call could not complete because its host died (the
+    router declares the host down and the caller retries elsewhere)."""
+
+
+@dataclass
+class ClusterTicket:
+    """The router's mirror of one request's state (refreshed from the
+    owning worker's published snapshot every router tick)."""
+
+    request_id: str
+    request: ScenarioRequest
+    host: Optional[int]          # owning host; None while in limbo
+    status: str = QUEUED
+    error: Optional[str] = None
+    steps_done: int = 0
+    horizon_steps: int = 0
+    result_path: Optional[str] = None
+    streamed_at: Optional[float] = None
+    diverged: bool = False
+    parent: Optional[str] = None
+    internal: bool = False       # router tickets are always client work
+    submitted_at: float = field(default_factory=time.perf_counter)
+    finished_at: Optional[float] = None
+    #: worker-reported timing row (worker-clock relative seconds)
+    timing: Optional[Dict[str, Any]] = None
+    #: stage marks the router never observes (a worker-side concern) —
+    #: present so ``request_timing_row`` renders a router ticket too
+    #: (the front door's fallback when the owning host is gone)
+    shard: Optional[int] = None
+    admitted_at: Optional[float] = None
+    first_window_at: Optional[float] = None
+    #: stream epoch: worker-level device requeues + router-level host
+    #: failovers; a bump tells an SSE reader its sink restarted
+    requeues: int = 0
+    _fail_epochs: int = 0
+
+
+class _Host:
+    """One host's handle: identity, health mirror, WAL location."""
+
+    def __init__(self, host_id: int, host_dir: str):
+        self.host_id = int(host_id)
+        self.host_dir = host_dir
+        self.wal_dir = os.path.join(host_dir, "wal")
+        self.alive = True
+        self.misses = 0
+        self.polled_at = 0.0
+        self.health: Dict[str, Any] = {
+            "queue_depth": 0, "lanes_busy": 0, "lanes_total": 0,
+            "free_lanes": 0, "busy": False, "retry_after": 1.0,
+            "counters": {}, "tickets": [], "alive": True,
+            "version": 0, "quarantined_devices": 0,
+        }
+
+    # subclass surface -------------------------------------------------------
+
+    def call(self, op: str, timeout: Optional[float] = None,
+             **params: Any) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def poll(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        raise NotImplementedError
+
+
+class LocalHost(_Host):
+    """An in-process simulated host: the same ``WorkerCore`` dispatch,
+    driven by the router's own tick (no subprocess, no sockets — the
+    fast tier for routing/stealing/failover logic). Ops JSON-roundtrip
+    so anything that would not survive the wire fails here too."""
+
+    def __init__(self, host_id: int, host_dir: str, core: WorkerCore):
+        super().__init__(host_id, host_dir)
+        self.core = core
+
+    def _roundtrip(self, obj: Any) -> Any:
+        return json.loads(json.dumps(obj, default=str))
+
+    def call(self, op: str, timeout: Optional[float] = None,
+             **params: Any) -> Dict[str, Any]:
+        if not self.alive:
+            raise HostDown(f"host {self.host_id} is down")
+        msg = self._roundtrip({"op": op, **params})
+        reply = self._roundtrip(self.core.handle_control(msg))
+        if not reply.get("ok"):
+            raise_error(reply)
+        return reply
+
+    def tick(self) -> bool:
+        if not self.alive:
+            return False
+        return self.core.tick_once()
+
+    def poll(self) -> Dict[str, Any]:
+        if not self.alive:
+            raise HostDown(f"host {self.host_id} is down")
+        reply = self.core.handle_health({"op": "poll"})
+        if not reply.get("ok"):
+            raise HostDown(f"host {self.host_id}: {reply.get('error')}")
+        return reply
+
+    def kill(self) -> None:
+        # a crashed host stops doing work but is NOT closed cleanly —
+        # its WAL (flushed at every append) is what failover reads
+        self.alive = False
+
+    def shutdown(self) -> None:
+        if self.alive:
+            self.alive = False
+            self.core.close()
+
+
+class RemoteHost(_Host):
+    """A spawned worker process reached over localhost TCP: a control
+    connection (lock-bound ops) and a health connection (lock-free
+    ping/poll — answered even while the worker compiles)."""
+
+    def __init__(
+        self,
+        host_id: int,
+        host_dir: str,
+        proc: subprocess.Popen,
+        rpc_timeout_s: float,
+        heartbeat_s: float,
+    ):
+        super().__init__(host_id, host_dir)
+        self.proc = proc
+        self.control: Optional[socket.socket] = None
+        self.health_sock: Optional[socket.socket] = None
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.info: Dict[str, Any] = {}
+        self._desynced = False
+
+    def call(self, op: str, timeout: Optional[float] = None,
+             **params: Any) -> Dict[str, Any]:
+        if not self.alive or self.control is None:
+            raise HostDown(f"host {self.host_id} is down")
+        try:
+            return rpc(
+                self.control, op,
+                timeout=timeout or self.rpc_timeout_s, **params,
+            )
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise HostDown(
+                f"host {self.host_id} control connection failed "
+                f"during {op!r}: {e}"
+            ) from e
+
+    def poll(self) -> Dict[str, Any]:
+        if not self.alive or self.health_sock is None:
+            raise HostDown(f"host {self.host_id} is down")
+        if self._desynced:
+            self._resync()
+        try:
+            return rpc(
+                self.health_sock, "poll",
+                timeout=self.heartbeat_s,
+                since=self.health.get("version"),
+            )
+        except socket.timeout:
+            # ONE missed heartbeat is counted, not fatal (the router
+            # tolerates heartbeat_misses of them). Must precede the
+            # OSError arm: socket.timeout IS an OSError subclass.
+            self._desynced = True
+            raise
+        except (ConnectionError, OSError) as e:
+            raise HostDown(
+                f"host {self.host_id} health connection failed: {e}"
+            ) from e
+
+    def _resync(self) -> None:
+        """A timed-out poll abandoned its reply: the late frame (whole
+        — or partial, since the timeout may have consumed some of its
+        bytes) is still in the stream, and reading the next reply from
+        here would be one snapshot stale forever, or land mid-frame
+        and unpack payload bytes as a length prefix (which reads as a
+        corrupt connection and would SIGKILL a healthy worker). Drain
+        until the stream goes quiet; snapshots are idempotent, so the
+        discarded replies cost nothing."""
+        s = self.health_sock
+        closed = False
+        try:
+            s.settimeout(0.05)
+            while True:
+                if not s.recv(65536):
+                    closed = True
+                    break
+        except socket.timeout:
+            self._desynced = False  # quiet: frame boundary restored
+        except (ConnectionError, OSError) as e:
+            raise HostDown(
+                f"host {self.host_id} health connection failed "
+                f"during resync: {e}"
+            ) from e
+        if closed:
+            raise HostDown(
+                f"host {self.host_id} health connection closed "
+                f"during resync"
+            )
+
+    def kill(self) -> None:
+        self.alive = False
+        for s in (self.control, self.health_sock):
+            try:
+                if s is not None:
+                    s.close()
+            except OSError:
+                pass
+        self.control = self.health_sock = None
+        if self.proc.poll() is None:
+            self.proc.kill()
+        try:
+            self.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def shutdown(self) -> None:
+        if self.alive and self.control is not None:
+            try:
+                self.call("shutdown", timeout=120.0)
+            except Exception:
+                pass
+            try:
+                # let the worker finish its clean close (drain the
+                # streamer, write server_meta) before the backstop kill
+                self.proc.wait(timeout=180)
+            except subprocess.TimeoutExpired:
+                pass
+        self.kill()
+
+
+class _ClusterQueue:
+    """Duck-typed ``RequestQueue`` view for the front door's pump gate:
+    cluster-wide queued count against cluster-wide depth."""
+
+    def __init__(self, owner: "ClusterServer"):
+        self._owner = owner
+        self.max_depth = 0
+
+    def __len__(self) -> int:
+        o = self._owner
+        return sum(
+            h.health["queue_depth"] for h in o.hosts.values() if h.alive
+        ) + len(o._limbo) + len(o._displaced)
+
+
+class _BucketView:
+    """Duck-typed bucket for the front door's drain check
+    (``b.busy()``) and composite discovery."""
+
+    def __init__(self, owner: "ClusterServer", name: str):
+        self._owner = owner
+        self.name = name
+
+    def busy(self) -> int:
+        return sum(
+            h.health["lanes_busy"]
+            for h in self._owner.hosts.values()
+            if h.alive
+        )
+
+
+class ClusterServer:
+    """Multi-host serving: one worker per host behind this router.
+
+    Parameters
+    ----------
+    buckets:
+        The same ``{name: bucket_config}`` mapping as ``SimServer`` —
+        every host serves every bucket (homogeneous fleet; the
+        fingerprint is verified at join).
+    hosts:
+        Host count. Simulated-hosts mode on one box: the router spawns
+        that many workers (``local=False``, real processes over
+        localhost TCP) or runs them in-process (``local=True``).
+    cluster_dir:
+        Root for everything host-crossing: ``out/`` (shared result
+        logs), ``tiers/`` (shared snapshot tier + hold spills — what
+        failover re-adopts from), ``host<k>/`` (per-host WAL dir,
+        worker config/log/meta).
+    queue_depth:
+        PER-HOST bounded queue depth (cluster capacity is the sum).
+    worker:
+        Extra ``SimServer`` kwargs forwarded to every worker
+        (``pipeline``, ``check_finite``, ``mesh``, per-worker
+        ``faults`` spec, ...).
+    heartbeat_s / heartbeat_misses:
+        Health poll timeout and how many consecutive misses declare a
+        host down. Health polls are answered lock-free by the worker,
+        so a long compile never reads as death; a SIGKILLed worker
+        fails the connection outright and is declared down
+        immediately.
+    steal_threshold / steal_batch:
+        A host whose queue depth reaches the threshold while another
+        host idles with free lanes loses up to ``steal_batch`` queued
+        requests per router tick to the idle host. The threshold also
+        bounds locality routing: a prefix owner backed up past it
+        loses its stickiness for new forks.
+    faults:
+        A ``FaultPlan`` for ROUTER-level chaos: ``host_down`` faults
+        fire here (SIGKILLing spawned workers). Worker-level faults
+        (nan/io_error/kill seams) ride ``worker={"faults": spec}``.
+    trace_dir:
+        Arm tracing: the router's spans land in
+        ``<trace_dir>/cluster.trace``; each worker traces to
+        ``<trace_dir>/host<k>/serve.trace`` with a ``host`` label on
+        every event.
+    worker_env:
+        Extra environment for spawned workers (e.g. ``XLA_FLAGS`` for
+        simulated devices under a per-host mesh).
+    """
+
+    def __init__(
+        self,
+        buckets: Mapping[str, Mapping[str, Any]],
+        hosts: int = 2,
+        cluster_dir: Optional[str] = None,
+        queue_depth: int = 64,
+        local: bool = False,
+        worker: Optional[Mapping[str, Any]] = None,
+        heartbeat_s: float = 5.0,
+        heartbeat_misses: int = 3,
+        poll_s: float = 0.01,
+        rpc_timeout_s: float = 300.0,
+        steal_threshold: int = 2,
+        steal_batch: int = 2,
+        faults: Optional[FaultPlan] = None,
+        trace_dir: Optional[str] = None,
+        worker_env: Optional[Mapping[str, str]] = None,
+        spawn_timeout_s: float = 300.0,
+    ):
+        if int(hosts) < 1:
+            raise ValueError(f"hosts={hosts} must be >= 1")
+        if not cluster_dir:
+            raise ValueError(
+                "ClusterServer needs a cluster_dir (shared logs, "
+                "tiers, and per-host WALs live under it)"
+            )
+        self.n_hosts = int(hosts)
+        self.cluster_dir = os.path.abspath(cluster_dir)
+        self.out_dir = os.path.join(self.cluster_dir, OUT_DIR)
+        self.tier_dir = os.path.join(self.cluster_dir, TIER_DIR)
+        os.makedirs(self.out_dir, exist_ok=True)
+        os.makedirs(self.tier_dir, exist_ok=True)
+        self.sink = "log"  # the front door's duck check
+        self.local = bool(local)
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_misses = int(heartbeat_misses)
+        self.poll_s = float(poll_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.steal_threshold = int(steal_threshold)
+        self.steal_batch = int(steal_batch)
+        self.faults = faults if faults is not None else FaultPlan(None)
+        self.trace_dir = trace_dir
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            self.trace: Any = Tracer(
+                os.path.join(trace_dir, "cluster.trace"),
+                extra={"role": "router"},
+            )
+        else:
+            self.trace = NullTracer()
+        self.faults.trace = self.trace
+        self._metrics = ServerMetrics()
+        self.queue = _ClusterQueue(self)
+        self.queue.max_depth = int(queue_depth) * self.n_hosts
+        self.buckets: Dict[str, _BucketView] = {
+            name: _BucketView(self, name) for name in buckets
+        }
+        self.tickets: Dict[str, ClusterTicket] = {}
+        self._rids = itertools.count()
+        self._limbo: List[Dict[str, Any]] = []      # stolen, unplaced
+        self._displaced: List[str] = []             # failover retries
+        self._dead_events: Dict[int, List[Dict[str, Any]]] = {}
+        self._rid_dead_host: Dict[str, int] = {}
+        self._prefix_owner: Dict[str, int] = {}
+        self._ticks = 0
+        self._closed = False
+        self.hosts: Dict[int, _Host] = {}
+        worker = dict(worker or {})
+        self._spawn(buckets, worker, queue_depth, worker_env,
+                    float(spawn_timeout_s))
+        self._recovered = self._mirror_recovered()
+
+    # -- bring-up ------------------------------------------------------------
+
+    def _worker_kwargs(
+        self, host_id: int, buckets, worker, queue_depth,
+    ) -> Dict[str, Any]:
+        host_dir = os.path.join(
+            self.cluster_dir, HOST_DIR.format(host_id)
+        )
+        os.makedirs(host_dir, exist_ok=True)
+        kwargs: Dict[str, Any] = {
+            "queue_depth": int(queue_depth),
+            "out_dir": self.out_dir,
+            "sink": "log",
+            "tier_dir": self.tier_dir,
+            "recover_dir": os.path.join(host_dir, "wal"),
+            **worker,
+        }
+        if self.trace_dir:
+            kwargs.setdefault(
+                "trace_dir",
+                os.path.join(self.trace_dir, f"host{host_id:02d}"),
+            )
+        return kwargs
+
+    def _spawn(self, buckets, worker, queue_depth, worker_env,
+               spawn_timeout_s) -> None:
+        if self.local:
+            from lens_tpu.serve import SimServer
+
+            for k in range(self.n_hosts):
+                host_dir = os.path.join(
+                    self.cluster_dir, HOST_DIR.format(k)
+                )
+                kwargs = self._worker_kwargs(
+                    k, buckets, worker, queue_depth
+                )
+                fault_spec = kwargs.pop("faults", None)
+                if fault_spec is not None:
+                    # same conversion the subprocess entry does
+                    # (worker._build_server): a worker faults spec
+                    # injects in local mode too
+                    kwargs["faults"] = FaultPlan.from_spec(fault_spec)
+                srv = SimServer(buckets, **kwargs)
+                srv.meta_dir = host_dir
+                _offset_ids(srv, ID_SPAN * (k + 1))
+                if srv.trace:
+                    srv.trace.extra = {"host": k}
+                self.hosts[k] = LocalHost(
+                    k, host_dir, WorkerCore(srv, k)
+                )
+            return
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(2 * self.n_hosts + 2)
+        port = listener.getsockname()[1]
+        procs: Dict[int, subprocess.Popen] = {}
+        logs = []
+        try:
+            for k in range(self.n_hosts):
+                host_dir = os.path.join(
+                    self.cluster_dir, HOST_DIR.format(k)
+                )
+                kwargs = self._worker_kwargs(
+                    k, buckets, worker, queue_depth
+                )
+                cfg = {
+                    "host_id": k,
+                    "n_hosts": self.n_hosts,
+                    "join_host": "127.0.0.1",
+                    "join_port": port,
+                    "buckets": {
+                        n: dict(c or {}) for n, c in buckets.items()
+                    },
+                    "server": kwargs,
+                    "meta_dir": host_dir,
+                }
+                cfg_path = os.path.join(host_dir, "worker.json")
+                with open(cfg_path, "w") as f:
+                    json.dump(cfg, f, indent=1, default=str)
+                log = open(os.path.join(host_dir, "worker.log"), "w")
+                logs.append(log)
+                env = dict(os.environ)
+                if worker_env:
+                    env.update(worker_env)
+                procs[k] = subprocess.Popen(
+                    [sys.executable, "-m", "lens_tpu",
+                     "cluster-worker", "--config", cfg_path],
+                    stdout=log, stderr=subprocess.STDOUT, env=env,
+                )
+            # accept control + health from every worker (jax import
+            # dominates the wait; workers come up in parallel)
+            deadline = time.monotonic() + spawn_timeout_s
+            pending = {(k, role) for k in procs
+                       for role in ("control", "health")}
+            conns: Dict[tuple, socket.socket] = {}
+            infos: Dict[int, Dict[str, Any]] = {}
+            while pending:
+                for k, p in procs.items():
+                    if p.poll() is not None and any(
+                        key[0] == k for key in pending
+                    ):
+                        raise RuntimeError(
+                            f"cluster worker host {k} exited rc="
+                            f"{p.returncode} during bring-up; see "
+                            f"{os.path.join(self.cluster_dir, HOST_DIR.format(k), 'worker.log')}"
+                        )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"cluster bring-up timed out with "
+                        f"{sorted(pending)} still unjoined"
+                    )
+                listener.settimeout(min(remaining, 1.0))
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                conn.settimeout(60)
+                hello = recv_msg(conn)
+                k = int(hello["host_id"])
+                role = hello.get("role")
+                if (k, role) not in pending:
+                    conn.close()
+                    raise RuntimeError(
+                        f"unexpected cluster join host={k} "
+                        f"role={role!r}"
+                    )
+                send_msg(conn, {"ok": True})
+                pending.discard((k, role))
+                conns[(k, role)] = conn
+                if role == "control":
+                    infos[k] = {
+                        kk: v for kk, v in hello.items()
+                        if kk not in ("op", "role")
+                    }
+            fps = {infos[k].get("fingerprint") for k in procs}
+            if len(fps) > 1:
+                raise RuntimeError(
+                    f"cluster workers disagree on the bucket "
+                    f"fingerprint: {sorted(fps)} — a heterogeneous "
+                    f"fleet would serve different bits under one id "
+                    f"space"
+                )
+            for k, p in procs.items():
+                host_dir = os.path.join(
+                    self.cluster_dir, HOST_DIR.format(k)
+                )
+                h = RemoteHost(
+                    k, host_dir, p,
+                    rpc_timeout_s=self.rpc_timeout_s,
+                    heartbeat_s=self.heartbeat_s,
+                )
+                h.control = conns[(k, "control")]
+                h.health_sock = conns[(k, "health")]
+                h.info = infos.get(k, {})
+                self.hosts[k] = h
+        except BaseException:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            raise
+        finally:
+            listener.close()
+            for log in logs:
+                log.close()
+
+    _RID_RE = re.compile(r"req-(\d+)$")
+
+    def _mirror_recovered(self) -> int:
+        """A rerun over an existing ``cluster_dir``: every worker just
+        replayed its per-host WAL at construction (``recover_dir`` is
+        always armed), re-queueing unfinished work and finalizing
+        WAL-attested results — but this router's mirror starts empty
+        and its rid mint at zero. Read the same WALs back (shared
+        filesystem): register a ``ClusterTicket`` per WAL-known client
+        rid, so ``status``/``result`` and the serve CLI's resume trim
+        see the previous invocation's work, and advance the mint past
+        every known id — a fresh submit minting ``req-000000`` against
+        a recovered ``req-000000`` would share its ticket slot AND its
+        shared ``out/`` log file. Returns the re-queued (unfinished)
+        count, which the ``recovered`` property reports before the
+        first health poll lands."""
+        requeued = 0
+        floor = -1
+        for h in self.hosts.values():
+            try:
+                events = read_events(h.wal_dir)
+            except FileNotFoundError:
+                continue  # first run: nothing to mirror
+            if not events:
+                continue
+            order, recs, retired, streamed, holds, _released = (
+                classify_events(events)
+            )
+            for rid in order:
+                m = self._RID_RE.match(rid)
+                if m is None or int(m.group(1)) >= ID_SPAN:
+                    # not router-minted: a worker-internal ticket
+                    # (id-mint offset space) — never mirrored
+                    continue
+                floor = max(floor, int(m.group(1)))
+                fin = retired.get(rid)
+                existing = self.tickets.get(rid)
+                if existing is not None and (
+                    existing.status != MIGRATED
+                    or (fin or {}).get("status") == MIGRATED
+                ):
+                    # a MIGRATED retire means the rid moved on: the
+                    # host holding the live copy wins the mirror slot
+                    continue
+                try:
+                    request = self._wal_request(rid, recs)
+                except (KeyError, ValueError, TypeError) as e:
+                    self.trace.instant(
+                        "cluster.mirror.skipped", rid=rid,
+                        host=h.host_id, error=str(e),
+                    )
+                    continue
+                t = ClusterTicket(
+                    request_id=rid, request=request, host=h.host_id,
+                    parent=recs[rid].get("parent"),
+                )
+                if fin is not None and not (
+                    fin.get("status") == DONE and rid not in streamed
+                ):
+                    # same WAL-attested-finished rule the workers
+                    # apply: a retired-DONE-but-unstreamed rid re-ran
+                    t.status = str(fin.get("status"))
+                    t.error = fin.get("error")
+                    t.steps_done = int(fin.get("steps", 0))
+                    t.diverged = (
+                        "SimulationDiverged" in str(t.error or "")
+                    )
+                    if rid in streamed:
+                        t.streamed_at = time.perf_counter()
+                    t.finished_at = time.perf_counter()
+                    path = os.path.join(self.out_dir, f"{rid}.lens")
+                    if os.path.exists(path):
+                        t.result_path = path
+                else:
+                    requeued += 1
+                self.tickets[rid] = t
+        if floor >= 0:
+            self._rids = itertools.count(floor + 1)
+        return requeued
+
+    def _wal_request(
+        self, rid: str, recs: Mapping[str, Mapping[str, Any]]
+    ) -> ScenarioRequest:
+        """The full-horizon request a WAL record denotes (mirror of
+        ``SimServer._effective_request``): a continuation extends its
+        parent chain's horizon."""
+        rec = recs[rid]
+        if "request" in rec:
+            return ScenarioRequest.from_mapping(rec["request"])
+        parent = self._wal_request(rec["parent"], recs)
+        return dc_replace(
+            parent,
+            horizon=(
+                float(parent.horizon) + float(rec["extra_horizon"])
+            ),
+        )
+
+    # -- placement -----------------------------------------------------------
+
+    def _live(self) -> List[_Host]:
+        return [h for h in self.hosts.values() if h.alive]
+
+    def _score(self, h: _Host) -> tuple:
+        s = h.health
+        return (
+            s["queue_depth"],
+            -s["free_lanes"],
+            s["lanes_busy"],
+            h.host_id,
+        )
+
+    def _route(self, request: ScenarioRequest) -> List[_Host]:
+        """Candidate hosts, best first. Locality: a prefix fork
+        prefers the host whose tier owns its snapshot unless that
+        host is backed up past steal_threshold (then the fork falls
+        back to the least-loaded host and re-resolves there)."""
+        live = sorted(self._live(), key=self._score)
+        if not live:
+            raise ValueError(
+                "every cluster host is down; the router has no "
+                "schedulable capacity"
+            )
+        key = self._prefix_key(request)
+        if key is not None:
+            owner = self._prefix_owner.get(key)
+            h = self.hosts.get(owner) if owner is not None else None
+            if (
+                h is not None and h.alive
+                and h.health["queue_depth"] < self.steal_threshold
+            ):
+                return [h] + [x for x in live if x is not h]
+        return live
+
+    @staticmethod
+    def _prefix_key(request: ScenarioRequest) -> Optional[str]:
+        spec = request.prefix_spec()
+        if spec is None:
+            return None
+        return json.dumps(spec, sort_keys=True, default=str)
+
+    # -- client surface ------------------------------------------------------
+
+    @property
+    def recovered(self) -> int:
+        """Requests the workers re-admitted from their own WALs at
+        bring-up (a rerun over an existing cluster_dir resumes). The
+        bring-up mirror count answers before the first health poll
+        populates the workers' own counters; max() because both count
+        the same replays."""
+        return max(
+            self._recovered,
+            sum(
+                h.health.get("counters", {}).get("recovered", 0)
+                for h in self.hosts.values()
+            ),
+        )
+
+    def reserve_id(self) -> str:
+        return f"req-{next(self._rids):06d}"
+
+    def reset_samples(self) -> None:
+        """Bench hygiene parity with ``SimServer.reset_samples``: the
+        router keeps no latency samples of its own (wall clocks live
+        in the workers), so this only clears the door-side histogram
+        state."""
+        self._metrics.reset_samples()
+
+    def retry_after_hint(self) -> float:
+        live = self._live()
+        if not live:
+            return 5.0
+        return max(
+            min(h.health["retry_after"] for h in live), 0.05
+        )
+
+    def validate(
+        self, request: ScenarioRequest | Mapping[str, Any]
+    ) -> ScenarioRequest:
+        """Shape-validate locally, schema-validate on a live worker
+        (override paths and grids live where the models do)."""
+        if isinstance(request, Mapping):
+            request = ScenarioRequest.from_mapping(request)
+        live = sorted(self._live(), key=self._score)
+        if not live:
+            raise ValueError(
+                "every cluster host is down; cannot validate"
+            )
+        from lens_tpu.serve.server import _request_to_json
+
+        for h in live:
+            try:
+                h.call("validate", request=_request_to_json(request))
+                return request
+            except HostDown:
+                self._declare_down(h.host_id, "validate RPC failed")
+        raise ValueError("every cluster host died during validation")
+
+    def submit(
+        self,
+        request: ScenarioRequest | Mapping[str, Any],
+        rid: Optional[str] = None,
+        host: Optional[int] = None,
+    ) -> str:
+        """Route one request to a host and mirror its ticket here.
+        ``host`` pins placement (tests/bench); default is the
+        locality/load score. All hosts full raises ``QueueFull`` with
+        the best (smallest) retry-after among them."""
+        if isinstance(request, Mapping):
+            request = ScenarioRequest.from_mapping(request)
+        rid = rid if rid is not None else self.reserve_id()
+        from lens_tpu.serve.server import _request_to_json
+
+        payload = _request_to_json(request)
+        if host is not None:
+            h = self.hosts.get(int(host))
+            if h is None or not h.alive:
+                raise ValueError(f"host {host} is not a live host")
+            candidates: List[_Host] = [h]
+        else:
+            candidates = self._route(request)
+        full: List[QueueFull] = []
+        for h in candidates:
+            try:
+                h.call("submit", request=payload, rid=rid)
+            except QueueFull as e:
+                full.append(e)
+                continue
+            except HostDown:
+                self._declare_down(h.host_id, "submit RPC failed")
+                continue
+            self._metrics.inc("submitted")
+            self._metrics.tenant_inc(request.tenant, "admitted")
+            t = ClusterTicket(
+                request_id=rid, request=request, host=h.host_id,
+            )
+            self.tickets[rid] = t
+            key = self._prefix_key(request)
+            if key is not None:
+                self._prefix_owner[key] = h.host_id
+            h.health["queue_depth"] += 1  # optimistic, until next poll
+            self.trace.instant(
+                "cluster.routed", rid=rid, host=h.host_id,
+            )
+            return rid
+        if full:
+            self._metrics.inc("rejected")
+            self._metrics.tenant_inc(request.tenant, "rejected")
+            raise QueueFull(
+                min(e.retry_after for e in full),
+                max(getattr(e, "depth", 0) for e in full),
+            )
+        raise ValueError(
+            "every cluster host is down; the router has no "
+            "schedulable capacity"
+        )
+
+    def _ticket(self, request_id: str) -> ClusterTicket:
+        t = self.tickets.get(request_id)
+        if t is None:
+            raise KeyError(f"unknown request id {request_id!r}")
+        return t
+
+    def status(self, request_id: str) -> Dict[str, Any]:
+        t = self._ticket(request_id)
+        h = self.hosts.get(t.host) if t.host is not None else None
+        if h is not None and h.alive:
+            try:
+                out = h.call("status", rid=request_id)
+                out.pop("ok", None)
+                self._apply_row(h, out | {"rid": request_id})
+                out["server"] = self._gauges()
+                return out
+            except HostDown:
+                self._declare_down(h.host_id, "status RPC failed")
+        return {
+            "request_id": request_id,
+            "status": t.status,
+            "steps_done": t.steps_done,
+            "horizon_steps": t.horizon_steps,
+            "error": t.error,
+            "result_path": t.result_path,
+            "parent": t.parent,
+            "host": t.host,
+            "timing": t.timing,
+            "server": self._gauges(),
+        }
+
+    def result(self, request_id: str) -> str:
+        """The request's ``.lens`` log path (shared filesystem),
+        after the owning worker attests the stream durable."""
+        t = self._ticket(request_id)
+        if t.diverged:
+            raise SimulationDiverged(t.error)
+        h = self.hosts.get(t.host) if t.host is not None else None
+        if h is not None and h.alive:
+            try:
+                reply = h.call("result", rid=request_id)
+            except HostDown:
+                self._declare_down(h.host_id, "result RPC failed")
+            else:
+                t.result_path = reply["path"]
+                return reply["path"]
+        if t.result_path and t.status in _TERMINAL and t.streamed_at:
+            return t.result_path
+        cause = f": {t.error}" if t.error else ""
+        raise ValueError(
+            f"request {request_id} ({t.status}) has no durable result "
+            f"and its host is gone{cause}"
+        )
+
+    def cancel(self, request_id: str) -> str:
+        t = self._ticket(request_id)
+        if t.status in _TERMINAL:
+            return t.status
+        if t.host is None:
+            # in limbo between hosts: cancel at the router
+            self._limbo = [
+                e for e in self._limbo if e["rid"] != request_id
+            ]
+            self._displaced = [
+                r for r in self._displaced if r != request_id
+            ]
+            t.status = CANCELLED
+            t.finished_at = time.perf_counter()
+            self._metrics.inc("cancelled")
+            return t.status
+        h = self.hosts.get(t.host)
+        if h is None or not h.alive:
+            return t.status
+        try:
+            reply = h.call("cancel", rid=request_id)
+        except HostDown:
+            self._declare_down(h.host_id, "cancel RPC failed")
+            return t.status
+        t.status = reply["status"]
+        return t.status
+
+    def resubmit(self, request_id: str, extra_horizon: float) -> str:
+        """Extend a held DONE request — routed to the host holding its
+        snapshot; if that host died, the parent re-adopts onto a
+        survivor from the dead WAL + shared tier first."""
+        t = self._ticket(request_id)
+        h = self.hosts.get(t.host) if t.host is not None else None
+        if h is None or not h.alive:
+            h = self._adopt_finished(t)
+        reply = h.call(
+            "resubmit", rid=request_id,
+            extra_horizon=float(extra_horizon),
+        )
+        new_rid = reply["rid"]
+        self._metrics.inc("resubmitted")
+        self.tickets[new_rid] = ClusterTicket(
+            request_id=new_rid,
+            request=dc_replace(
+                t.request,
+                horizon=float(t.request.horizon)
+                + float(extra_horizon),
+            ),
+            host=h.host_id,
+            parent=request_id,
+        )
+        return new_rid
+
+    def release_state(self, request_id: str) -> None:
+        t = self._ticket(request_id)
+        h = self.hosts.get(t.host) if t.host is not None else None
+        if h is None or not h.alive:
+            return  # the hold died with its host's device memory
+        h.call("release", rid=request_id)
+
+    def prewarm(self, spec: Mapping[str, Any]) -> None:
+        """Speculatively warm a prefix on the host that owns it (or
+        the least-loaded host for a cold one), and make that host the
+        prefix's locality owner so the forks this warming anticipates
+        route to the warmed snapshot."""
+        key = json.dumps({
+            "composite": spec["composite"],
+            "seed": int(spec.get("seed", 0)),
+            "horizon": float(spec["horizon"]),
+            "overrides": spec.get("overrides") or {},
+            "n_agents": spec.get("n_agents"),
+        }, sort_keys=True, default=str)
+        live = sorted(self._live(), key=self._score)
+        if not live:
+            return
+        owner = self._prefix_owner.get(key)
+        h = self.hosts.get(owner) if owner is not None else None
+        if h is None or not h.alive:
+            h = live[0]
+        h.call("prewarm", spec=dict(spec))
+        self._prefix_owner[key] = h.host_id
+
+    # -- scheduling ----------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One router iteration: injected host faults, local-host
+        ticks, health polls (the heartbeat), failover for newly dead
+        hosts, limbo/displaced drains, and one stealing pass."""
+        self._ticks += 1
+        self._metrics.inc("ticks")
+        for h in list(self.hosts.values()):
+            if h.alive and self.faults.host_down(h.host_id):
+                # the injected whole-host failure: a REAL SIGKILL for
+                # spawned workers (LocalHost marks itself dead)
+                h.kill()
+                self._declare_down(
+                    h.host_id, "FaultPlan host_down"
+                )
+        busy = False
+        for h in self.hosts.values():
+            if isinstance(h, LocalHost) and h.alive:
+                busy = h.tick() or busy
+        now = time.perf_counter()
+        for h in list(self.hosts.values()):
+            if not h.alive:
+                continue
+            if (
+                isinstance(h, RemoteHost)
+                and now - h.polled_at < self.poll_s
+            ):
+                # health mirrors are advisory: polling a remote worker
+                # faster than poll_s burns both sides' CPU shipping
+                # identical snapshots (LocalHosts are polled in-line —
+                # free — every tick)
+                busy = busy or h.health.get("busy", False)
+                continue
+            if (
+                isinstance(h, RemoteHost)
+                and h.proc.poll() is not None
+            ):
+                self._declare_down(
+                    h.host_id,
+                    f"worker process exited rc={h.proc.returncode}",
+                )
+                continue
+            try:
+                snap = h.poll()
+            except socket.timeout:
+                h.misses += 1
+                if h.misses >= self.heartbeat_misses:
+                    self._declare_down(
+                        h.host_id,
+                        f"heartbeat lost ({h.misses} consecutive "
+                        f"misses at {self.heartbeat_s}s)",
+                    )
+                continue
+            except HostDown as e:
+                self._declare_down(h.host_id, str(e))
+                continue
+            h.misses = 0
+            h.polled_at = now
+            if not snap.get("unchanged"):
+                h.health = {**h.health, **{
+                    k: v for k, v in snap.items() if k != "ok"
+                }}
+                for row in h.health.get("tickets", ()):
+                    self._apply_row(h, row)
+            if not snap.get("unchanged", False) and not h.health.get(
+                "alive", True
+            ):
+                self._declare_down(
+                    h.host_id,
+                    f"worker scheduler died: {h.health.get('error')}",
+                )
+                continue
+            busy = busy or h.health.get("busy", False)
+        self._drain_displaced()
+        self._drain_limbo()
+        self._steal_pass()
+        return bool(busy or self._limbo or self._displaced)
+
+    def run_until_idle(self, max_ticks: Optional[int] = None) -> int:
+        """Drive ``tick`` until every host reports idle (two
+        consecutive quiet passes — health mirrors are one poll stale
+        by construction)."""
+        ticks = 0
+        quiet = 0
+        while True:
+            busy = self.tick()
+            ticks += 1
+            if busy:
+                quiet = 0
+            else:
+                quiet += 1
+                if quiet >= 2:
+                    return ticks
+            if max_ticks is not None and ticks >= max_ticks:
+                raise RuntimeError(
+                    f"cluster not idle after {ticks} router ticks "
+                    f"(queue={len(self.queue)}, "
+                    f"limbo={len(self._limbo)})"
+                )
+            if not self.local:
+                # workers tick themselves; the router only needs to
+                # wake for routing decisions. When every host is busy
+                # and nothing waits on the router, back off — on an
+                # oversubscribed box each router wakeup preempts a
+                # worker's compute
+                idle_router = (
+                    not self._limbo and not self._displaced
+                    and all(
+                        h.health.get("busy") or h.health["queue_depth"]
+                        for h in self._live()
+                    )
+                )
+                time.sleep(0.01 if idle_router else 0.002)
+
+    def _apply_row(self, h: _Host, row: Mapping[str, Any]) -> None:
+        rid = row.get("rid") or row.get("request_id")
+        t = self.tickets.get(rid)
+        if t is None or t.host != h.host_id:
+            return  # stale owner (stolen/displaced) or internal
+        t.status = row["status"]
+        t.error = row.get("error")
+        t.steps_done = int(row.get("steps_done", t.steps_done))
+        t.horizon_steps = int(
+            row.get("horizon_steps", t.horizon_steps)
+        )
+        t.diverged = bool(row.get("diverged", False))
+        if row.get("result_path"):
+            t.result_path = row["result_path"]
+        if row.get("timing"):
+            t.timing = dict(row["timing"])
+        t.requeues = int(row.get("requeues", 0)) + t._fail_epochs
+        if row.get("streamed"):
+            if t.streamed_at is None:
+                t.streamed_at = time.perf_counter()
+        else:
+            t.streamed_at = None
+        if t.status in _TERMINAL and t.finished_at is None:
+            t.finished_at = time.perf_counter()
+
+    # -- work-stealing -------------------------------------------------------
+
+    def _steal_pass(self) -> None:
+        live = self._live()
+        if len(live) < 2:
+            return
+        donor = max(live, key=lambda h: h.health["queue_depth"])
+        if donor.health["queue_depth"] < self.steal_threshold:
+            return
+        takers = [
+            h for h in live
+            if h is not donor
+            and h.health["queue_depth"] == 0
+            and h.health["free_lanes"] > 0
+        ]
+        if not takers:
+            return
+        taker = max(takers, key=lambda h: h.health["free_lanes"])
+        want = min(
+            self.steal_batch,
+            taker.health["free_lanes"],
+            donor.health["queue_depth"] - 1,
+        )
+        if want < 1:
+            return
+        try:
+            reply = donor.call("withdraw", count=want)
+        except HostDown:
+            self._declare_down(donor.host_id, "withdraw RPC failed")
+            return
+        stolen = reply.get("requests", [])
+        if not stolen:
+            return
+        donor.health["queue_depth"] = max(
+            donor.health["queue_depth"] - len(stolen), 0
+        )
+        for item in stolen:
+            rid = item["rid"]
+            self._metrics.inc("stolen")
+            t = self.tickets.get(rid)
+            if t is not None:
+                t.host = None
+            self.trace.instant(
+                "cluster.stolen", rid=rid,
+                src=donor.host_id, dst=taker.host_id,
+            )
+            self._place(rid, item["request"], prefer=taker)
+
+    def _place(
+        self, rid: str, request_json: Mapping[str, Any],
+        prefer: Optional[_Host] = None,
+    ) -> None:
+        """(Re)submit a router-held request (stolen or displaced-
+        retry) under its original id; unplaceable work stays in
+        limbo for the next tick."""
+        t = self.tickets.get(rid)
+        candidates = sorted(self._live(), key=self._score)
+        if prefer is not None and prefer.alive:
+            candidates = [prefer] + [
+                h for h in candidates if h is not prefer
+            ]
+        for h in candidates:
+            try:
+                h.call("submit", request=dict(request_json), rid=rid)
+            except QueueFull:
+                continue
+            except HostDown:
+                self._declare_down(h.host_id, "submit RPC failed")
+                continue
+            except (ValueError, KeyError) as e:
+                if t is not None:
+                    t.status = FAILED
+                    t.error = f"{type(e).__name__}: {e}"
+                    t.finished_at = time.perf_counter()
+                return
+            if t is not None:
+                t.host = h.host_id
+                t.status = QUEUED
+            h.health["queue_depth"] += 1
+            return
+        self._limbo.append({"rid": rid, "request": dict(request_json)})
+
+    def _drain_limbo(self) -> None:
+        if not self._limbo:
+            return
+        pending, self._limbo = self._limbo, []
+        if not self._live():
+            for item in pending:
+                t = self.tickets.get(item["rid"])
+                if t is not None and t.status not in _TERMINAL:
+                    t.status = FAILED
+                    t.error = (
+                        "every cluster host is down; request cannot "
+                        "be placed"
+                    )
+                    t.finished_at = time.perf_counter()
+            return
+        for item in pending:
+            if self.tickets.get(item["rid"], None) is not None and \
+                    self.tickets[item["rid"]].status in _TERMINAL:
+                continue  # cancelled while in limbo
+            self._place(item["rid"], item["request"])
+
+    # -- whole-host failover -------------------------------------------------
+
+    def down_host(self, host_id: int, reason: str = "operator") -> None:
+        """Operator call: declare a host down NOW — kill it (a real
+        SIGKILL for spawned workers), drain it from routing, and fail
+        its WAL-known work over to the survivors. The whole-host
+        analogue of ``SimServer.quarantine_device``."""
+        if host_id not in self.hosts:
+            raise KeyError(f"unknown host {host_id!r}")
+        h = self.hosts[host_id]
+        if not h.alive and host_id in self._dead_events:
+            return  # already down and failed over
+        h.kill()
+        self._declare_down(host_id, reason)
+
+    def _declare_down(self, host_id: int, reason: str) -> None:
+        h = self.hosts.get(host_id)
+        if h is None or (not h.alive and host_id in self._dead_events):
+            return
+        h.kill()  # idempotent; stops a half-dead worker writing
+        self._metrics.inc("hosts_down")
+        self.trace.instant(
+            "cluster.host_down", host=host_id, reason=reason,
+        )
+        try:
+            events = read_events(h.wal_dir)
+        except FileNotFoundError:
+            events = []
+        self._dead_events[host_id] = events
+        order, recs, retired, streamed, holds, released = (
+            classify_events(events)
+        )
+        undone = unfinished(order, retired, streamed)
+        todo: List[str] = []
+        for rid in undone:
+            t = self.tickets.get(rid)
+            if t is None or t.host != host_id:
+                continue  # stolen away earlier, or not ours
+            if t.status in _TERMINAL and t.streamed_at:
+                continue
+            todo.append(rid)
+        # requests the WAL attests FINISHED (retire + streamed for
+        # DONE) whose head mirror is stale — the kill can land between
+        # the worker's durable write and this router's next poll:
+        # finalize them from the WAL truth, never re-run them
+        for rid in order:
+            t = self.tickets.get(rid)
+            if (
+                t is None or t.host != host_id or rid in undone
+                or rid not in retired
+            ):
+                continue
+            fin = retired[rid]
+            t.status = str(fin.get("status"))
+            t.error = fin.get("error") or t.error
+            if rid in streamed and t.streamed_at is None:
+                t.streamed_at = time.perf_counter()
+            if t.result_path is None:
+                path = os.path.join(self.out_dir, f"{rid}.lens")
+                if os.path.exists(path):
+                    t.result_path = path
+            if t.finished_at is None:
+                t.finished_at = time.perf_counter()
+        # DONE requests with live holds re-adopt too (their spill in
+        # the shared tier keeps resubmit chains alive across the loss)
+        for rid in order:
+            t = self.tickets.get(rid)
+            if (
+                rid in holds and rid not in released
+                and rid not in todo
+                and t is not None and t.host == host_id
+                and t.status == DONE
+                and t.request.hold_state
+            ):
+                todo.append(rid)
+        for rid in todo:
+            t = self.tickets[rid]
+            t.host = None
+            self._rid_dead_host[rid] = host_id
+            if t.status not in _TERMINAL or not t.streamed_at:
+                t.status = QUEUED
+                t.streamed_at = None
+                t.result_path = None
+                t._fail_epochs += 1
+                t.requeues += 1
+        self._displaced.extend(todo)
+        self._drain_displaced()
+
+    def _drain_displaced(self) -> None:
+        if not self._displaced:
+            return
+        pending, self._displaced = self._displaced, []
+        survivors = sorted(self._live(), key=self._score)
+        if not survivors:
+            for rid in pending:
+                t = self.tickets.get(rid)
+                if t is not None and t.status not in _TERMINAL:
+                    t.status = FAILED
+                    t.error = (
+                        "every cluster host is down; displaced "
+                        "request cannot be re-queued"
+                    )
+                    t.finished_at = time.perf_counter()
+            return
+        # spread the displaced work over survivors by load, round
+        # robin from the emptiest — batched into ONE adopt RPC per
+        # (survivor, dead host): the events payload is the dead host's
+        # whole WAL, so per-rid calls would reship and re-classify it
+        # N times during exactly the window survivors are absorbing
+        # the dead host's load
+        groups: Dict[tuple, List[str]] = {}
+        for i, rid in enumerate(pending):
+            t = self.tickets.get(rid)
+            if t is None or (
+                t.status in _TERMINAL and not t.request.hold_state
+            ):
+                continue
+            h = survivors[i % len(survivors)]
+            dead = self._rid_dead_host.get(rid)
+            groups.setdefault((h.host_id, dead), []).append(rid)
+        for (host_id, dead), rids in groups.items():
+            h = self.hosts[host_id]
+            events = self._dead_events.get(dead, [])
+            if not h.alive:
+                self._displaced.extend(rids)
+                continue
+            try:
+                h.call(
+                    "adopt", events=events, rids=rids,
+                    timeout=self.rpc_timeout_s,
+                )
+            except HostDown:
+                self._declare_down(h.host_id, "adopt RPC failed")
+                self._displaced.extend(rids)
+                continue
+            except (ValueError, KeyError):
+                # one bad rid refused the batch MID-application (the
+                # worker adopts in order): retry one by one so it
+                # cannot take its batchmates down
+                self._adopt_one_by_one(h, dead, events, rids)
+                continue
+            for rid in rids:
+                self._mark_adopted(rid, dead, h)
+
+    def _adopt_one_by_one(
+        self, h: _Host, dead: Optional[int],
+        events: List[Dict[str, Any]], rids: List[str],
+    ) -> None:
+        """Per-rid adoption fallback after a refused batch — the old
+        (round-17-initial) granularity, where one continuation with a
+        lost spill fails alone. A rid the partial batch already
+        adopted answers with the duplicate-adoption refusal, which IS
+        adoption."""
+        for j, rid in enumerate(rids):
+            try:
+                h.call(
+                    "adopt", events=events, rids=[rid],
+                    timeout=self.rpc_timeout_s,
+                )
+            except HostDown:
+                self._declare_down(h.host_id, "adopt RPC failed")
+                self._displaced.extend(rids[j:])
+                return
+            except (ValueError, KeyError) as e:
+                if "duplicate adoption" not in str(e):
+                    t = self.tickets[rid]
+                    t.status = FAILED
+                    t.error = (
+                        f"failover adoption failed: "
+                        f"{type(e).__name__}: {e}"
+                    )
+                    t.finished_at = time.perf_counter()
+                    continue
+            self._mark_adopted(rid, dead, h)
+
+    def _mark_adopted(
+        self, rid: str, dead: Optional[int], h: _Host
+    ) -> None:
+        t = self.tickets[rid]
+        t.host = h.host_id
+        self._metrics.inc("requeued")
+        h.health["queue_depth"] += 1
+        self.trace.instant(
+            "cluster.failover", rid=rid,
+            src=dead, dst=h.host_id,
+        )
+
+    def _adopt_finished(self, t: ClusterTicket) -> _Host:
+        """Re-home a FINISHED ticket (held parent) from a dead host
+        onto the best survivor, for resubmit-after-failover."""
+        dead = (
+            t.host if t.host is not None
+            else self._rid_dead_host.get(t.request_id)
+        )
+        events = self._dead_events.get(dead)
+        if events is None:
+            raise ValueError(
+                f"request {t.request_id}'s host {dead} is gone and "
+                f"left no readable WAL; cannot re-home it"
+            )
+        survivors = sorted(self._live(), key=self._score)
+        if not survivors:
+            raise ValueError("every cluster host is down")
+        h = survivors[0]
+        h.call(
+            "adopt", events=events, rids=[t.request_id],
+            timeout=self.rpc_timeout_s,
+        )
+        t.host = h.host_id
+        self._rid_dead_host.pop(t.request_id, None)
+        return h
+
+    # -- observability -------------------------------------------------------
+
+    def _gauges(self) -> Dict[str, Any]:
+        live = self._live()
+        counters = self._summed_counters()
+        busy = counters.get("lane_windows_busy", 0)
+        total = counters.get("lane_windows_total", 0)
+        return {
+            "occupancy": (busy / total) if total else None,
+            "queue_depth": len(self.queue),
+            "lanes_busy": sum(
+                h.health["lanes_busy"] for h in live
+            ),
+            "lanes_total": sum(
+                h.health["lanes_total"] for h in live
+            ),
+            "quarantined_devices": sum(
+                h.health.get("quarantined_devices", 0) for h in live
+            ),
+            "hosts_alive": len(live),
+            "hosts_down": sorted(
+                h.host_id
+                for h in self.hosts.values()
+                if not h.alive
+            ),
+        }
+
+    def _summed_counters(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for h in self.hosts.values():
+            for k, v in h.health.get("counters", {}).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out
+
+    def cluster_info(self) -> Dict[str, Any]:
+        """Per-host identity + health for ``/healthz`` in cluster
+        mode (docs/serving.md, "Cluster serving")."""
+        head = self._metrics.counters
+        return {
+            "hosts": [
+                {
+                    "host": h.host_id,
+                    "alive": h.alive,
+                    "state": "serving" if h.alive else "down",
+                    "queue_depth": h.health["queue_depth"],
+                    "lanes_busy": h.health["lanes_busy"],
+                    "lanes_total": h.health["lanes_total"],
+                    "stolen": h.health.get("counters", {}).get(
+                        "stolen", 0
+                    ),
+                    "adopted": h.health.get("counters", {}).get(
+                        "adopted", 0
+                    ),
+                }
+                for h in self.hosts.values()
+            ],
+            "hosts_alive": len(self._live()),
+            "hosts_down": sorted(
+                h.host_id for h in self.hosts.values() if not h.alive
+            ),
+            "stolen": head["stolen"],
+            "requeued": head["requeued"],
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        """The cluster-wide live snapshot: summed worker counters
+        (plus the router's own routing/stealing/failover counters
+        under distinct names), cluster gauges, and one row per host."""
+        counters = self._summed_counters()
+        for k, v in self._metrics.counters.items():
+            if k in ("stolen", "requeued", "ticks"):
+                counters[f"router_{k}"] = v
+            elif k == "hosts_down":
+                counters[k] = v
+        gauges = self._gauges()
+        tenants: Dict[str, Dict[str, int]] = {}
+        for src in [self._metrics.tenants] + [
+            h.health.get("tenants", {}) for h in self.hosts.values()
+        ]:
+            for name, row in (src or {}).items():
+                agg = tenants.setdefault(name, {})
+                for k, v in row.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+        live = self._live()
+        return {
+            **gauges,
+            "counters": counters,
+            "retraces": sum(
+                h.health.get("retraces", 0) for h in live
+            ),
+            "snapshots_resident": sum(
+                h.health.get("snapshots_resident", 0) for h in live
+            ),
+            "snapshot_bytes": sum(
+                h.health.get("snapshot_bytes", 0) for h in live
+            ),
+            "latency_seconds": {"p50": None, "p95": None, "p99": None},
+            "tenants": tenants,
+            "hosts": [
+                {
+                    "host": h.host_id,
+                    "alive": h.alive,
+                    "queue_depth": h.health["queue_depth"],
+                    "lanes_busy": h.health["lanes_busy"],
+                    "lanes_total": h.health["lanes_total"],
+                    "counters": dict(h.health.get("counters", {})),
+                }
+                for h in self.hosts.values()
+            ],
+            "cluster": self.cluster_info(),
+        }
+
+    def prometheus_metrics(self) -> str:
+        """Cluster exposition: router counters plus per-host gauges
+        and counters, every per-host sample carrying a ``host``
+        label — the end-to-end attribution the multi-host view
+        needs."""
+        lines: List[str] = []
+
+        def emit(name, kind, help_, samples):
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {kind}")
+            lines.extend(samples)
+
+        head = self._metrics.counters
+        emit(
+            "lens_cluster_hosts_alive", "gauge",
+            "live hosts in the serving cluster",
+            [f"lens_cluster_hosts_alive {len(self._live())}"],
+        )
+        for name, help_ in (
+            ("stolen", "queued requests migrated by work-stealing"),
+            ("requeued", "requests re-queued by whole-host failover"),
+            ("hosts_down", "hosts declared down"),
+            ("submitted", "requests routed by this router"),
+            ("rejected", "submits refused cluster-wide"),
+        ):
+            emit(
+                f"lens_cluster_{name}_total", "counter", help_,
+                [f"lens_cluster_{name}_total {head[name]}"],
+            )
+        for gauge, help_ in (
+            ("queue_depth", "queued requests on the host"),
+            ("lanes_busy", "occupied lanes on the host"),
+            ("lanes_total", "schedulable lanes on the host"),
+        ):
+            emit(
+                f"lens_cluster_host_{gauge}", "gauge",
+                f"{help_} (label: host)",
+                [
+                    f'lens_cluster_host_{gauge}'
+                    f'{{host="{h.host_id}"}} '
+                    f'{h.health[gauge]}'
+                    for h in self.hosts.values()
+                ],
+            )
+        emit(
+            "lens_cluster_host_up", "gauge",
+            "1 while the host serves, 0 once drained (label: host)",
+            [
+                f'lens_cluster_host_up{{host="{h.host_id}"}} '
+                f'{1 if h.alive else 0}'
+                for h in self.hosts.values()
+            ],
+        )
+        for counter in ("submitted", "retired", "stolen", "adopted",
+                        "recovered", "requeued", "diverged"):
+            samples = [
+                f'lens_cluster_host_{counter}_total'
+                f'{{host="{h.host_id}"}} '
+                f'{h.health.get("counters", {}).get(counter, 0)}'
+                for h in self.hosts.values()
+            ]
+            emit(
+                f"lens_cluster_host_{counter}_total", "counter",
+                f"per-host {counter} (label: host)", samples,
+            )
+        # the router's own door-side metrics (tenant counters ride
+        # here in front-door deployments)
+        lines.append(self._metrics.prometheus_text())
+        return "\n".join(lines) + "\n"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        first_error: Optional[BaseException] = None
+        for h in self.hosts.values():
+            try:
+                h.shutdown()
+            except BaseException as e:
+                first_error = first_error or e
+        try:
+            meta = {
+                "cluster": self.cluster_info(),
+                "metrics": {
+                    k: v for k, v in self.metrics().items()
+                    if k != "hosts"
+                },
+                "hosts": self.n_hosts,
+                "out_dir": self.out_dir,
+            }
+            with open(
+                os.path.join(self.cluster_dir, "cluster_meta.json"),
+                "w",
+            ) as f:
+                json.dump(meta, f, indent=1, default=str)
+        except BaseException as e:
+            first_error = first_error or e
+        try:
+            self.trace.close()
+        except BaseException as e:
+            first_error = first_error or e
+        if first_error is not None:
+            raise first_error
+
+    def __enter__(self) -> "ClusterServer":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        try:
+            self.close()
+        except BaseException:
+            if exc_type is None:
+                raise

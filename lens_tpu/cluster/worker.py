@@ -1,0 +1,473 @@
+"""The per-host serve worker: one ``SimServer`` per host, behind RPC.
+
+A worker owns everything host-local — its resident lane pools (and
+device mesh), its shard-keyed snapshot tiers, its per-host WAL
+directory — and exposes the serve client surface over two localhost
+TCP connections to the cluster router (docs/serving.md, "Cluster
+serving"):
+
+- **control**: submit/withdraw/adopt/cancel/status/result/metrics —
+  every op that touches scheduler state, serialized with the tick
+  thread through one lock (the front door's proven threading model).
+- **health**: ping/poll answered LOCK-FREE from a snapshot the tick
+  thread publishes after every tick — a worker mid-compile (the first
+  window of a bucket can stall tens of seconds on this box) still
+  answers heartbeats instantly, so a slow compile is never mistaken
+  for a dead host.
+
+Identity: the router passes ``host_id`` in the worker config
+(simulated-hosts mode); a config with ``"distributed": true`` instead
+derives it from the jax.distributed runtime via
+:func:`lens_tpu.parallel.distributed.cluster_identity` — the real
+multi-host bring-up path, which this box cannot exercise beyond the
+single-process fallback.
+
+Request ids: the ROUTER mints every client rid; the worker's own mint
+is offset to ``10_000_000 * (host_id + 1)`` so server-internal tickets
+(prefix runs, warm scavengers) can never collide with router-minted
+ids — or with another host's internals after a failover adoption.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+from lens_tpu.cluster.protocol import encode_error, recv_msg, send_msg
+from lens_tpu.serve.metrics import request_timing_row
+
+#: Worker-internal id mint spacing per host (see module docstring).
+ID_SPAN = 10_000_000
+
+_REQ_RE = re.compile(r"^req-(\d+)$")
+
+
+def _offset_ids(server: Any, offset: int) -> None:
+    """Advance the worker's id mint past ``offset`` AND past every id
+    its recovery replayed (the mint must never move backwards)."""
+    top = int(offset)
+    for rid in server.tickets:
+        m = _REQ_RE.match(rid)
+        if m:
+            top = max(top, int(m.group(1)) + 1)
+    server.queue.skip_ids(top)
+
+
+def _ticket_row(t: Any) -> Dict[str, Any]:
+    """The per-ticket facts the router mirrors into its own table."""
+    return {
+        "rid": t.request_id,
+        "status": t.status,
+        "error": t.error,
+        "steps_done": int(t.steps_done),
+        "horizon_steps": int(t.horizon_steps),
+        "result_path": t.result_path,
+        "streamed": t.streamed_at is not None,
+        "requeues": int(t.requeues),
+        "diverged": bool(t.diverged),
+        "parent": t.parent,
+        "priority": t.request.priority,
+    }
+
+
+class WorkerCore:
+    """Op dispatch + tick loop over one ``SimServer``.
+
+    Shared by the subprocess worker (ops arrive over TCP) and the
+    router's in-process simulated hosts (ops arrive as direct calls,
+    JSON-roundtripped for wire parity) — the routing/stealing/failover
+    logic is therefore testable without spawning processes, while the
+    drills exercise the identical dispatch through real sockets.
+    """
+
+    #: Publish cadence while the scheduler is busy: the snapshot is
+    #: advisory routing/health state, and rebuilding every ticket row
+    #: at full tick rate is measurable CPU the windows want (the
+    #: router polls far slower than the server ticks anyway). State
+    #: CHANGES the router acts on (submit/cancel/adopt/withdraw)
+    #: publish immediately, bypassing the throttle.
+    PUBLISH_EVERY_S = 0.01
+    #: Idle refresh cadence: one publish the moment the scheduler
+    #: settles, then a slow heartbeat-refresh to catch stamps that can
+    #: land just after the final tick (the streamer thread's durable
+    #: mark). Rebuilding the ticket table every 2 ms idle-loop pass
+    #: would both burn CPU and bump the version each time, so a
+    #: router poll could never come back ``unchanged``.
+    IDLE_PUBLISH_EVERY_S = 0.25
+
+    def __init__(self, server: Any, host_id: int):
+        if server.sink != "log":
+            raise ValueError(
+                "cluster workers need sink='log': results must be "
+                "host-crossing files, not process memory"
+            )
+        self.server = server
+        self.host_id = int(host_id)
+        self.lock = threading.RLock()
+        self.error: Optional[BaseException] = None
+        self._version = 0
+        self._published: Dict[str, Any] = {}
+        self._published_at = 0.0
+        self._content: Dict[str, Any] = {}
+        self._settled = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.idle_sleep_s = 0.002
+        # pipeline on: a busy tick can return without blocking (all
+        # lanes mid-window, stream pipe not full) and the loop would
+        # spin a whole core against the windows' own compute — pace
+        # it. pipeline off: tick blocks through the window inline, so
+        # only a short yield is left (on an oversubscribed box the
+        # explicit sleep is the OS's rotation point between workers).
+        self.busy_sleep_s = (
+            0.001 if getattr(server, "pipeline", "on") == "on"
+            else 0.0005
+        )
+        self.publish()
+
+    # -- tick thread ---------------------------------------------------------
+
+    def start(self) -> "WorkerCore":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"cluster-worker-{self.host_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            busy = self.tick_once()
+            if not busy:
+                time.sleep(self.idle_sleep_s)
+            elif self.busy_sleep_s:
+                # pace the tick loop while windows compute: a busy
+                # spin here (the in-process driving style) would burn
+                # a whole core PER WORKER against the windows' own
+                # compute threads — on a small box that is measurable
+                # aggregate throughput. Windows run ~10ms+; sub-ms
+                # pacing costs <5% dispatch latency and frees the core
+                time.sleep(self.busy_sleep_s)
+
+    def tick_once(self) -> bool:
+        """One scheduler tick + snapshot publish. A fatal server error
+        (parked stream failure, watchdog) parks on ``self.error`` — the
+        published health names it, which the router reads as this host
+        failing, and every later control op refuses with the cause."""
+        with self.lock:
+            if self.error is not None:
+                return False
+            try:
+                busy = self.server.tick()
+            except BaseException as e:
+                self.error = e
+                self.publish()
+                return False
+            now = time.perf_counter()
+            if busy:
+                self._settled = False
+                if now - self._published_at >= self.PUBLISH_EVERY_S:
+                    self.publish()
+            elif not self._settled or now - self._published_at \
+                    >= self.IDLE_PUBLISH_EVERY_S:
+                self.publish()
+                self._settled = True
+        return busy or len(self.server.queue) > 0
+
+    def publish(self) -> None:
+        """Refresh the lock-free health/ticket snapshot (caller holds
+        the lock, or owns the server single-threadedly)."""
+        srv = self.server
+        m = srv._metrics
+        self._published_at = time.perf_counter()
+        m.queue_depth = len(srv.queue)
+        busy_lanes = sum(b.busy() for b in srv.buckets.values())
+        content = {
+            "host": self.host_id,
+            "alive": self.error is None,
+            "error": (
+                f"{type(self.error).__name__}: {self.error}"
+                if self.error is not None else None
+            ),
+            "queue_depth": len(srv.queue),
+            "lanes_busy": busy_lanes,
+            "lanes_total": sum(
+                b.lanes_total() for b in srv.buckets.values()
+            ),
+            "free_lanes": sum(
+                b.free_lanes() for b in srv.buckets.values()
+            ),
+            "busy": busy_lanes > 0 or len(srv.queue) > 0,
+            "retry_after": float(srv.retry_after_hint()),
+            "quarantined_devices": len(srv._quarantined),
+            "retraces": sum(
+                s.pool.retraces()
+                for b in srv.buckets.values()
+                for s in b.shards
+            ),
+            "snapshots_resident": len(srv.snapshots),
+            "snapshot_bytes": int(srv.snapshots.resident_bytes()),
+            # copies, not live references (both properties copy): the
+            # dedup below compares against the previous snapshot, so
+            # shared mutable state would read as "unchanged" forever
+            "tenants": m.tenants,
+            "counters": dict(m.counters),
+            "tickets": [
+                _ticket_row(t)
+                for t in srv.tickets.values()
+                if not t.internal
+            ],
+        }
+        if self._same_but_ticks(content, self._content):
+            # nothing moved: keep the version stable so the router's
+            # since= poll comes back "unchanged" (version-only bumps
+            # would ship the full ticket table on every heartbeat)
+            return
+        self._content = content
+        self._version += 1
+        self._published = {"version": self._version, **content}
+
+    @staticmethod
+    def _same_but_ticks(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+        """Snapshot equality, ignoring the scheduler's ``ticks``
+        counter: an idle server still ticks, and republishing the full
+        ticket table because ONLY the tick count moved defeats the
+        whole ``unchanged`` poll path (the advertised count going
+        slightly stale while idle is harmless — it is advisory)."""
+
+        def norm(c: Dict[str, Any]) -> Dict[str, Any]:
+            counters = dict(c.get("counters") or {})
+            counters.pop("ticks", None)
+            return {**c, "counters": counters}
+
+        return bool(b) and norm(a) == norm(b)
+
+    # -- health surface (lock-free) ------------------------------------------
+
+    def handle_health(self, msg: Mapping[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        snap = self._published  # one reference read: never torn
+        if op == "ping":
+            return {
+                "ok": True,
+                **{k: v for k, v in snap.items() if k != "tickets"},
+            }
+        if op == "poll":
+            if msg.get("since") == snap["version"]:
+                return {
+                    "ok": True, "version": snap["version"],
+                    "unchanged": True,
+                }
+            return {"ok": True, **snap}
+        return {
+            "ok": False, "error_type": "ValueError",
+            "error": f"unknown health op {op!r}",
+        }
+
+    # -- control surface -----------------------------------------------------
+
+    def handle_control(self, msg: Mapping[str, Any]) -> Dict[str, Any]:
+        try:
+            with self.lock:
+                return {"ok": True, **self._dispatch(msg)}
+        except BaseException as e:  # typed across the wire
+            return encode_error(e)
+
+    def _dispatch(self, msg: Mapping[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        srv = self.server
+        if self.error is not None and op not in ("shutdown", "hello"):
+            raise RuntimeError(
+                f"worker host {self.host_id} scheduler died: "
+                f"{type(self.error).__name__}: {self.error}"
+            )
+        if op == "hello":
+            return self.info()
+        if op == "validate":
+            srv.validate(msg["request"])
+            return {}
+        if op == "submit":
+            rid = srv.submit(msg["request"], rid=msg.get("rid"))
+            self.publish()
+            return {"rid": rid}
+        if op == "resubmit":
+            rid = srv.resubmit(
+                msg["rid"], float(msg["extra_horizon"])
+            )
+            self.publish()
+            return {"rid": rid}
+        if op == "release":
+            srv.release_state(msg["rid"])
+            return {}
+        if op == "cancel":
+            status = srv.cancel(msg["rid"])
+            self.publish()
+            return {"status": status}
+        if op == "status":
+            out = srv.status(msg["rid"])
+            t = srv.tickets[msg["rid"]]
+            out["timing"] = request_timing_row(t, srv._metrics._t0)
+            out["streamed"] = t.streamed_at is not None
+            out["requeues"] = int(t.requeues)
+            out["host"] = self.host_id
+            return out
+        if op == "result":
+            # log sink: result() drains this rid's stream, then hands
+            # back the (shared-filesystem) log path
+            return {"path": srv.result(msg["rid"])}
+        if op == "withdraw":
+            return {"requests": self._withdraw_batch(
+                int(msg.get("count", 1))
+            )}
+        if op == "adopt":
+            adopted = srv.adopt_displaced(
+                msg["events"], list(msg["rids"])
+            )
+            self.publish()
+            return {"adopted": adopted}
+        if op == "prewarm":
+            srv.prewarm(msg["spec"])
+            return {}
+        if op == "metrics":
+            return {"metrics": srv.metrics()}
+        if op == "prometheus":
+            return {"text": srv.prometheus_metrics()}
+        if op == "shutdown":
+            return {}
+        raise ValueError(f"unknown control op {op!r}")
+
+    def _withdraw_batch(self, count: int) -> List[Dict[str, Any]]:
+        """Withdraw up to ``count`` STEALABLE queued requests, youngest
+        first (the tail of the FIFO is the work least likely to start
+        soon — stealing it disturbs admission order least). Ineligible
+        tickets (running, waiting on a prefix, continuations, ...) are
+        skipped, not errors: the router asked for whatever can move."""
+        out: List[Dict[str, Any]] = []
+        for t in reversed(list(self.server.queue)):
+            if len(out) >= count:
+                break
+            rid = t.request_id
+            try:
+                request = self.server.withdraw(rid)
+            except (ValueError, KeyError):
+                continue
+            out.append({"rid": rid, "request": request})
+        if out:
+            self.publish()
+        return out
+
+    def info(self) -> Dict[str, Any]:
+        srv = self.server
+        return {
+            "host": self.host_id,
+            "pid": os.getpid(),
+            "buckets": sorted(srv.buckets),
+            "fingerprint": srv._fingerprint,
+            "lanes_total": sum(
+                b.lanes_total() for b in srv.buckets.values()
+            ),
+            "queue_depth_max": srv.queue.max_depth,
+            "out_dir": srv.out_dir,
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+        self.server.close()
+
+
+# -- subprocess entry (python -m lens_tpu cluster-worker) --------------------
+
+
+def _build_server(cfg: Mapping[str, Any]):
+    from lens_tpu.serve import FaultPlan, SimServer
+
+    kwargs = dict(cfg.get("server") or {})
+    faults = kwargs.pop("faults", None)
+    if faults is not None:
+        kwargs["faults"] = FaultPlan.from_spec(faults)
+    return SimServer(cfg["buckets"], **kwargs)
+
+
+def run_worker(config_path: str) -> int:
+    """Worker process main: build the host's ``SimServer`` from the
+    JSON config the router wrote, dial the router's control + health
+    connections, and serve ops until shutdown (or until the router
+    goes away — a worker never outlives its head)."""
+    with open(config_path) as f:
+        cfg = json.load(f)
+    host_id = cfg.get("host_id")
+    if cfg.get("distributed"):
+        # real multi-host bring-up: join the jax.distributed runtime
+        # and take identity from it when the config does not pin one
+        from lens_tpu.parallel.distributed import (
+            cluster_identity,
+            initialize,
+        )
+
+        initialize()
+        if host_id is None:
+            host_id, _ = cluster_identity()
+    host_id = int(host_id)
+    server = _build_server(cfg)
+    if cfg.get("meta_dir"):
+        server.meta_dir = cfg["meta_dir"]
+    _offset_ids(server, ID_SPAN * (host_id + 1))
+    if server.trace:
+        # every span/instant this worker emits carries its host label
+        server.trace.extra = {"host": host_id}
+    core = WorkerCore(server, host_id)
+    addr = (cfg.get("join_host", "127.0.0.1"), int(cfg["join_port"]))
+    control = socket.create_connection(addr, timeout=60)
+    send_msg(control, {
+        "op": "hello", "role": "control", "host_id": host_id,
+        **core.info(),
+    })
+    recv_msg(control)  # router ack
+    health = socket.create_connection(addr, timeout=60)
+    send_msg(health, {
+        "op": "hello", "role": "health", "host_id": host_id,
+    })
+    recv_msg(health)
+    control.settimeout(None)
+    health.settimeout(None)
+    core.start()
+
+    def _health_loop() -> None:
+        try:
+            while True:
+                msg = recv_msg(health)
+                send_msg(health, core.handle_health(msg))
+        except (ConnectionError, OSError, ValueError):
+            pass  # router gone; the control loop owns shutdown
+
+    threading.Thread(
+        target=_health_loop, name="cluster-health", daemon=True
+    ).start()
+    rc = 0
+    try:
+        while True:
+            try:
+                msg = recv_msg(control)
+            except (ConnectionError, OSError):
+                break  # router died: shut down cleanly
+            reply = core.handle_control(msg)
+            try:
+                send_msg(control, reply)
+            except (ConnectionError, OSError):
+                break
+            if msg.get("op") == "shutdown":
+                break
+    finally:
+        try:
+            core.close()
+        except BaseException as e:
+            print(f"cluster-worker: close error: {e}", flush=True)
+            rc = 1
+    return rc

@@ -826,9 +826,10 @@ class FrontDoor:
                 out = self.server.status(rid)
                 out["tenant"] = tenant.name
                 out["priority"] = t.request.priority
-                out["timing"] = request_timing_row(
-                    t, self.server._metrics._t0
-                )
+                if not out.get("timing"):
+                    out["timing"] = request_timing_row(
+                        t, self.server._metrics._t0
+                    )
                 return out
             if rid in self._done_at_door:
                 status, error = self._done_at_door[rid]
@@ -975,8 +976,12 @@ class FrontDoor:
     async def _get_healthz(self, req: _HttpRequest, writer) -> bool:
         def fetch():
             snap = self.server.metrics()
-            return {
+            out = {
                 "status": "draining" if self._draining else "ok",
+                # the serving-vs-draining contract, explicit: load
+                # balancers route on this field, and a draining door
+                # also answers 503 + Retry-After below
+                "state": "draining" if self._draining else "serving",
                 "occupancy": snap["occupancy"],
                 "queue_depth": snap["queue_depth"],
                 "lanes_busy": snap["lanes_busy"],
@@ -989,10 +994,25 @@ class FrontDoor:
                     "tenants": self.sched.snapshot(),
                 },
             }
+            # cluster mode: per-host identity + health (docs/serving.md,
+            # "Cluster serving") — the duck-typed router surface
+            info = getattr(self.server, "cluster_info", None)
+            if callable(info):
+                out["cluster"] = info()
+            return out
 
         payload = await self._locked(fetch)
-        status = 503 if self._draining else 200
-        await self._respond(writer, status, payload)
+        if self._draining:
+            # every drain-path 503 carries Retry-After (the same
+            # occupancy-derived hint submits quote), so health-checking
+            # clients and balancers back off instead of hammering
+            hint = max(self.server.retry_after_hint(), 1.0)
+            await self._respond(
+                writer, 503, payload,
+                extra_headers={"Retry-After": f"{hint:.3f}"},
+            )
+        else:
+            await self._respond(writer, 200, payload)
         return True
 
     async def _get_status(self, req: _HttpRequest, writer) -> bool:

@@ -130,7 +130,7 @@ class Tracer:
 
     enabled = True
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, extra: Optional[Dict[str, Any]] = None):
         self.path = path
         # write-only + fresh file: a trace describes ONE server run,
         # and a long-lived traced server must not retain (or replay)
@@ -143,6 +143,10 @@ class Tracer:
         self._lock = threading.Lock()
         self.t0 = time.perf_counter()
         self.events_emitted = 0
+        # labels stamped into EVERY event's args (cluster workers set
+        # {"host": k} so a merged multi-host view stays attributable
+        # end to end; empty for single-host servers — zero overhead)
+        self.extra: Dict[str, Any] = dict(extra or {})
 
     def __bool__(self) -> bool:
         return True
@@ -181,6 +185,8 @@ class Tracer:
         }
         if aid is not None:
             event["aid"] = str(aid)
+        if self.extra:
+            args = {**self.extra, **args}
         if args:
             event["args"] = _jsonable(args)
         self._emit(event)
@@ -195,6 +201,8 @@ class Tracer:
             "track": track,
             "ts": time.perf_counter() - self.t0,
         }
+        if self.extra:
+            args = {**self.extra, **args}
         if args:
             event["args"] = _jsonable(args)
         self._emit(event)

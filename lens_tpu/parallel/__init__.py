@@ -33,6 +33,7 @@ from lens_tpu.parallel.runner import ShardedSpatialColony
 from lens_tpu.parallel.multispecies import ShardedMultiSpeciesColony
 from lens_tpu.parallel.ensemble import ShardedEnsemble
 from lens_tpu.parallel.distributed import (
+    cluster_identity,
     coordinator_only,
     distribute,
     global_mesh,
@@ -55,4 +56,5 @@ __all__ = [
     "distribute",
     "is_coordinator",
     "coordinator_only",
+    "cluster_identity",
 ]

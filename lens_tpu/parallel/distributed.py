@@ -87,6 +87,39 @@ def initialize(
     return jax.process_count() > 1
 
 
+def cluster_identity(
+    host_id: Optional[int] = None,
+    n_hosts: Optional[int] = None,
+) -> tuple:
+    """(host index, host count) for cluster serving bring-up
+    (docs/serving.md, "Cluster serving").
+
+    Explicit arguments win — the simulated-hosts mode (the cluster
+    router spawning localhost workers) passes both. With neither
+    given, the identity comes from the jax.distributed runtime when
+    :func:`initialize` attached more than one process (one serve
+    worker per host, numbered by ``jax.process_index`` — the
+    Podracer/Sebulba shape: per-host actors behind a central work
+    source), and degrades to ``(0, 1)`` single-host otherwise.
+    Mixing one explicit value with one default is refused — a worker
+    that knows its index but not the fleet size (or vice versa)
+    indicates a broken launcher."""
+    if (host_id is None) != (n_hosts is None):
+        raise ValueError(
+            f"cluster_identity needs both host_id and n_hosts or "
+            f"neither, got host_id={host_id} n_hosts={n_hosts}"
+        )
+    if host_id is not None:
+        host_id, n_hosts = int(host_id), int(n_hosts)
+        if not 0 <= host_id < n_hosts:
+            raise ValueError(
+                f"host_id={host_id} out of range for "
+                f"n_hosts={n_hosts}"
+            )
+        return host_id, n_hosts
+    return jax.process_index(), jax.process_count()
+
+
 def is_coordinator() -> bool:
     """True on the process that owns IO (process 0; single-host: always)."""
     return jax.process_index() == 0

@@ -14,6 +14,7 @@ from lens_tpu.serve.batcher import (
     DONE,
     FAILED,
     INTERACTIVE,
+    MIGRATED,
     PRIORITIES,
     QUEUED,
     QueueFull,
@@ -38,6 +39,7 @@ __all__ = [
     "DONE",
     "FAILED",
     "INTERACTIVE",
+    "MIGRATED",
     "PRIORITIES",
     "QUEUED",
     "FaultPlan",

@@ -27,13 +27,17 @@ import time
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, Iterator, List, Mapping, Optional
 
-#: Request lifecycle states. Terminal: DONE, TIMEOUT, CANCELLED, FAILED.
+#: Request lifecycle states. Terminal: DONE, TIMEOUT, CANCELLED, FAILED,
+#: MIGRATED (this server handed the queued request to another host —
+#: cluster work-stealing; the request lives on under its original id on
+#: the host that adopted it, so MIGRATED is terminal only locally).
 QUEUED = "queued"
 RUNNING = "running"
 DONE = "done"
 TIMEOUT = "timeout"
 CANCELLED = "cancelled"
 FAILED = "failed"
+MIGRATED = "migrated"
 
 #: Admission priority classes, highest first. ``interactive`` requests
 #: are admitted ahead of ``batch`` ones whenever both wait for a lane

@@ -34,6 +34,13 @@ Fault kinds and their seams:
   from scheduling, and re-queues its requests onto survivors
   (docs/serving.md, "Mesh serving & device failover"); the
   kill-one-device drill injector.
+- ``host_down`` (seam ``cluster.host``): declare a whole HOST dead at
+  the N-th cluster-router health pass over it (optionally filtered to
+  one ``host``) — the router SIGKILLs a spawned worker process (or
+  marks an in-process simulated host dead), drains it from routing,
+  and fails its WAL-known work over to the surviving hosts
+  (docs/serving.md, "Cluster serving"); the kill-one-host drill
+  injector.
 - ``kill`` (any seam in :data:`KILL_SEAMS`): ``SIGKILL`` the process
   at a named scheduler/WAL seam — the crash-recovery pins
   (tests/test_recovery.py) SIGKILL at every one of these and require
@@ -72,11 +79,12 @@ _KIND_SEAMS = {
     "io_error": "sink.append",
     "stall": "stream.window",
     "device_down": "shard.window",
+    "host_down": "cluster.host",
 }
 
 _FAULT_KEYS = {
     "kind", "at", "request", "after_steps", "occurrence", "seconds",
-    "p", "shard",
+    "p", "shard", "host",
 }
 
 
@@ -178,10 +186,28 @@ class FaultPlan:
                     raise ValueError(
                         f"fault {i}: shard={shard} must be >= 0"
                     )
-            if kind == "device_down" and f.get("request") is not None:
+            host = f.get("host")
+            if host is not None:
+                if kind != "host_down":
+                    raise ValueError(
+                        f"fault {i}: 'host' only applies to "
+                        f"host_down faults (kind {kind!r} has no "
+                        f"host context)"
+                    )
+                if int(host) < 0:
+                    raise ValueError(
+                        f"fault {i}: host={host} must be >= 0"
+                    )
+                # the generic matcher's shard slot doubles as the host
+                # index (both are "which failure domain" filters)
+                shard = host
+            if kind in ("device_down", "host_down") \
+                    and f.get("request") is not None:
                 raise ValueError(
-                    f"fault {i}: device_down faults target a device, "
-                    f"not a request (use 'shard'/'occurrence')"
+                    f"fault {i}: {kind} faults target a failure "
+                    f"domain, not a request (use "
+                    f"'{'host' if kind == 'host_down' else 'shard'}'"
+                    f"/'occurrence')"
                 )
             self.faults.append(Fault(
                 kind=str(kind),
@@ -302,3 +328,12 @@ class FaultPlan:
         attempt per shard, so ``occurrence`` counts that shard's
         windows."""
         return bool(self.fire("shard.window", shard=shard))
+
+    def host_down(self, host: int) -> bool:
+        """True when a host_down fault fires for this host at this
+        cluster-router health pass (the router then kills/drains the
+        host and fails its WAL-known work over to the survivors —
+        docs/serving.md, "Cluster serving"). The seam fires once per
+        router tick per live host, so ``occurrence`` counts that
+        host's health passes."""
+        return bool(self.fire("cluster.host", shard=host))

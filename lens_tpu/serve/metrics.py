@@ -70,6 +70,9 @@ _COUNTER_HELP = {
     "diverged": "lanes quarantined by the per-window finite check",
     "recovered": "unfinished WAL requests re-admitted at startup",
     "requeued": "requests displaced from a quarantined device",
+    "stolen": "queued requests withdrawn by the cluster router",
+    "adopted": "displaced requests adopted from another host's WAL",
+    "hosts_down": "cluster hosts declared down by the router",
     "sink_failed": "requests failed by a request-scoped sink error",
 }
 

@@ -93,6 +93,7 @@ from lens_tpu.serve.batcher import (
     CANCELLED,
     DONE,
     FAILED,
+    MIGRATED,
     PRIORITIES,
     QUEUED,
     QueueFull,
@@ -151,6 +152,7 @@ from lens_tpu.serve.wal import (
     WAL_NAME,
     ServeWal,
     buckets_fingerprint,
+    classify_events,
     key_from_json,
     key_to_json,
 )
@@ -169,6 +171,13 @@ BUCKET_DEFAULTS: Dict[str, Any] = {
     "timestep": 1.0,        # sim seconds per step
     "emit_every": 1,        # device emit cadence within the window
 }
+
+
+def _strip_seq(event: Mapping[str, Any]) -> Dict[str, Any]:
+    """A WAL event without its source log's ``seq`` stamp — events
+    copied across hosts during failover adoption are re-stamped by the
+    destination WAL's own sequence."""
+    return {k: v for k, v in event.items() if k != "seq"}
 
 
 def _tree_to_json(tree: Mapping) -> Dict[str, Any]:
@@ -662,6 +671,11 @@ class SimServer:
             b.lanes_total() for b in self.buckets.values()
         )
         self.out_dir = out_dir
+        # where server_meta.json lands at close (defaults to out_dir;
+        # cluster workers share one out_dir for result logs but must
+        # not clobber each other's meta, so each points this at its
+        # own per-host directory)
+        self.meta_dir = out_dir
         self.sink = sink
         self.stream_flush = stream_flush
         self.flush_every = int(flush_every)
@@ -1607,6 +1621,163 @@ class SimServer:
         if t is None:
             raise KeyError(f"unknown request id {request_id!r}")
         return t
+
+    def withdraw(self, request_id: str) -> Dict[str, Any]:
+        """Remove a QUEUED request from this server and hand back its
+        exact submit-time mapping — the work-stealing egress
+        (docs/serving.md, "Cluster serving": the router migrates
+        queued work from a backed-up host's FIFO to an idle one).
+
+        Only plain queued client requests are eligible. Running or
+        terminal work, internal prefix/warm runs, coalesced forks
+        still waiting on an in-flight prefix, forks already seeded
+        with a device-resident tree, and resubmit continuations
+        (their held snapshot lives here) refuse with a descriptive
+        ``ValueError`` — the router skips them and steals the next
+        candidate. A fork that merely PINNED a cached snapshot at
+        submit migrates fine: its pin is released here and the prefix
+        re-resolves wherever it lands (recompute, or a shared-tier
+        disk hit).
+
+        The withdrawn request retires ``MIGRATED`` locally and the
+        retirement is WAL'd, so this host's own recovery (and a
+        whole-host failover over this host's WAL) never re-runs it —
+        it lives on under its original id wherever the router
+        resubmits it.
+        """
+        t = self._ticket(request_id)
+        if t.internal or t.warm:
+            raise ValueError(
+                f"request {request_id} is a server-internal run; "
+                f"internal work is never stolen"
+            )
+        if t.status != QUEUED:
+            raise ValueError(
+                f"request {request_id} is {t.status}, not queued; "
+                f"only queued requests migrate"
+            )
+        if t.waiting:
+            raise ValueError(
+                f"request {request_id} is coalesced onto an in-flight "
+                f"prefix run here; it migrates only before or after "
+                f"the prefix resolves"
+            )
+        if t.carry_state is not None:
+            raise ValueError(
+                f"request {request_id} already holds a device-resident "
+                f"seed on this host; not stealable"
+            )
+        if t.parent is not None:
+            raise ValueError(
+                f"request {request_id} continues {t.parent}, whose "
+                f"held snapshot lives on this host; continuations "
+                f"do not migrate"
+            )
+        if not self.queue.drop(t):
+            raise ValueError(
+                f"request {request_id} left the queue mid-steal"
+            )
+        payload = _request_to_json(t.request)
+        self._finish(t, MIGRATED)
+        self._metrics.inc("stolen")
+        self._metrics.queue_depth = len(self.queue)
+        self.trace.instant(
+            "cluster.withdrawn", rid=request_id, tick=self._ticks
+        )
+        return payload
+
+    def adopt_displaced(
+        self,
+        events: List[Mapping[str, Any]],
+        rids: List[str],
+    ) -> List[str]:
+        """Adopt requests DISPLACED from another host: re-queue each
+        rid in ``rids`` under its original id, reconstructed from the
+        dead host's merged WAL ``events`` — whole-host failover's
+        ingress (docs/serving.md, "Cluster serving"), the per-host
+        generalization of device-quarantine requeues. Continuations
+        re-arm from their parent's spilled snapshot, which both hosts
+        reach through the shared tier directory.
+
+        The adopted rids' event closure (submit/resubmit chain, hold
+        spills, the parents' terminal facts) is COPIED into this
+        host's own WAL first, so a later crash here recovers them like
+        native work; the determinism contract makes the re-run a
+        bitwise resume either way. Returns the adopted rids."""
+        order, recs, retired, streamed, holds, released = (
+            classify_events(events)
+        )
+        adopted: List[str] = []
+        walled: set = set()
+        for rid in order:
+            if rid not in rids:
+                continue
+            if rid in self.tickets:
+                raise ValueError(
+                    f"request {rid} already lives on this host; "
+                    f"refusing a duplicate adoption"
+                )
+            # the rid's ancestry, oldest first: a continuation's
+            # parent chain must be on this WAL before the resubmit
+            # event that references it
+            chain: List[str] = []
+            walk: Optional[str] = rid
+            while walk is not None:
+                if walk not in recs:
+                    raise ValueError(
+                        f"request {rid}: the displaced WAL has no "
+                        f"submit record for ancestor {walk!r}; "
+                        f"cannot reconstruct the request"
+                    )
+                chain.append(walk)
+                walk = recs[walk].get("parent")
+            fin = retired.get(rid)
+            finished = fin is not None and not (
+                fin.get("status") == DONE and rid not in streamed
+            )
+            if self._wal is not None:
+                for member in reversed(chain):
+                    if member in walled:
+                        continue
+                    walled.add(member)
+                    self._wal.append(_strip_seq(recs[member]))
+                    # terminal facts ride along for ancestors always,
+                    # and for the rid itself when the WAL attests it
+                    # finished (then we materialize, not re-run)
+                    if (member != rid or finished) \
+                            and member in retired:
+                        self._wal.append(_strip_seq(retired[member]))
+                        if member in streamed:
+                            self._wal.append(
+                                {"event": STREAMED, "rid": member}
+                            )
+                    if member in holds:
+                        self._wal.append(_strip_seq(holds[member]))
+                    if member in released:
+                        self._wal.append(
+                            {"event": RELEASE, "rid": member}
+                        )
+            if finished:
+                # a finished request adopts as a TERMINAL ticket over
+                # its existing (shared-filesystem) result log; a live
+                # hold re-pins from its spill in the shared tier, so
+                # resubmit chains survive their host's death without
+                # re-running the parent
+                self._materialize(rid, recs, fin, holds, released)
+            else:
+                self._requeue(rid, recs, holds)
+            self._metrics.inc("adopted")
+            adopted.append(rid)
+            self.trace.instant(
+                "cluster.adopted", rid=rid, tick=self._ticks,
+                finished=finished,
+            )
+        missing = [r for r in rids if r not in adopted]
+        if missing:
+            raise ValueError(
+                f"displaced WAL has no submit records for {missing}"
+            )
+        return adopted
 
     # -- scheduling ----------------------------------------------------------
 
@@ -2966,27 +3137,9 @@ class SimServer:
         resume (its partial result log is truncated at re-admission).
         Continuations re-queue from their parent's spilled snapshot,
         whether or not the parent itself finished."""
-        recs: Dict[str, Dict[str, Any]] = {}
-        order: List[str] = []
-        retired: Dict[str, Dict[str, Any]] = {}
-        streamed: set = set()
-        holds: Dict[str, Dict[str, Any]] = {}
-        released: set = set()
-        for ev in self._wal.events:
-            kind = ev.get("event")
-            rid = ev.get("rid")
-            if kind in (SUBMIT, RESUBMIT):
-                recs[rid] = ev
-                order.append(rid)
-            elif kind == RETIRE:
-                retired[rid] = ev  # last wins (quarantine flips DONE)
-            elif kind == STREAMED:
-                streamed.add(rid)
-            elif kind == HOLD:
-                holds[rid] = ev
-            elif kind == RELEASE:
-                released.add(rid)
-            # unknown events: forward-compat, ignored
+        order, recs, retired, streamed, holds, released = (
+            classify_events(self._wal.events)
+        )
         if not order:
             return
         self.queue.skip_ids(
@@ -3200,14 +3353,14 @@ class SimServer:
                     t.held_key = None
         except BaseException as e:
             first_error = first_error or e
-        if self.out_dir:
+        if self.meta_dir:
             try:
                 # failures parked during the streamer's final drain
                 # must flip their tickets before the table is written
                 self._sweep_sink_failures()
                 self._refresh_gauges()
                 write_server_meta(
-                    self.out_dir,
+                    self.meta_dir,
                     {name: b.cfg for name, b in self.buckets.items()},
                     self._metrics,
                     requests=self._request_table(),

@@ -156,7 +156,12 @@ class TieredSnapshotStore(SnapshotStore):
                     f"buckets)."
                 )
             return
-        tmp = path + ".tmp"
+        # unique tmp name: cluster workers construct their stores over
+        # ONE shared tier dir concurrently at bring-up, and a shared
+        # ".tmp" name lets worker A's os.replace consume the file
+        # worker B just wrote (B's replace then ENOENTs). Same
+        # fingerprint either way — last rename wins harmlessly.
+        tmp = f"{path}.tmp-{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"fingerprint": fingerprint}, f)
         os.replace(tmp, path)

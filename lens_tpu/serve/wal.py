@@ -43,7 +43,7 @@ import json
 import os
 import re
 import threading
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Sequence
 
 from lens_tpu.emit.log import JsonFrameLog
 
@@ -62,6 +62,77 @@ STREAMED = "streamed"    # {rid} records durably on disk
 HOLD = "hold"            # {rid, key, name} held snapshot spilled
 RELEASE = "release"      # {rid} hold dropped
 QUARANTINE = "device_quarantined"  # {shard, reason} observability only
+
+
+def classify_events(events: Sequence[Mapping[str, Any]]):
+    """Fold a merged WAL event stream into the per-request facts
+    recovery acts on: ``(order, recs, retired, streamed, holds,
+    released)`` where ``order`` is submission order, ``recs`` maps rid
+    -> its submit/resubmit event, ``retired`` maps rid -> its LAST
+    retire event (quarantine may flip DONE post hoc), ``streamed`` is
+    the set of rids whose records are attested durably on disk, and
+    ``holds``/``released`` track spilled snapshots. Shared by
+    ``SimServer`` construction-time recovery, cluster whole-host
+    failover (a SURVIVOR adopting a dead host's WAL — docs/serving.md,
+    "Cluster serving"), and the ``python -m lens_tpu wal`` dump.
+    Unknown events are ignored (forward compat)."""
+    recs: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    retired: Dict[str, Dict[str, Any]] = {}
+    streamed: set = set()
+    holds: Dict[str, Dict[str, Any]] = {}
+    released: set = set()
+    for ev in events:
+        kind = ev.get("event")
+        rid = ev.get("rid")
+        if kind in (SUBMIT, RESUBMIT):
+            if rid not in recs:
+                order.append(rid)
+            recs[rid] = dict(ev)
+        elif kind == RETIRE:
+            retired[rid] = dict(ev)
+        elif kind == STREAMED:
+            streamed.add(rid)
+        elif kind == HOLD:
+            holds[rid] = dict(ev)
+        elif kind == RELEASE:
+            released.add(rid)
+    return order, recs, retired, streamed, holds, released
+
+
+def unfinished(
+    order: Sequence[str],
+    retired: Mapping[str, Mapping[str, Any]],
+    streamed,
+) -> List[str]:
+    """The rids a recovery/failover must RE-RUN: no terminal retire, or
+    a DONE retire whose records were never attested durable (under the
+    pipeline, status runs ahead of the sink)."""
+    out = []
+    for rid in order:
+        fin = retired.get(rid)
+        if fin is None or (
+            fin.get("status") == "done" and rid not in streamed
+        ):
+            out.append(rid)
+    return out
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """The merged event stream of a WAL directory (or its head
+    ``serve.wal`` file) WITHOUT arming it for appends — the read-only
+    entry point for cluster failover and the ``wal`` dump CLI. The
+    directory's per-shard files are merged on the global ``seq`` stamp
+    exactly like :attr:`ServeWal.events`."""
+    if os.path.isdir(path):
+        path = os.path.join(path, WAL_NAME)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no WAL at {path}")
+    wal = ServeWal(path)
+    try:
+        return wal.events
+    finally:
+        wal.close()
 
 
 def shard_wal_name(shard: int) -> str:

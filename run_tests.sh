@@ -27,7 +27,11 @@ cd "$(dirname "$0")"
 
 # Quick tier: engine/state/process contracts + the numerics the rest of
 # the stack leans on (integration, tau-leap + hybrid sampler, LP ops),
-# chosen for coverage-per-second, not completeness.
+# chosen for coverage-per-second, not completeness. test_cluster.py's
+# quick signal is the protocol/WAL units + LocalHost routing/stealing/
+# failover; its multi-process SIGKILL host-failover drills are
+# slow-marked (real worker spawns cost ~a minute each) and run in the
+# full tier's cluster batch.
 QUICK_FILES="
 tests/test_state.py
 tests/test_engine.py
@@ -44,6 +48,7 @@ tests/test_tiers.py
 tests/test_faults.py
 tests/test_recovery.py
 tests/test_frontdoor.py
+tests/test_cluster.py
 tests/test_sweep.py
 tests/test_metrics.py
 tests/test_obs.py
@@ -67,6 +72,7 @@ BATCHES=(
   "tests/test_multispecies.py tests/test_ensemble.py"
   "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_tiers.py tests/test_faults.py tests/test_recovery.py tests/test_frontdoor.py tests/test_metrics.py tests/test_obs.py"
   "tests/test_sweep.py tests/test_cli.py"
+  "tests/test_cluster.py"
   "tests/test_experiment.py"
   "tests/test_bridge.py"
 )

@@ -1,0 +1,1062 @@
+"""Multi-host serving cluster (round 17): locality-aware routing,
+work-stealing, whole-host failover.
+
+The contract (docs/serving.md, "Cluster serving"): a ``ClusterServer``
+routes requests across one serve worker per host; per-request bits are
+host-independent (each worker is a full ``SimServer``, and the serving
+determinism contract makes results a pure function of the request), so
+a request's streamed bytes are identical wherever it runs — including
+after a steal or a whole-host failover re-queues it. A host that dies
+mid-load loses no admitted work: its per-host WAL is read back and
+every unfinished request re-queues onto survivors under its original
+id, spill-backed snapshots re-adopting from the shared tier directory.
+
+Tiers here: pure-logic tests (protocol framing, WAL classification,
+withdraw/adopt semantics, the wal dump CLI) run everywhere; in-process
+simulated-host clusters (LocalHost — same op dispatch, no process
+spawns) carry the quick routing/stealing/failover signal; the REAL
+drills — subprocess workers, real SIGKILLs, bitwise oracle pins at 2
+and 4 hosts — are slow-marked to protect the tier-1 time budget
+(run_tests.sh runs them in the cluster batch).
+"""
+
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from lens_tpu.cluster import ClusterServer, HostDown
+from lens_tpu.cluster.protocol import (
+    encode_error,
+    raise_error,
+    recv_msg,
+    rpc,
+    send_msg,
+)
+from lens_tpu.cluster.worker import ID_SPAN, _offset_ids
+from lens_tpu.serve import (
+    DONE,
+    FAILED,
+    QueueFull,
+    RequestValidationError,
+    ScenarioRequest,
+    ServeWal,
+    SimServer,
+)
+from lens_tpu.serve.batcher import MIGRATED, QUEUED
+from lens_tpu.serve.faults import FaultPlan
+from lens_tpu.serve.wal import classify_events, read_events, unfinished
+
+BUCKET = {"capacity": 16, "lanes": 2, "window": 8}
+
+
+def _cluster(tmp_path, hosts=2, local=True, lanes=2, **kw):
+    kw.setdefault("worker", {"pipeline": "off"})
+    return ClusterServer(
+        {"toggle_colony": {**BUCKET, "lanes": lanes}},
+        hosts=hosts,
+        cluster_dir=str(tmp_path / "cluster"),
+        local=local,
+        **kw,
+    )
+
+
+def _req(seed, horizon=16.0, **kw):
+    return ScenarioRequest(
+        composite="toggle_colony", seed=seed, horizon=horizon, **kw
+    )
+
+
+# -- protocol (no jax, no servers) -------------------------------------------
+
+
+class TestProtocol:
+    def test_roundtrip_over_socketpair(self):
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"op": "ping", "x": [1, 2, 3]})
+            assert recv_msg(b) == {"op": "ping", "x": [1, 2, 3]}
+            send_msg(b, {"ok": True, "y": "z"})
+            assert recv_msg(a)["y"] == "z"
+        finally:
+            a.close()
+            b.close()
+
+    def test_rpc_raises_typed_errors(self):
+        a, b = socket.socketpair()
+        try:
+            import threading
+
+            def server():
+                msg = recv_msg(b)
+                send_msg(b, encode_error(
+                    QueueFull(3.5, 7) if msg["op"] == "full"
+                    else RequestValidationError("bad", path="emit.every")
+                ))
+
+            t = threading.Thread(target=server)
+            t.start()
+            with pytest.raises(QueueFull) as e:
+                rpc(a, "full", timeout=5)
+            t.join()
+            assert e.value.retry_after == 3.5
+            assert e.value.depth == 7
+            t = threading.Thread(target=server)
+            t.start()
+            with pytest.raises(RequestValidationError) as e:
+                rpc(a, "validate", timeout=5)
+            t.join()
+            assert e.value.path == "emit.every"
+        finally:
+            a.close()
+            b.close()
+
+    def test_unknown_error_type_becomes_runtime_error(self):
+        with pytest.raises(RuntimeError, match="Weird: boom"):
+            raise_error({"error_type": "Weird", "error": "boom"})
+
+    def test_oversized_frame_refused(self):
+        import struct
+
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">I", 2**30))
+            with pytest.raises(ConnectionError, match="exceeds"):
+                recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_peer_close_mid_frame(self):
+        a, b = socket.socketpair()
+        a.sendall(b"\x00\x00")
+        a.close()
+        try:
+            with pytest.raises(ConnectionError):
+                recv_msg(b)
+        finally:
+            b.close()
+
+
+# -- WAL classification + dump CLI (no servers) ------------------------------
+
+
+def _wal_events(tmp_path, events):
+    wal = ServeWal(str(tmp_path / "serve.wal"))
+    for ev in events:
+        wal.append(ev)
+    wal.close()
+    return str(tmp_path)
+
+
+class TestWalClassify:
+    EVENTS = [
+        {"event": "submit", "rid": "req-000000",
+         "request": {"composite": "toggle_colony", "seed": 1,
+                     "horizon": 8.0}},
+        {"event": "submit", "rid": "req-000001",
+         "request": {"composite": "toggle_colony", "seed": 2,
+                     "horizon": 8.0, "hold_state": True}},
+        {"event": "retire", "rid": "req-000000", "status": "done",
+         "steps": 8},
+        {"event": "streamed", "rid": "req-000000"},
+        {"event": "retire", "rid": "req-000001", "status": "done",
+         "steps": 8},
+        {"event": "hold", "rid": "req-000001", "key": ["k"],
+         "name": "snap_x"},
+        {"event": "submit", "rid": "req-000002",
+         "request": {"composite": "toggle_colony", "seed": 3,
+                     "horizon": 8.0}},
+    ]
+
+    def test_classify_and_unfinished(self):
+        order, recs, retired, streamed, holds, released = (
+            classify_events(self.EVENTS)
+        )
+        assert order == ["req-000000", "req-000001", "req-000002"]
+        assert set(recs) == set(order)
+        assert retired["req-000000"]["status"] == "done"
+        assert "req-000000" in streamed
+        assert holds["req-000001"]["name"] == "snap_x"
+        # req-000001 retired DONE but never attested streamed: it must
+        # re-run; req-000002 never retired at all
+        assert unfinished(order, retired, streamed) == [
+            "req-000001", "req-000002",
+        ]
+
+    def test_migrated_retire_is_finished(self):
+        events = self.EVENTS + [
+            {"event": "retire", "rid": "req-000002",
+             "status": MIGRATED, "steps": 0},
+        ]
+        order, recs, retired, streamed, *_ = classify_events(events)
+        # a stolen request must never be re-run by failover: it lives
+        # on another host now
+        assert unfinished(order, retired, streamed) == ["req-000001"]
+
+    def test_read_events_merges_dir(self, tmp_path):
+        d = _wal_events(tmp_path, self.EVENTS)
+        events = read_events(d)
+        assert [e["event"] for e in events if e["event"] != "server_begin"] \
+            == [e["event"] for e in self.EVENTS]
+        assert all("seq" in e for e in events)
+
+    def test_read_events_missing(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_events(str(tmp_path / "nope"))
+
+    def test_wal_cli_dump(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        d = _wal_events(tmp_path, self.EVENTS)
+        assert main(["wal", d]) == 0
+        out = capsys.readouterr().out
+        assert "submit" in out and "req-000001" in out
+        assert "hold_state" in out      # submit detail
+        assert "status=done" in out     # retire detail
+        assert "spill=snap_x" in out    # hold detail
+
+    def test_wal_cli_rid_filter_follows_ancestry(
+        self, tmp_path, capsys
+    ):
+        from lens_tpu.__main__ import main
+
+        events = self.EVENTS + [
+            {"event": "resubmit", "rid": "req-000009",
+             "parent": "req-000001", "extra_horizon": 8.0},
+        ]
+        d = _wal_events(tmp_path, events)
+        assert main(["wal", d, "--rid", "req-000009"]) == 0
+        out = capsys.readouterr().out
+        assert "req-000009" in out
+        assert "req-000001" in out      # the parent rides along
+        assert "req-000000" not in out  # unrelated rid filtered
+
+    def test_wal_cli_json(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        d = _wal_events(tmp_path, self.EVENTS)
+        assert main(["wal", d, "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert len(data) == 1
+        kinds = [e["event"] for e in data[0]["events"]]
+        assert "submit" in kinds and "hold" in kinds
+
+    def test_wal_cli_no_wal(self, tmp_path, capsys):
+        from lens_tpu.__main__ import main
+
+        assert main(["wal", str(tmp_path)]) == 2
+
+
+class TestHostDownFault:
+    def test_occurrence_counts_per_host(self):
+        plan = FaultPlan([
+            {"kind": "host_down", "host": 1, "occurrence": 2},
+        ])
+        assert not plan.host_down(0)
+        assert not plan.host_down(1)
+        assert plan.host_down(1)
+        assert not plan.host_down(1)
+
+    def test_host_key_rejected_elsewhere(self):
+        with pytest.raises(ValueError, match="only applies"):
+            FaultPlan([{"kind": "nan", "host": 0}])
+
+    def test_request_filter_rejected(self):
+        with pytest.raises(ValueError, match="failure domain"):
+            FaultPlan([{"kind": "host_down", "request": "req-000001"}])
+
+
+class TestReviewRegressions:
+    """Pins for review findings: each of these was a real bug once."""
+
+    def test_poll_timeout_is_a_miss_not_hostdown(self, monkeypatch):
+        """socket.timeout subclasses OSError: the health poll must let
+        it propagate (one counted miss, tolerated heartbeat_misses
+        times) instead of converting it to an immediate HostDown."""
+        from lens_tpu.cluster import router as router_mod
+
+        h = router_mod.RemoteHost.__new__(router_mod.RemoteHost)
+        router_mod._Host.__init__(h, 0, "/nonexistent")
+        h.health_sock = object()
+        h.heartbeat_s = 0.01
+        h._desynced = False
+
+        def slow_rpc(*a, **kw):
+            raise socket.timeout("health poll timed out")
+
+        monkeypatch.setattr(router_mod, "rpc", slow_rpc)
+        with pytest.raises(socket.timeout):
+            h.poll()
+
+    def test_local_worker_faults_spec_injects(self, tmp_path):
+        """local=True converts a worker faults spec exactly like the
+        subprocess entry does, instead of silently dropping it."""
+        srv = _cluster(
+            tmp_path, hosts=1,
+            worker={
+                "pipeline": "off",
+                "faults": {
+                    "seed": 7,
+                    "faults": [{"kind": "nan", "occurrence": 99}],
+                },
+            },
+        )
+        try:
+            plan = srv.hosts[0].core.server.faults
+            assert isinstance(plan, FaultPlan)
+            assert plan.seed == 7
+            assert [f.kind for f in plan.faults] == ["nan"]
+        finally:
+            srv.close()
+
+    def test_idle_publish_version_stable(self, tmp_path):
+        """An idle worker's snapshot version must settle so router
+        polls come back ``unchanged`` instead of reshipping the full
+        ticket table every heartbeat."""
+        with _cluster(tmp_path, hosts=1) as srv:
+            rid = srv.submit(_req(1, horizon=8.0))
+            srv.run_until_idle(max_ticks=300)
+            assert srv.status(rid)["status"] == DONE
+            core = srv.hosts[0].core
+            v = core._published["version"]
+            # idle ticks past the refresh cadence rebuild the snapshot
+            # but must not bump the version while nothing changed
+            core._published_at -= core.IDLE_PUBLISH_EVERY_S + 1
+            core.tick_once()
+            assert core._published["version"] == v
+            reply = core.handle_health({"op": "poll", "since": v})
+            assert reply.get("unchanged") is True
+
+    def test_poll_resync_after_late_reply(self):
+        """A health reply landing after the poll timeout must not
+        leave the stream desynchronized: the next poll drains the
+        stale frame and reads its own reply."""
+        import threading
+
+        from lens_tpu.cluster import router as router_mod
+
+        a, b = socket.socketpair()
+        h = router_mod.RemoteHost.__new__(router_mod.RemoteHost)
+        router_mod._Host.__init__(h, 0, "/nonexistent")
+        h.health_sock = a
+        h.heartbeat_s = 0.2
+        h._desynced = False
+
+        def worker():
+            n = 0
+            try:
+                while True:
+                    recv_msg(b)
+                    n += 1
+                    if n == 1:
+                        time.sleep(0.6)  # past heartbeat_s
+                    send_msg(b, {"ok": True, "version": n})
+            except (OSError, ValueError):
+                pass
+
+        threading.Thread(target=worker, daemon=True).start()
+        try:
+            with pytest.raises(socket.timeout):
+                h.poll()
+            time.sleep(0.8)  # the late reply lands in the buffer
+            assert h._desynced
+            reply = h.poll()
+            assert reply["version"] == 2
+            assert not h._desynced
+        finally:
+            a.close()
+            b.close()
+
+    def test_rerun_over_cluster_dir_resumes(self, tmp_path):
+        """A second ClusterServer over the same cluster_dir mirrors
+        the WAL-known work (tickets + recovered count) and mints rids
+        PAST it — a colliding req-000000 would share the first run's
+        ticket slot and its shared out/ log file."""
+        with _cluster(tmp_path, hosts=1) as srv:
+            done_rid = srv.submit(_req(1, horizon=8.0))
+            srv.run_until_idle(max_ticks=300)
+            assert srv.status(done_rid)["status"] == DONE
+            queued_rid = srv.submit(_req(2, horizon=8.0))
+            # close with it still queued: the WAL knows the submit,
+            # no retire — a rerun must re-queue it
+            data = open(srv.result(done_rid), "rb").read()
+        with _cluster(tmp_path, hosts=1) as srv2:
+            assert srv2.recovered == 1  # the queued one re-queued
+            assert srv2.status(done_rid)["status"] == DONE
+            assert open(srv2.result(done_rid), "rb").read() == data
+            assert queued_rid in srv2.tickets
+            fresh = srv2.submit(_req(3, horizon=8.0))
+            assert fresh not in (done_rid, queued_rid)
+            srv2.run_until_idle(max_ticks=600)
+            for rid in (queued_rid, fresh):
+                assert srv2.status(rid)["status"] == DONE
+
+    def test_cli_forwards_worker_knobs(self, monkeypatch, tmp_path):
+        """serve --hosts N forwards every worker-level CLI flag
+        (mesh, check_finite, watchdog, worker faults, ...) into the
+        ClusterServer's worker= kwargs."""
+        import lens_tpu.cluster as cluster_pkg
+        from lens_tpu.__main__ import _build_cluster, _build_parser
+
+        captured = {}
+
+        def fake_cluster(*a, **kw):
+            captured.update(kw)
+            return "cluster-sentinel"
+
+        monkeypatch.setattr(cluster_pkg, "ClusterServer", fake_cluster)
+        faults_path = tmp_path / "faults.json"
+        faults_path.write_text(json.dumps({
+            "seed": 3,
+            "faults": [
+                {"kind": "host_down", "host": 0, "occurrence": 1},
+                {"kind": "nan", "occurrence": 99},
+            ],
+        }))
+        args = _build_parser().parse_args([
+            "serve", "--requests", str(tmp_path / "r.json"),
+            "--hosts", "2", "--mesh", "2",
+            "--check-finite", "window", "--watchdog", "30",
+            "--faults", str(faults_path),
+            "--out-dir", str(tmp_path / "c"),
+        ])
+        assert _build_cluster(args) == "cluster-sentinel"
+        worker = captured["worker"]
+        assert worker["mesh"] == 2
+        assert worker["check_finite"] == "window"
+        assert worker["watchdog_s"] == 30.0
+        # the fault spec splits: host_down stays at the router, the
+        # rest ride to the workers
+        assert [f["kind"] for f in worker["faults"]["faults"]] \
+            == ["nan"]
+        assert [f.kind for f in captured["faults"].faults] \
+            == ["host_down"]
+
+
+class TestOffsetIds:
+    class _Stub:
+        def __init__(self, tickets):
+            self.tickets = tickets
+            self.skipped = None
+            stub = self
+
+            class Q:
+                def skip_ids(self, n):
+                    stub.skipped = n
+
+            self.queue = Q()
+
+    def test_offset_applies(self):
+        s = self._Stub({"req-000004": None})
+        _offset_ids(s, ID_SPAN)
+        assert s.skipped == ID_SPAN
+
+    def test_never_moves_backwards(self):
+        s = self._Stub({f"req-{ID_SPAN + 17:06d}": None})
+        _offset_ids(s, ID_SPAN)
+        assert s.skipped == ID_SPAN + 18
+
+
+# -- withdraw / adopt on a real SimServer ------------------------------------
+
+
+class TestWithdrawAdopt:
+    def test_withdraw_only_clean_queued(self, tmp_path):
+        srv = SimServer.single_bucket(
+            "toggle_colony", **{**BUCKET, "lanes": 1},
+            pipeline="off",
+            out_dir=str(tmp_path / "out"), sink="log",
+            recover_dir=str(tmp_path / "wal"),
+        )
+        rids = [srv.submit(_req(s, horizon=32.0)) for s in range(3)]
+        srv.tick()  # rids[0] running, rest queued
+        with pytest.raises(ValueError, match="not queued"):
+            srv.withdraw(rids[0])
+        payload = srv.withdraw(rids[2])
+        assert payload["seed"] == 2
+        assert srv.tickets[rids[2]].status == MIGRATED
+        # the WAL knows: this host's own recovery (and any failover
+        # over this WAL) treats the rid as finished here
+        events = [
+            e for e in srv._wal.events
+            if e.get("rid") == rids[2] and e["event"] == "retire"
+        ]
+        assert events and events[0]["status"] == MIGRATED
+        assert srv.metrics()["counters"]["stolen"] == 1
+        srv.run_until_idle(max_ticks=300)
+        srv.close()
+
+    def test_adopt_displaced_requeues_bitwise(self, tmp_path):
+        """A survivor adopting a dead host's WAL re-runs the request
+        to the same bytes the dead host would have produced."""
+        out = tmp_path / "out"
+        a = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(out), sink="log",
+            recover_dir=str(tmp_path / "wal_a"),
+        )
+        ra = a.submit(_req(5, horizon=16.0))
+        events = list(a._wal.events)
+        # host A "dies" before running anything; read its WAL
+        b = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(out), sink="log",
+            recover_dir=str(tmp_path / "wal_b"),
+        )
+        adopted = b.adopt_displaced(events, [ra])
+        assert adopted == [ra]
+        assert b.metrics()["counters"]["adopted"] == 1
+        b.run_until_idle(max_ticks=300)
+        assert b.status(ra)["status"] == DONE
+        got = open(b.result(ra), "rb").read()
+        # reference: the same request run start-to-finish on one host
+        ref_srv = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(tmp_path / "ref"), sink="log",
+        )
+        ref_srv.queue.skip_ids(int(ra.rsplit("-", 1)[1]))
+        rr = ref_srv.submit(_req(5, horizon=16.0))
+        assert rr == ra
+        ref_srv.run_until_idle(max_ticks=300)
+        ref = open(ref_srv.result(rr), "rb").read()
+        assert got == ref
+        # the adoption is WAL'd on B: B's own recovery now owns it
+        assert any(
+            e.get("rid") == ra and e["event"] == "submit"
+            for e in b._wal.events
+        )
+        ref_srv.close()
+        b.close()
+        a.close()
+
+    def test_adopt_finished_materializes_without_rerun(self, tmp_path):
+        """A rid the WAL attests FINISHED adopts as a terminal ticket
+        over its existing log — no lane ever runs it again."""
+        out = tmp_path / "out"
+        a = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(out), sink="log",
+            recover_dir=str(tmp_path / "wal_a"),
+        )
+        ra = a.submit(_req(5, horizon=16.0))
+        a.run_until_idle(max_ticks=300)
+        assert a.status(ra)["status"] == DONE
+        data = open(a.result(ra), "rb").read()
+        events = list(a._wal.events)
+        b = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(out), sink="log",
+            recover_dir=str(tmp_path / "wal_b"),
+        )
+        windows_before = b.metrics()["counters"]["windows"]
+        b.adopt_displaced(events, [ra])
+        assert b.status(ra)["status"] == DONE
+        assert b.result(ra) == os.path.join(str(out), f"{ra}.lens")
+        b.run_until_idle(max_ticks=50)
+        assert b.metrics()["counters"]["windows"] == windows_before
+        assert open(b.result(ra), "rb").read() == data
+        b.close()
+        a.close()
+
+    def test_adopt_duplicate_refused(self, tmp_path):
+        srv = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(tmp_path / "out"), sink="log",
+            recover_dir=str(tmp_path / "wal"),
+        )
+        rid = srv.submit(_req(1))
+        with pytest.raises(ValueError, match="duplicate"):
+            srv.adopt_displaced(list(srv._wal.events), [rid])
+        srv.run_until_idle(max_ticks=300)
+        srv.close()
+
+    def test_adopt_unknown_rid_refused(self, tmp_path):
+        srv = SimServer.single_bucket(
+            "toggle_colony", **BUCKET, pipeline="off",
+            out_dir=str(tmp_path / "out"), sink="log",
+            recover_dir=str(tmp_path / "wal"),
+        )
+        with pytest.raises(ValueError, match="no submit records"):
+            srv.adopt_displaced([], ["req-000042"])
+        srv.close()
+
+
+# -- in-process simulated-host clusters (LocalHost) --------------------------
+
+
+class TestLocalCluster:
+    def test_routes_and_completes_across_hosts(self, tmp_path):
+        with _cluster(tmp_path, hosts=2) as srv:
+            rids = [srv.submit(_req(s)) for s in range(4)]
+            srv.run_until_idle(max_ticks=500)
+            hosts = set()
+            for rid in rids:
+                st = srv.status(rid)
+                assert st["status"] == DONE
+                path = srv.result(rid)
+                assert os.path.exists(path)
+                hosts.add(srv.tickets[rid].host)
+            # least-loaded routing spreads an even load over both
+            assert hosts == {0, 1}
+            snap = srv.metrics()
+            assert snap["hosts_alive"] == 2
+            assert snap["lanes_total"] == 4  # 2 hosts x 2 lanes
+            assert snap["counters"]["retired"] >= 4
+
+    def test_work_stealing_rebalances_pinned_skew(self, tmp_path):
+        with _cluster(
+            tmp_path, hosts=2, lanes=1, steal_threshold=2,
+        ) as srv:
+            rids = [
+                srv.submit(_req(s, horizon=24.0), host=0)
+                for s in range(6)
+            ]
+            srv.run_until_idle(max_ticks=800)
+            snap = srv.metrics()
+            assert snap["counters"]["router_stolen"] >= 1
+            assert {srv.tickets[r].host for r in rids} == {0, 1}
+            for rid in rids:
+                assert srv.status(rid)["status"] == DONE
+            # donor's WAL marks the stolen rids MIGRATED — they can
+            # never be double-run by a later failover of host 0
+            events = read_events(srv.hosts[0].wal_dir)
+            _, _, retired, *_ = classify_events(events)
+            stolen = [
+                r for r in rids
+                if retired.get(r, {}).get("status") == MIGRATED
+            ]
+            assert len(stolen) == snap["counters"]["router_stolen"]
+
+    def test_host_down_failover_completes_everything(self, tmp_path):
+        with _cluster(
+            tmp_path, hosts=2,
+            faults=FaultPlan([
+                {"kind": "host_down", "host": 1, "occurrence": 2},
+            ]),
+        ) as srv:
+            rids = [srv.submit(_req(s, horizon=24.0)) for s in range(6)]
+            srv.run_until_idle(max_ticks=1000)
+            snap = srv.metrics()
+            assert snap["hosts_down"] == [1]
+            assert snap["counters"]["router_requeued"] >= 1
+            for rid in rids:
+                assert srv.status(rid)["status"] == DONE
+                assert srv.tickets[rid].host == 0
+            # a re-queued request's stream epoch bumped (SSE reset)
+            requeued = [
+                r for r in rids if srv.tickets[r]._fail_epochs
+            ]
+            assert len(requeued) == snap["counters"]["router_requeued"]
+            assert all(srv.tickets[r].requeues >= 1 for r in requeued)
+            # the drained host never schedules again
+            more = srv.submit(_req(77, horizon=8.0))
+            srv.run_until_idle(max_ticks=300)
+            assert srv.tickets[more].host == 0
+
+
+@pytest.mark.slow
+class TestLocalClusterSlow:
+    def test_prefix_locality_and_spill(self, tmp_path):
+        """Forks of one prefix stick to the owning host; once that
+        host backs up past steal_threshold, later forks fall back to
+        the least-loaded host and re-resolve there."""
+        with _cluster(
+            tmp_path, hosts=2, lanes=1, steal_threshold=3,
+        ) as srv:
+            prefix = {"horizon": 8.0}
+            first = srv.submit(_req(
+                3, horizon=16.0, prefix=prefix,
+                overrides={"global": {"volume": 1.05}},
+            ))
+            owner = srv.tickets[first].host
+            second = srv.submit(_req(
+                3, horizon=16.0, prefix=prefix,
+                overrides={"global": {"volume": 1.10}},
+            ))
+            assert srv.tickets[second].host == owner  # locality
+            # back the owner up past the threshold: next fork spills
+            for s in range(4):
+                srv.submit(_req(40 + s, horizon=32.0), host=owner)
+            spilled = srv.submit(_req(
+                3, horizon=16.0, prefix=prefix,
+                overrides={"global": {"volume": 1.20}},
+            ))
+            assert srv.tickets[spilled].host != owner
+            srv.run_until_idle(max_ticks=2000)
+            for rid in (first, second, spilled):
+                assert srv.status(rid)["status"] == DONE
+
+    def test_failover_bitwise_vs_single_host_oracle(self, tmp_path):
+        """LocalHost kill drill, bytes pinned: every displaced request
+        re-runs on the survivor to the exact bytes a 1-host no-fault
+        cluster produces (same router mint, same headers)."""
+        reqs = [dict(seed=s, horizon=24.0) for s in range(5)] + [
+            dict(seed=7, horizon=24.0, prefix={"horizon": 8.0},
+                 overrides={"global": {"volume": 1.1}}),
+            dict(seed=8, horizon=16.0, hold_state=True),
+        ]
+        with ClusterServer(
+            {"toggle_colony": BUCKET}, hosts=1,
+            cluster_dir=str(tmp_path / "oracle"), local=True,
+            worker={"pipeline": "off"},
+        ) as oracle:
+            orids = [
+                oracle.submit(_req(**r)) for r in reqs
+            ]
+            oracle.run_until_idle(max_ticks=2000)
+            ref = {
+                r: open(oracle.result(r), "rb").read() for r in orids
+            }
+        with _cluster(
+            tmp_path, hosts=2,
+            faults=FaultPlan([
+                {"kind": "host_down", "host": 1, "occurrence": 3},
+            ]),
+        ) as srv:
+            rids = [srv.submit(_req(**r)) for r in reqs]
+            assert rids == orids
+            srv.run_until_idle(max_ticks=2000)
+            assert srv.metrics()["hosts_down"] == [1]
+            for rid in rids:
+                assert srv.status(rid)["status"] == DONE
+                got = open(srv.result(rid), "rb").read()
+                assert got == ref[rid], f"{rid} differs"
+
+    def test_one_host_cluster_equals_simserver_records(self, tmp_path):
+        """Cluster mode at 1 host serves the same records a plain
+        SimServer does (headers differ only in the request id — the
+        router and a solo server mint internal prefix ids
+        differently, deliberately)."""
+        from lens_tpu.emit.log import decode_record, iter_frames
+
+        reqs = [dict(seed=s, horizon=16.0) for s in range(3)] + [
+            dict(seed=7, horizon=16.0, prefix={"horizon": 8.0},
+                 overrides={"global": {"volume": 1.1}}),
+        ]
+        with ClusterServer(
+            {"toggle_colony": BUCKET}, hosts=1,
+            cluster_dir=str(tmp_path / "c"), local=True,
+            worker={"pipeline": "off"},
+        ) as cluster:
+            crids = [cluster.submit(_req(**r)) for r in reqs]
+            cluster.run_until_idle(max_ticks=1000)
+            cpaths = {r: cluster.result(r) for r in crids}
+            solo = SimServer.single_bucket(
+                "toggle_colony", **BUCKET, pipeline="off",
+                out_dir=str(tmp_path / "solo"), sink="log",
+            )
+            srids = [solo.submit(_req(**r)) for r in reqs]
+            solo.run_until_idle(max_ticks=1000)
+            for crid, srid in zip(crids, srids):
+                cf = list(iter_frames(cpaths[crid]))
+                sf = list(iter_frames(solo.result(srid)))
+                assert cf[1:] == sf[1:], f"{crid}: records differ"
+                ch = decode_record(cf[0])["__header__"]
+                sh = decode_record(sf[0])["__header__"]
+                assert str(ch.pop("experiment_id")) == crid
+                assert str(sh.pop("experiment_id")) == srid
+                assert {k: v.tolist() for k, v in ch.items()} == \
+                    {k: v.tolist() for k, v in sh.items()}
+            solo.close()
+
+    def test_resubmit_survives_host_death(self, tmp_path):
+        """A held DONE parent whose host dies re-homes through the
+        shared tier (spill re-adopted, terminal ticket materialized)
+        and its resubmit continuation runs on the survivor bitwise
+        equal to an undisturbed chain."""
+        from lens_tpu.emit.log import iter_frames
+
+        with ClusterServer(
+            {"toggle_colony": BUCKET}, hosts=1,
+            cluster_dir=str(tmp_path / "oracle"), local=True,
+            worker={"pipeline": "off"},
+        ) as oracle:
+            p = oracle.submit(_req(3, horizon=16.0, hold_state=True))
+            oracle.run_until_idle(max_ticks=500)
+            c = oracle.resubmit(p, 16.0)
+            oracle.run_until_idle(max_ticks=500)
+            ref_parent = open(oracle.result(p), "rb").read()
+            ref_cont_rid = c
+            ref_cont = list(iter_frames(oracle.result(c)))
+        with _cluster(tmp_path, hosts=2) as srv:
+            p2 = srv.submit(
+                _req(3, horizon=16.0, hold_state=True), host=1
+            )
+            srv.run_until_idle(max_ticks=500)
+            assert srv.status(p2)["status"] == DONE
+            assert p2 == p
+            srv.down_host(1, reason="test")  # operator kill+failover
+            assert not srv.hosts[1].alive
+            assert srv.tickets[p2].host == 0
+            assert srv.status(p2)["status"] == DONE  # materialized
+            c2 = srv.resubmit(p2, 16.0)
+            # survivor host 0's internal mint matches the 1-host
+            # oracle's, so the continuation rid (and its log header)
+            # compare exactly
+            assert c2 == ref_cont_rid
+            srv.run_until_idle(max_ticks=500)
+            assert srv.status(c2)["status"] == DONE
+            assert open(srv.result(p2), "rb").read() == ref_parent
+            assert list(iter_frames(srv.result(c2))) == ref_cont
+
+    def test_cancel_in_limbo_and_queue_view(self, tmp_path):
+        with _cluster(tmp_path, hosts=2, lanes=1) as srv:
+            rids = [
+                srv.submit(_req(s, horizon=64.0), host=0)
+                for s in range(4)
+            ]
+            assert len(srv.queue) >= 1
+            assert srv.queue.max_depth == 2 * 64
+            # cancel a queued request through the router
+            st = srv.cancel(rids[3])
+            assert st in ("cancelled", "queued", "running")
+            srv.run_until_idle(max_ticks=1000)
+            done = sum(
+                1 for r in rids
+                if srv.status(r)["status"] == DONE
+            )
+            assert done >= 3
+
+    def test_frontdoor_over_cluster(self, tmp_path):
+        """The front door runs unchanged over the cluster backend:
+        submit/status/stream/healthz span hosts transparently, and
+        /healthz carries host identity + serving state."""
+        import base64
+        import http.client
+
+        from lens_tpu.frontdoor import FrontDoor
+
+        with _cluster(tmp_path, hosts=2) as srv:
+            fd = FrontDoor(srv, port=0).start()
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", fd.port, timeout=60
+                )
+                body = json.dumps({
+                    "composite": "toggle_colony", "seed": 3,
+                    "horizon": 16.0,
+                })
+                conn.request("POST", "/v1/requests", body=body)
+                resp = conn.getresponse()
+                assert resp.status == 202
+                rid = json.loads(resp.read())["rid"]
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    conn.request("GET", f"/v1/requests/{rid}")
+                    resp = conn.getresponse()
+                    row = json.loads(resp.read())
+                    if row["status"] == DONE:
+                        break
+                    time.sleep(0.05)
+                assert row["status"] == DONE
+                assert row.get("host") in (0, 1)
+                # healthz: serving state + per-host identity
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                hz = json.loads(resp.read())
+                assert resp.status == 200
+                assert hz["state"] == "serving"
+                assert [
+                    h["host"] for h in hz["cluster"]["hosts"]
+                ] == [0, 1]
+                assert all(
+                    h["state"] == "serving"
+                    for h in hz["cluster"]["hosts"]
+                )
+                # the SSE stream concatenates to the log bytes
+                from lens_tpu.frontdoor.streams import (
+                    decode_record_events,
+                )
+
+                conn.request(
+                    "GET", f"/v1/requests/{rid}/stream"
+                )
+                resp = conn.getresponse()
+                streamed, end = decode_record_events(resp.read())
+                assert end["status"] == DONE
+                path = srv.result(rid)
+                assert streamed == open(path, "rb").read()
+                # /metrics exposition carries host labels end to end
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                assert 'lens_cluster_host_up{host="0"} 1' in text
+                assert 'lens_cluster_host_up{host="1"} 1' in text
+            finally:
+                fd.close()
+
+    def test_healthz_draining_has_retry_after(self, tmp_path):
+        import http.client
+        import threading
+
+        from lens_tpu.frontdoor import FrontDoor
+
+        with _cluster(tmp_path, hosts=2) as srv:
+            fd = FrontDoor(srv, port=0).start()
+            try:
+                fd._draining = True
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", fd.port, timeout=30
+                )
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                assert resp.status == 503
+                assert resp.getheader("Retry-After") is not None
+                hz = json.loads(resp.read())
+                assert hz["state"] == "draining"
+            finally:
+                fd._draining = False
+                fd.close()
+
+
+# -- the real drills: subprocess workers, real SIGKILLs ----------------------
+
+
+_DRILL_REQS = [dict(seed=s, horizon=24.0) for s in range(6)] + [
+    dict(seed=7, horizon=24.0, prefix={"horizon": 8.0},
+         overrides={"global": {"volume": 1.1}}),
+    dict(seed=8, horizon=16.0, hold_state=True),
+]
+
+
+def _oracle_bytes(tmp_path):
+    """The single-host no-fault oracle: a 1-host cluster (identical
+    router id mint, so files compare byte for byte, headers
+    included)."""
+    with ClusterServer(
+        {"toggle_colony": BUCKET}, hosts=1,
+        cluster_dir=str(tmp_path / "oracle"), local=True,
+        worker={"pipeline": "off"},
+    ) as oracle:
+        rids = [oracle.submit(_req(**r)) for r in _DRILL_REQS]
+        oracle.run_until_idle(max_ticks=2000)
+        return rids, {
+            r: open(oracle.result(r), "rb").read() for r in rids
+        }
+
+
+def _kill_one_host_drill(tmp_path, hosts, victim, occurrence):
+    """Spawn a real cluster, SIGKILL one worker mid-load via the
+    host_down fault, and pin every request's bytes against the
+    single-host no-fault oracle."""
+    orids, ref = _oracle_bytes(tmp_path)
+    with ClusterServer(
+        {"toggle_colony": {**BUCKET, "lanes": 1}},
+        hosts=hosts,
+        cluster_dir=str(tmp_path / f"c{hosts}"),
+        faults=FaultPlan([{
+            "kind": "host_down", "host": victim,
+            "occurrence": occurrence,
+        }]),
+    ) as srv:
+        rids = [srv.submit(_req(**r)) for r in _DRILL_REQS]
+        assert rids == orids
+        srv.run_until_idle(max_ticks=200000)
+        snap = srv.metrics()
+        assert snap["hosts_down"] == [victim]
+        # the victim was REALLY killed (SIGKILL, not a flag)
+        h = srv.hosts[victim]
+        assert h.proc.poll() == -signal.SIGKILL
+        for rid in rids:
+            st = srv.status(rid)
+            assert st["status"] == DONE, (rid, st)
+            t = srv.tickets[rid]
+            # a ticket still attributed to the victim must have
+            # finished AND streamed durably before the kill; anything
+            # unfinished was displaced to a survivor
+            assert t.host != victim or t.streamed_at is not None
+            got = open(srv.result(rid), "rb").read()
+            assert got == ref[rid], f"{rid} differs after failover"
+        return snap
+
+
+@pytest.mark.slow
+class TestKillOneHostDrill:
+    """The acceptance headline: kill one REAL worker process at 2 and
+    4 simulated hosts; every non-faulted request completes and its
+    streamed bytes equal the single-host no-fault oracle."""
+
+    def test_two_hosts(self, tmp_path):
+        snap = _kill_one_host_drill(
+            tmp_path, hosts=2, victim=1, occurrence=3
+        )
+        assert snap["counters"]["router_requeued"] >= 1
+        assert snap["hosts_alive"] == 1
+
+    def test_four_hosts(self, tmp_path):
+        snap = _kill_one_host_drill(
+            tmp_path, hosts=4, victim=2, occurrence=3
+        )
+        assert snap["hosts_alive"] == 3
+
+
+@pytest.mark.slow
+class TestRemoteClusterSlow:
+    def test_heartbeat_loss_sigstop(self, tmp_path):
+        """A wedged (not dead) worker: SIGSTOP stops it answering
+        health polls; after heartbeat_misses the router declares it
+        down, SIGKILLs it, and fails its work over."""
+        with ClusterServer(
+            {"toggle_colony": {**BUCKET, "lanes": 1}},
+            hosts=2,
+            cluster_dir=str(tmp_path / "c"),
+            heartbeat_s=0.5, heartbeat_misses=2,
+        ) as srv:
+            rids = [srv.submit(_req(s, horizon=48.0))
+                    for s in range(4)]
+            victim = 1
+            os.kill(srv.hosts[victim].proc.pid, signal.SIGSTOP)
+            srv.run_until_idle(max_ticks=200000)
+            assert not srv.hosts[victim].alive
+            for rid in rids:
+                assert srv.status(rid)["status"] == DONE
+                assert srv.tickets[rid].host != victim
+
+    def test_worker_sigkill_detected_without_faultplan(self, tmp_path):
+        """An out-of-band kill (the OOM killer's shape) is caught by
+        the process/connection monitors, not just the fault seam."""
+        with ClusterServer(
+            {"toggle_colony": {**BUCKET, "lanes": 1}},
+            hosts=2, cluster_dir=str(tmp_path / "c"),
+        ) as srv:
+            rids = [srv.submit(_req(s, horizon=32.0))
+                    for s in range(4)]
+            srv.tick()
+            os.kill(srv.hosts[0].proc.pid, signal.SIGKILL)
+            srv.run_until_idle(max_ticks=200000)
+            assert srv.metrics()["hosts_down"] == [0]
+            for rid in rids:
+                assert srv.status(rid)["status"] == DONE
+
+    def test_cli_cluster_serve(self, tmp_path, capsys):
+        """python -m lens_tpu serve --hosts 2 end to end, including
+        the wal dump CLI over the cluster dir afterwards."""
+        from lens_tpu.__main__ import main
+
+        reqs = tmp_path / "reqs.json"
+        reqs.write_text(json.dumps(
+            [{"seed": s, "horizon": 16.0} for s in range(4)]
+        ))
+        out = tmp_path / "cl"
+        rc = main([
+            "serve", "--composite", "toggle_colony",
+            "--capacity", "16", "--lanes", "1", "--window", "8",
+            "--hosts", "2", "--requests", str(reqs),
+            "--out-dir", str(out),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "cluster 2 hosts" in text
+        assert len(glob.glob(str(out / "out" / "*.lens"))) == 4
+        assert (out / "cluster_meta.json").exists()
+        assert main(["wal", str(out)]) == 0
+        dump = capsys.readouterr().out
+        assert "host00" in dump and "host01" in dump

@@ -11,15 +11,19 @@ feed the sharded runner unchanged.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from lens_tpu.parallel import (
     ShardedSpatialColony,
+    cluster_identity,
     coordinator_only,
     distribute,
     global_mesh,
     initialize,
     is_coordinator,
 )
+from lens_tpu.parallel import distributed as dist_mod
+from lens_tpu.parallel.distributed import place_like
 from lens_tpu.parallel.mesh import AGENTS_AXIS, SPACE_AXIS, spatial_pspecs
 
 
@@ -34,6 +38,31 @@ class TestBringup:
         assert initialize() is False
         assert jax.process_count() == 1
 
+    def test_initialize_idempotent_when_attached(self, monkeypatch):
+        """Repeat calls after a successful handshake never
+        re-handshake (experiment retries call initialize() freely):
+        with the attached flag set, jax.distributed.initialize must
+        not be reached at all."""
+        monkeypatch.setattr(dist_mod, "_initialized", True)
+
+        def boom(**kw):  # pragma: no cover - the assertion IS no call
+            raise AssertionError("re-handshake attempted")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        # single process: attached-but-alone reports False (not
+        # distributed), without touching the runtime again
+        assert initialize() is False
+        assert initialize("somewhere:1234") is False
+
+    def test_initialize_repeat_noop_unattached(self, monkeypatch):
+        """The no-op single-host path is itself idempotent: any number
+        of calls without opt-in neither handshake nor flip state."""
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("LENS_TPU_DISTRIBUTED", raising=False)
+        for _ in range(3):
+            assert initialize() is False
+        assert dist_mod._initialized is False
+
     def test_coordinator_identity_single_host(self):
         assert is_coordinator()
 
@@ -47,6 +76,48 @@ class TestBringup:
 
         assert emit(7) == 7
         assert calls == [7]
+
+
+class TestClusterIdentity:
+    def test_explicit_pair_wins(self):
+        assert cluster_identity(2, 4) == (2, 4)
+
+    def test_defaults_to_runtime_single_process(self):
+        assert cluster_identity() == (0, 1)
+
+    def test_half_specified_refused(self):
+        with pytest.raises(ValueError, match="both"):
+            cluster_identity(host_id=1)
+        with pytest.raises(ValueError, match="both"):
+            cluster_identity(n_hosts=4)
+
+    def test_out_of_range_refused(self):
+        with pytest.raises(ValueError, match="out of range"):
+            cluster_identity(4, 4)
+
+
+class TestPlaceLike:
+    def test_single_process_is_device_put(self):
+        """place_like on one host is a plain device_put: values
+        round-trip exactly and land with the requested sharding."""
+        mesh = global_mesh(n_agents=4, n_space=2)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec(AGENTS_AXIS))
+        leaf = np.arange(16, dtype=np.float32).reshape(16)
+        placed = place_like(leaf, sharding)
+        assert placed.sharding == sharding
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(placed)), leaf
+        )
+
+    def test_replicated_scalar(self):
+        mesh = global_mesh(n_agents=4, n_space=2)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec())
+        placed = place_like(np.float32(3.5), sharding)
+        assert float(placed) == 3.5
 
 
 class TestGlobalMesh:
