@@ -1742,6 +1742,261 @@ def run_tier_bench(args) -> int:
     return 0
 
 
+# -- request-stream CDN (round 18) -------------------------------------------
+
+
+def _cdn_server(mode, composite, capacity, lanes, window, emit_every,
+                tmp_root, tag):
+    """One server per CDN knob setting: ``off`` is the round-17 path
+    bit for bit, ``dedup``/``cache``/``both`` arm the knobs. Cache
+    modes get a tier dir (the results dir lives under it) so the
+    kill-restart row can rebuild over the same store."""
+    import os
+
+    kw = dict(
+        capacity=capacity, lanes=lanes, window=window,
+        emit_every=emit_every, queue_depth=512, pipeline="on",
+        sink="log", out_dir=os.path.join(tmp_root, f"{tag}_out"),
+    )
+    if mode in ("cache", "both"):
+        kw["result_cache_mb"] = 256
+        kw["tier_dir"] = os.path.join(tmp_root, f"{tag}_tier")
+    if mode in ("dedup", "both"):
+        kw["dedup"] = "on"
+    return SimServer.single_bucket(composite, **kw)
+
+
+def _cdn_round(srv, composite, horizon_steps, lanes, seeds):
+    """Submit the seed sequence in waves of two lane-fills (so
+    within-wave duplicates are IN FLIGHT together — the dedup case —
+    while across-wave repeats meet only the durable cache), run each
+    wave to idle, return wall."""
+    t0 = time.perf_counter()
+    ids = []
+    for w0 in range(0, len(seeds), 2 * lanes):
+        ids.extend(
+            srv.submit(ScenarioRequest(
+                composite=composite, seed=int(s),
+                horizon=float(horizon_steps),
+            ))
+            for s in seeds[w0:w0 + 2 * lanes]
+        )
+        srv.run_until_idle(max_ticks=100_000)
+    wall = time.perf_counter() - t0
+    assert all(srv.status(r)["status"] == "done" for r in ids)
+    return wall
+
+
+def run_cdn_bench(args) -> int:
+    """Round-18 CDN bench (docs/serving.md, "Suffix dedup & result
+    cache"): Zipf repeat-traffic over a small distinct-request pool —
+    the sweep-driver / classroom / parameter-scan shape where the same
+    coordinates are asked for again and again.
+
+    Rows:
+
+    - ``zipf``: the four knob settings (off / dedup / cache / both) on
+      an identical per-rep workload, interleaved min-of-reps: wall,
+      device windows, hits/coalesces, device seconds saved.
+    - ``hot_cold``: p50 of a fully-hot repeat (submit returns a
+      terminal ticket) vs p50 of a cold solo request, with the
+      zero-device-windows claim counter-verified during the hot run.
+    - ``overhead``: all-distinct traffic (every request a miss) on
+      off vs both — what arming the knobs costs when nothing repeats.
+    - ``restart``: kill the ``both`` server, rebuild over the same
+      tier dir, repeat the workload — every request a durable hit,
+      zero windows.
+    """
+    import os
+    import tempfile
+
+    import numpy as np
+
+    horizon_steps = args.horizon_windows * args.window
+    lanes = max(args.lanes)
+    n = max(8 * lanes, 48)
+    modes = ("off", "dedup", "cache", "both")
+    record = {
+        "bench": "serve_cdn",
+        "backend": jax.default_backend(),
+        "composite": args.composite,
+        "capacity": args.capacity,
+        "window": args.window,
+        "emit_every": args.emit_every,
+        "horizon_steps": horizon_steps,
+        "lanes": lanes,
+        "n_requests": n,
+        "n_distinct": args.cdn_distinct,
+        "zipf_alpha": args.zipf_alpha,
+        "reps": args.reps,
+        "protocol": "zipf row: interleaved min-of-reps, identical "
+        "per-rep Zipf workload on all four knob settings, fresh "
+        "seed pool per rep (no cross-rep cache reuse), waves of two "
+        "lane-fills; hot_cold: 20 hot repeats timed at submit with "
+        "the windows counter pinned unchanged, vs solo cold "
+        "requests run to idle; overhead: all-distinct traffic, "
+        "off vs both; restart: rebuild over the same tier dir, "
+        "repeat the workload",
+        "zipf": [],
+        "hot_cold": {},
+        "overhead": {},
+        "restart": {},
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        servers = {
+            m: _cdn_server(
+                m, args.composite, args.capacity, lanes, args.window,
+                args.emit_every, tmp, m,
+            )
+            for m in modes
+        }
+        for srv in servers.values():
+            _warm(srv, args.composite, lanes, args.window)
+        base = {
+            m: dict(srv.metrics()["counters"])
+            for m, srv in servers.items()
+        }
+        walls = {m: float("inf") for m in modes}
+        last_seeds = None
+        for rep in range(args.reps):
+            rng = np.random.default_rng(4242 + rep)
+            idx = _zipf_draws(n, args.cdn_distinct, args.zipf_alpha,
+                              rng)
+            pool = 100_000 + rep * 1_000 + np.arange(args.cdn_distinct)
+            seeds = pool[idx]
+            last_seeds = seeds
+            for m, srv in servers.items():
+                walls[m] = min(walls[m], _cdn_round(
+                    srv, args.composite, horizon_steps, lanes, seeds,
+                ))
+        for m in modes:
+            c = servers[m].metrics()["counters"]
+            row = {
+                "mode": m,
+                "wall_s": round(walls[m], 4),
+                "wall_over_off": round(walls[m] / walls["off"], 4),
+                "windows": c["windows"] - base[m]["windows"],
+                "result_hits": c["result_hits"]
+                - base[m]["result_hits"],
+                "suffix_coalesced": c["suffix_coalesced"]
+                - base[m]["suffix_coalesced"],
+                "device_seconds_saved": round(
+                    c["device_seconds_saved"]
+                    - base[m]["device_seconds_saved"], 3,
+                ),
+            }
+            record["zipf"].append(row)
+            print(json.dumps(row), flush=True)
+
+        # hot/cold p50: repeats of the last rep's most popular request
+        # against the warmed "both" server, windows pinned unchanged
+        both = servers["both"]
+        hot_seed = int(last_seeds[0])
+        w0 = both.metrics()["counters"]["windows"]
+        hot = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            rid = both.submit(ScenarioRequest(
+                composite=args.composite, seed=hot_seed,
+                horizon=float(horizon_steps),
+            ))
+            assert both.status(rid)["status"] == "done"
+            hot.append(time.perf_counter() - t0)
+        hot_windows = both.metrics()["counters"]["windows"] - w0
+        cold = []
+        off = servers["off"]
+        for i in range(8):
+            t0 = time.perf_counter()
+            off.submit(ScenarioRequest(
+                composite=args.composite, seed=900_000 + i,
+                horizon=float(horizon_steps),
+            ))
+            off.run_until_idle(max_ticks=100_000)
+            cold.append(time.perf_counter() - t0)
+        record["hot_cold"] = {
+            "hot_p50_s": round(float(np.median(hot)), 6),
+            "cold_p50_s": round(float(np.median(cold)), 6),
+            "cold_over_hot": round(
+                float(np.median(cold)) / float(np.median(hot)), 1,
+            ),
+            "hot_windows": hot_windows,  # the zero-device-work claim
+        }
+        print(json.dumps({"hot_cold": record["hot_cold"]}), flush=True)
+
+        # cold-path overhead: all-distinct traffic, nothing repeats —
+        # fingerprint hashing + cache puts are the whole delta
+        pair = {
+            m: _cdn_server(
+                m, args.composite, args.capacity, lanes, args.window,
+                args.emit_every, tmp, f"ov_{m}",
+            )
+            for m in ("off", "both")
+        }
+        for srv in pair.values():
+            _warm(srv, args.composite, lanes, args.window)
+        ov = {m: float("inf") for m in pair}
+        for rep in range(args.reps):
+            seeds = 500_000 + rep * 1_000 + np.arange(n)
+            for m, srv in pair.items():
+                ov[m] = min(ov[m], _cdn_round(
+                    srv, args.composite, horizon_steps, lanes, seeds,
+                ))
+        for srv in pair.values():
+            srv.close()
+        record["overhead"] = {
+            "off_wall_s": round(ov["off"], 4),
+            "both_wall_s": round(ov["both"], 4),
+            "both_over_off": round(ov["both"] / ov["off"], 4),
+        }
+        print(json.dumps({"overhead": record["overhead"]}), flush=True)
+
+        # kill/restart: the results dir is durable state — a rebuilt
+        # server answers the whole workload from disk, zero windows
+        for m in ("off", "dedup", "cache"):
+            servers[m].close()
+        both.close()
+        warm = _cdn_server(
+            "both", args.composite, args.capacity, lanes, args.window,
+            args.emit_every, tmp, "both",
+        )
+        w0 = warm.metrics()["counters"]["windows"]
+        t0 = time.perf_counter()
+        wall = _cdn_round(
+            warm, args.composite, horizon_steps, lanes, last_seeds,
+        )
+        c = warm.metrics()["counters"]
+        record["restart"] = {
+            "wall_s": round(wall, 4),
+            "wall_over_cold": round(wall / walls["off"], 4),
+            "windows": c["windows"] - w0,
+            "result_hits": c["result_hits"],
+        }
+        warm.close()
+        print(json.dumps({"restart": record["restart"]}), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2)
+    print(f"wrote {args.out}")
+    rows = {r["mode"]: r for r in record["zipf"]}
+    print(
+        f"zipf walls vs off: dedup x{rows['dedup']['wall_over_off']}"
+        f" cache x{rows['cache']['wall_over_off']}"
+        f" both x{rows['both']['wall_over_off']}"
+    )
+    hc = record["hot_cold"]
+    print(
+        f"hot p50 {hc['hot_p50_s'] * 1e3:.2f} ms vs cold "
+        f"{hc['cold_p50_s'] * 1e3:.1f} ms (x{hc['cold_over_hot']}), "
+        f"{hc['hot_windows']} device windows during hot repeats"
+    )
+    print(
+        f"cold-path overhead x{record['overhead']['both_over_off']}; "
+        f"restart x{record['restart']['wall_over_cold']} of cold with "
+        f"{record['restart']['windows']} windows"
+    )
+    return 0
+
+
 # -- multi-host cluster (round 17) -------------------------------------------
 
 
@@ -2145,6 +2400,19 @@ def main() -> int:
         "given)",
     )
     p.add_argument(
+        "--cdn", action="store_true",
+        help="run the round-18 request-stream CDN bench: a Zipf "
+        "repeat-traffic A/B across the four knob settings (off / "
+        "dedup / cache / both), a hot-vs-cold p50 row with the "
+        "zero-device-windows claim counter-verified, an all-distinct "
+        "cold-path overhead row, and a kill/restart durable-warmth "
+        "row (writes BENCH_CDN_CPU_r18.json unless --out is given)",
+    )
+    p.add_argument(
+        "--cdn-distinct", type=int, default=8,
+        help="distinct requests in the CDN Zipf workload",
+    )
+    p.add_argument(
         "--tier-prefixes", type=int, default=12,
         help="distinct prefixes in the Zipf/restart tier workloads",
     )
@@ -2173,12 +2441,12 @@ def main() -> int:
     if sum(
         1 for m in (args.prefix, args.faults, args.mesh is not None,
                     args.trace, args.frontdoor, args.tiers,
-                    args.cluster is not None)
+                    args.cluster is not None, args.cdn)
         if m
     ) > 1:
         raise SystemExit(
             "--prefix / --faults / --mesh / --trace / --frontdoor / "
-            "--tiers / --cluster are separate modes"
+            "--tiers / --cluster / --cdn are separate modes"
         )
     args.capacity = args.capacity or (
         64 if args.frontdoor else 256
@@ -2208,6 +2476,11 @@ def main() -> int:
         args.lanes = args.lanes or [2, 4, 8]
         args.horizon_windows = args.horizon_windows or 6
         return run_faults_bench(args)
+    if args.cdn:
+        args.out = args.out or "BENCH_CDN_CPU_r18.json"
+        args.lanes = args.lanes or [4]
+        args.horizon_windows = args.horizon_windows or 6
+        return run_cdn_bench(args)
     if args.tiers:
         args.out = args.out or "BENCH_TIER_CPU_r16.json"
         args.lanes = args.lanes or [8]

@@ -146,6 +146,26 @@ def _add_server_args(
         "armed, else no disk tier",
     )
     p.add_argument(
+        "--result-cache-mb", type=float, default=None,
+        metavar="MB",
+        help="arm the durable content-addressed RESULT cache (MiB): "
+        "each completed request's .lens log is filed under its "
+        "request fingerprint in <tier-dir|recover-dir>/results, and "
+        "an identical later submit is answered whole from disk — "
+        "zero device windows, zero queueing (docs/serving.md, "
+        "'Suffix dedup & result cache'). LRU-evicted past the "
+        "budget, survives restarts. Needs --tier-dir or "
+        "--recover-dir. Default: off",
+    )
+    p.add_argument(
+        "--dedup", choices=["on", "off"], default="off",
+        help="in-flight suffix dedup: concurrent identical requests "
+        "coalesce onto ONE lane and fan out at the streamer, each "
+        "getting its own byte-identical stream (docs/serving.md, "
+        "'Suffix dedup & result cache'). Default: off (the bitwise "
+        "round-17 path)",
+    )
+    p.add_argument(
         "--warm", action="store_true",
         help="speculative prefix warming: pre-run (serve: the "
         "request list's distinct prefixes; frontdoor: each tenant's "
@@ -422,6 +442,28 @@ def _build_parser() -> argparse.ArgumentParser:
     wal.add_argument(
         "--rid", default=None,
         help="only events for this request id (and its ancestry)",
+    )
+
+    cache = sub.add_parser(
+        "cache",
+        help="inspect (and optionally GC) a durable result-cache "
+        "directory written under --result-cache-mb "
+        "(docs/serving.md, 'Suffix dedup & result cache')",
+    )
+    cache.add_argument(
+        "cache",
+        help="the results directory (<tier-dir|recover-dir>/results, "
+        "or a cluster dir's tiers/results), or a parent holding one",
+    )
+    cache.add_argument(
+        "--max-mb", type=float, default=None, metavar="MB",
+        help="evict LRU entries until the cache fits this budget "
+        "(offline GC; uses the same rename protocol as the server, "
+        "so it is safe beside a live one)",
+    )
+    cache.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the entry table as JSON instead of text",
     )
 
     cw = sub.add_parser(
@@ -787,6 +829,25 @@ def _serve_requests(args, server, raw) -> int:
                 f"hits={c['warm_hits']} "
                 f"preempted={c['warm_preempted']}"
             )
+        rhits = c.get("result_hits", 0) + c.get("router_result_hits", 0)
+        rmiss = (
+            c.get("result_misses", 0)
+            + c.get("router_result_misses", 0)
+        )
+        if rhits or rmiss or c.get("suffix_coalesced", 0):
+            # single-host metrics carry flat result_* gauges; the
+            # cluster nests them under a "results" dict
+            results = snap.get("results") or {}
+            print(
+                f"result cache: hits={rhits} misses={rmiss} "
+                f"coalesced={c.get('suffix_coalesced', 0)} "
+                f"evictions={c.get('result_evictions', 0)} "
+                f"entries="
+                f"{snap.get('result_entries', results.get('entries', 0))} "
+                f"({snap.get('result_bytes', results.get('bytes', 0)) / 2**20:.1f} MiB) "
+                f"device_seconds_saved="
+                f"{c.get('device_seconds_saved', 0.0):.1f}"
+            )
         if c["diverged"] or c["recovered"]:
             print(
                 f"fault tolerance: diverged={c['diverged']} "
@@ -936,6 +997,8 @@ def _build_cluster(args, frontdoor_defaults=False):
         worker=worker,
         faults=router_faults,
         trace_dir=args.trace_dir,
+        result_cache_mb=args.result_cache_mb,
+        dedup=args.dedup,
     )
 
 
@@ -1012,6 +1075,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         device_watchdog_s=args.device_watchdog,
         trace_dir=args.trace_dir,
         metrics_interval_s=args.metrics_interval,
+        result_cache_mb=args.result_cache_mb,
+        dedup=args.dedup,
     )
     return _serve_requests(args, server, raw)
 
@@ -1067,6 +1132,8 @@ def _cmd_frontdoor(args: argparse.Namespace) -> int:
             device_watchdog_s=args.device_watchdog,
             trace_dir=args.trace_dir,
             metrics_interval_s=args.metrics_interval,
+            result_cache_mb=args.result_cache_mb,
+            dedup=args.dedup,
         )
     except ValueError as e:
         raise SystemExit(str(e))
@@ -1229,6 +1296,8 @@ def _cmd_wal(args: argparse.Namespace) -> int:
             return out
         if kind == "hold":
             return f"spill={ev.get('name')}"
+        if kind == "coalesced":
+            return f"leader={ev.get('leader')}"
         if kind == "device_quarantined":
             return f"shard={ev.get('shard')} reason={ev.get('reason')}"
         return ""
@@ -1266,6 +1335,83 @@ def _cmd_wal(args: argparse.Namespace) -> int:
         if args.rid:
             print(f"  ({shown} of {len(events)} events match "
                   f"{args.rid} + ancestry)")
+    return 0
+
+
+def _cmd_cache(args: argparse.Namespace) -> int:
+    """Inspect / offline-GC a durable result-cache directory (jax-free:
+    the cache is sidecar JSON + framed logs). Accepts the results dir
+    itself or any parent the server layouts put it under."""
+    import glob
+    import os
+
+    from lens_tpu.serve.results import RESULT_META, ResultCache
+
+    target = args.cache
+    # accept the dir itself, a --tier-dir/--recover-dir, or a cluster
+    # dir (tiers/results) — first layout that holds entries wins
+    candidates = [
+        target,
+        os.path.join(target, "results"),
+        os.path.join(target, "tiers", "results"),
+    ]
+    found = next(
+        (
+            d for d in candidates
+            if os.path.exists(os.path.join(d, RESULT_META))
+            or glob.glob(os.path.join(d, "res_*.lens"))
+        ),
+        None,
+    )
+    if found is None:
+        print(
+            f"no result cache under {target!r} (expected a results/ "
+            f"dir written by --result-cache-mb)",
+            file=sys.stderr,
+        )
+        return 2
+    # fingerprint=None: inspection never serves hits, so it must not
+    # refuse a dir whose owning server config we don't know
+    cache = ResultCache(found, fingerprint=None)
+    evicted: list = []
+    if args.max_mb is not None:
+        evicted = cache.gc(int(float(args.max_mb) * 2**20))
+    rows = cache.entries()
+    if args.as_json:
+        print(json.dumps(
+            {
+                "dir": found,
+                "entries": rows,
+                "total_bytes": cache.total_bytes(),
+                "evicted": evicted,
+            },
+            indent=1, default=str,
+        ))
+        return 0
+    print(
+        f"== {found}: {len(rows)} entr{'y' if len(rows) == 1 else 'ies'}, "
+        f"{cache.total_bytes() / 2**20:.1f} MiB"
+    )
+    if rows:
+        print(
+            f"  {'fingerprint':<16} {'MiB':>8} {'hits':>5} "
+            f"{'age':>8} {'idle':>8}  composite@horizon"
+        )
+    for row in rows:
+        age = row["age_s"]
+        idle = row["idle_s"]
+        print(
+            f"  {row['fingerprint'][:16]:<16} "
+            f"{row['nbytes'] / 2**20:>8.2f} {row['hits']:>5} "
+            f"{'-' if age is None else f'{age:>7.0f}s':>8} "
+            f"{'-' if idle is None else f'{idle:>7.0f}s':>8}  "
+            f"{row['composite']}@{row['horizon']}"
+        )
+    if args.max_mb is not None:
+        print(
+            f"gc --max-mb {args.max_mb:g}: evicted {len(evicted)} "
+            f"entr{'y' if len(evicted) == 1 else 'ies'}"
+        )
     return 0
 
 
@@ -1440,6 +1586,9 @@ def main(argv=None) -> int:
 
     if args.command == "wal":
         return _cmd_wal(args)
+
+    if args.command == "cache":
+        return _cmd_cache(args)
 
     if args.command == "cluster-worker":
         from lens_tpu.cluster import run_worker

@@ -77,7 +77,17 @@ from lens_tpu.serve.batcher import (
 )
 from lens_tpu.serve.faults import FaultPlan
 from lens_tpu.serve.metrics import ServerMetrics
-from lens_tpu.serve.wal import classify_events, read_events, unfinished
+from lens_tpu.serve.results import (
+    ResultCache,
+    log_config,
+    request_fingerprint,
+)
+from lens_tpu.serve.wal import (
+    buckets_fingerprint,
+    classify_events,
+    read_events,
+    unfinished,
+)
 
 _TERMINAL = (DONE, FAILED, TIMEOUT, CANCELLED)
 
@@ -425,6 +435,8 @@ class ClusterServer:
         trace_dir: Optional[str] = None,
         worker_env: Optional[Mapping[str, str]] = None,
         spawn_timeout_s: float = 300.0,
+        result_cache_mb: Optional[float] = None,
+        dedup: str = "off",
     ):
         if int(hosts) < 1:
             raise ValueError(f"hosts={hosts} must be >= 1")
@@ -432,6 +444,15 @@ class ClusterServer:
             raise ValueError(
                 "ClusterServer needs a cluster_dir (shared logs, "
                 "tiers, and per-host WALs live under it)"
+            )
+        if dedup not in ("on", "off"):
+            raise ValueError(
+                f"dedup={dedup!r} must be 'on' or 'off'"
+            )
+        if result_cache_mb is not None \
+                and float(result_cache_mb) <= 0:
+            raise ValueError(
+                f"result_cache_mb={result_cache_mb} must be > 0"
             )
         self.n_hosts = int(hosts)
         self.cluster_dir = os.path.abspath(cluster_dir)
@@ -473,8 +494,36 @@ class ClusterServer:
         self._prefix_owner: Dict[str, int] = {}
         self._ticks = 0
         self._closed = False
+        # -- request-stream CDN (round 18) --
+        # The router answers result-cache hits BEFORE host placement:
+        # its cache instance reads the SAME shared results dir every
+        # worker files into (tiers/results — the workers get tier_dir
+        # and derive the same path), so a repeat of any host's work is
+        # served here with zero routing, zero queueing, zero device
+        # windows. Budget/GC stay with the workers (they own the
+        # writes and see every entry); the router only reads, and
+        # `refresh` adopts entries published after its scan.
+        self.result_cache_mb = result_cache_mb
+        self.dedup = dedup
+        self._result_cache: Optional[ResultCache] = None
+        if result_cache_mb is not None:
+            from lens_tpu.serve.server import BUCKET_DEFAULTS
+            from lens_tpu.utils.dicts import deep_merge
+
+            self._result_cache = ResultCache(
+                os.path.join(self.tier_dir, "results"),
+                budget_bytes=None,
+                fingerprint=buckets_fingerprint({
+                    n: deep_merge(BUCKET_DEFAULTS, c or {})
+                    for n, c in buckets.items()
+                }),
+            )
         self.hosts: Dict[int, _Host] = {}
         worker = dict(worker or {})
+        if result_cache_mb is not None:
+            worker.setdefault("result_cache_mb", result_cache_mb)
+        if dedup == "on":
+            worker.setdefault("dedup", dedup)
         self._spawn(buckets, worker, queue_depth, worker_env,
                     float(spawn_timeout_s))
         self._recovered = self._mirror_recovered()
@@ -858,6 +907,12 @@ class ClusterServer:
         from lens_tpu.serve.server import _request_to_json
 
         payload = _request_to_json(request)
+        if (
+            self._result_cache is not None
+            and not request.hold_state
+            and self._serve_cached(request, payload, rid)
+        ):
+            return rid
         if host is not None:
             h = self.hosts.get(int(host))
             if h is None or not h.alive:
@@ -900,6 +955,45 @@ class ClusterServer:
             "every cluster host is down; the router has no "
             "schedulable capacity"
         )
+
+    def _serve_cached(
+        self,
+        request: ScenarioRequest,
+        payload: Mapping[str, Any],
+        rid: str,
+    ) -> bool:
+        """Answer one submit from the shared result cache AT THE
+        ROUTER — no placement, no worker RPC, no queue slot anywhere.
+        The cached log replays as the new rid's own ``<rid>.lens``
+        under the shared out/ dir (header re-minted, every other frame
+        verbatim), and the mirror ticket is born terminal with
+        ``host=None`` — the same no-owner shape a failed-over terminal
+        mirror has, so status/result/cancel already handle it. Any
+        replay failure degrades to a miss and placement proceeds."""
+        fp = request_fingerprint(payload)
+        cache = self._result_cache
+        if fp not in cache and not cache.refresh(fp):
+            self._metrics.inc("result_misses")
+            return False
+        path = os.path.join(self.out_dir, f"{rid}.lens")
+        if not cache.serve(fp, rid, log_config(request), path):
+            self._metrics.inc("result_misses")
+            return False
+        now = time.perf_counter()
+        t = ClusterTicket(
+            request_id=rid, request=request, host=None, status=DONE,
+        )
+        t.result_path = path
+        t.finished_at = now
+        t.streamed_at = now
+        self.tickets[rid] = t
+        self._metrics.inc("submitted")
+        self._metrics.inc("result_hits")
+        self._metrics.tenant_inc(request.tenant, "admitted")
+        self.trace.instant(
+            "result.replay", rid=rid, tick=self._ticks,
+        )
+        return True
 
     def _ticket(self, request_id: str) -> ClusterTicket:
         t = self.tickets.get(request_id)
@@ -1517,6 +1611,22 @@ class ClusterServer:
                 for h in self.hosts.values()
                 if not h.alive
             ),
+            **(
+                {
+                    "results": {
+                        "entries": len(self._result_cache),
+                        "bytes": self._result_cache.total_bytes(),
+                        "router_hits": (
+                            self._metrics.counters["result_hits"]
+                        ),
+                        "router_misses": (
+                            self._metrics.counters["result_misses"]
+                        ),
+                    }
+                }
+                if self._result_cache is not None
+                else {}
+            ),
         }
 
     def _summed_counters(self) -> Dict[str, int]:
@@ -1562,7 +1672,10 @@ class ClusterServer:
         under distinct names), cluster gauges, and one row per host."""
         counters = self._summed_counters()
         for k, v in self._metrics.counters.items():
-            if k in ("stolen", "requeued", "ticks"):
+            if k in (
+                "stolen", "requeued", "ticks",
+                "result_hits", "result_misses",
+            ):
                 counters[f"router_{k}"] = v
             elif k == "hosts_down":
                 counters[k] = v
@@ -1628,6 +1741,10 @@ class ClusterServer:
             ("hosts_down", "hosts declared down"),
             ("submitted", "requests routed by this router"),
             ("rejected", "submits refused cluster-wide"),
+            ("result_hits",
+             "submits answered at the router from the result cache"),
+            ("result_misses",
+             "router result-cache lookups that missed"),
         ):
             emit(
                 f"lens_cluster_{name}_total", "counter", help_,
@@ -1658,7 +1775,8 @@ class ClusterServer:
             ],
         )
         for counter in ("submitted", "retired", "stolen", "adopted",
-                        "recovered", "requeued", "diverged"):
+                        "recovered", "requeued", "diverged",
+                        "result_hits", "suffix_coalesced"):
             samples = [
                 f'lens_cluster_host_{counter}_total'
                 f'{{host="{h.host_id}"}} '

@@ -177,7 +177,22 @@ class WorkerCore:
                     >= self.IDLE_PUBLISH_EVERY_S:
                 self.publish()
                 self._settled = True
-        return busy or len(self.server.queue) > 0
+        return busy or len(self.server.queue) > 0 or self._streaming()
+
+    def _streaming(self) -> bool:
+        """Stream/publish work still in flight after the scheduler
+        settles: windows queued behind the stream thread, or completed
+        logs waiting to be filed into the shared result cache. Counting
+        these as busy keeps the local-mode router ticking until every
+        result is durably published — the same "idle = fully streamed"
+        contract ``SimServer.run_until_idle`` enforces for itself — so
+        a repeat submit right after idle can hit the cache instead of
+        recomputing."""
+        srv = self.server
+        if getattr(srv, "_cache_pending", None):
+            return True
+        s = srv._streamer
+        return s is not None and any(s.progress_token())
 
     def publish(self) -> None:
         """Refresh the lock-free health/ticket snapshot (caller holds

@@ -29,6 +29,14 @@ Correlation rides ``args``: every serve event carries the request id
 that apply, so a timeline groks "this request waited 3 windows behind
 that one's streamer backpressure on shard 2".
 
+The request-stream CDN (round 18) adds four events: a
+``result.replay`` span on the requests track (a submit answered whole
+from the durable result cache — its duration is the entire serving
+cost of the hit), a ``result.store`` span on the scheduler track (a
+completed log filed under its fingerprint), and ``dedup.coalesced`` /
+``dedup.detached`` instants (a request attaching to — or re-queueing
+off — an identical in-flight leader's lane).
+
 Overhead contract (docs/observability.md): tracing OFF is a
 :class:`NullTracer` — falsy, every method a no-op — and the traced
 code paths are written to compute nothing extra behind ``if tracer:``

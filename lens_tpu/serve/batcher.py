@@ -167,6 +167,78 @@ def validate_prefix_block(prefix: Any) -> None:
         )
 
 
+def _sorted_tree(tree: Mapping[str, Any]) -> Dict[str, Any]:
+    """Rebuild an override tree with mapping keys sorted at every
+    level (leaves untouched). Dict order is semantically inert for the
+    simulation but NOT for the bytes of a ``.lens`` header
+    (``emit.log.make_header`` serializes config JSON in insertion
+    order) or for the result-cache / dedup fingerprint — one ordering
+    in, one ordering out."""
+    return {
+        k: _sorted_tree(v) if isinstance(v, Mapping) else v
+        for k, v in sorted(tree.items(), key=lambda kv: str(kv[0]))
+    }
+
+
+def canonicalize_request(request: Mapping[str, Any]) -> Dict[str, Any]:
+    """Fold spelling-level aliases out of a VALIDATED request mapping
+    so equivalent submissions construct equal requests — the round-18
+    result-cache / suffix-dedup key contract (one meaning, one
+    fingerprint; docs/serving.md, "Suffix dedup & result cache") and
+    the header-bytes contract above. Folds:
+
+    - ``seed`` -> int; ``horizon`` / ``deadline`` -> float
+    - override trees (top-level and ``prefix``) key-sorted recursively
+    - ``n_agents``: integral -> int; per-species mapping key-sorted
+      with int counts
+    - ``emit``: ``every`` -> int with the default ``every=1`` elided,
+      ``paths`` -> list of str with an empty list elided, and a
+      fully-default block -> None
+    - ``prefix``: ``horizon`` -> float, empty ``overrides`` elided
+
+    Value aliases inside override LEAVES (``1`` vs ``1.0``) are
+    deliberately NOT folded: leaf dtype can change the simulated bits,
+    so those stay distinct requests — and distinct cache keys (the
+    safe direction: a spurious miss, never a wrong hit).
+    """
+    req = dict(request)
+    if "seed" in req:
+        req["seed"] = int(req["seed"])
+    for key in ("horizon", "deadline"):
+        if req.get(key) is not None:
+            req[key] = float(req[key])
+    if isinstance(req.get("overrides"), Mapping):
+        req["overrides"] = _sorted_tree(req["overrides"])
+    n_agents = req.get("n_agents")
+    if isinstance(n_agents, Mapping):
+        req["n_agents"] = {
+            k: int(v)
+            for k, v in sorted(
+                n_agents.items(), key=lambda kv: str(kv[0])
+            )
+        }
+    elif n_agents is not None:
+        req["n_agents"] = int(n_agents)
+    if req.get("emit") is not None:
+        emit = req["emit"]
+        canon: Dict[str, Any] = {}
+        if int(emit.get("every", 1)) != 1:
+            canon["every"] = int(emit["every"])
+        if emit.get("paths"):
+            canon["paths"] = [str(p) for p in emit["paths"]]
+        req["emit"] = canon or None
+    if req.get("prefix") is not None:
+        prefix: Dict[str, Any] = {
+            "horizon": float(req["prefix"]["horizon"])
+        }
+        if req["prefix"].get("overrides"):
+            prefix["overrides"] = _sorted_tree(
+                req["prefix"]["overrides"]
+            )
+        req["prefix"] = prefix
+    return req
+
+
 class SimulationDiverged(Exception):
     """A request's lane produced non-finite state (NaN/Inf).
 
@@ -345,7 +417,10 @@ class ScenarioRequest:
             )
         validate_emit_block(request.get("emit"))
         validate_prefix_block(request.get("prefix"))
-        return cls(**request)
+        # alias folding happens HERE, at the one mapping->request
+        # gate, so every downstream identity (cache fingerprint,
+        # dedup key, header bytes) sees one spelling per meaning
+        return cls(**canonicalize_request(request))
 
 
 @dataclass
@@ -426,6 +501,15 @@ class Ticket:
     # closed by the stream-side error handler — terminal paths must
     # not close (or stream to) it again
     sink_closed: bool = False
+    # -- result cache / suffix dedup (round 18) --
+    # fingerprint: the request's bytes-relevant content address
+    # (serve.results.request_fingerprint), set at submit when either
+    # knob is armed. leader: the request id of the in-flight identical
+    # request this ticket COALESCED onto — a follower never queues,
+    # never owns a lane; it rides the leader's stream with its own
+    # sink and retires when the leader does.
+    fingerprint: Optional[str] = None
+    leader: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return (

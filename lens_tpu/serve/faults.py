@@ -70,6 +70,11 @@ KILL_SEAMS = (
     "hold.spilled",      # held snapshot spilled + WAL hold event written
     "retired.walled",    # terminal status WAL event written
     "streamed.walled",   # stream-completion WAL event written (stream thread)
+    # result-cache publication protocol (serve/results.py) — these fire
+    # only when the server runs with result_cache_mb set:
+    "result.tmp_written",  # payload copied to tmp name, not yet renamed
+    "result.renamed",      # payload renamed, sidecar not yet written
+    "result.cached",       # sidecar written: the entry is complete
 )
 
 #: Default seam per fault kind (a fault may override ``at`` only for

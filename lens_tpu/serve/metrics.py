@@ -74,6 +74,15 @@ _COUNTER_HELP = {
     "adopted": "displaced requests adopted from another host's WAL",
     "hosts_down": "cluster hosts declared down by the router",
     "sink_failed": "requests failed by a request-scoped sink error",
+    "result_hits": "submits served whole from the result cache",
+    "result_misses": "fingerprinted submits the result cache lacked",
+    "result_evictions": "result-cache entries dropped to budget",
+    "suffix_coalesced":
+        "submits coalesced onto an identical in-flight request",
+    "device_seconds_saved":
+        "estimated device-window seconds not spent thanks to result-"
+        "cache hits and suffix dedup (windows avoided x mean window "
+        "wall seconds)",
 }
 
 #: Per-tenant counter names (round 15, docs/serving.md "Front door"):
@@ -163,6 +172,12 @@ class ServerMetrics:
         # snapshot_bytes) + the quarantined-device count
         self.shards: List[Dict[str, Any]] = []
         self.quarantined_devices = 0
+        # result-cache gauges (round 18, docs/serving.md "Suffix dedup
+        # & result cache"): entry count and payload bytes of the
+        # durable content-addressed result store — its budget is its
+        # own, separate from the snapshot tiers above
+        self.result_entries = 0
+        self.result_bytes = 0
         for name, help, fn in (
             ("queue_depth", "requests waiting for a lane",
              lambda: self.queue_depth),
@@ -180,6 +195,10 @@ class ServerMetrics:
              lambda: self.snapshot_bytes),
             ("quarantined_devices", "device shards quarantined",
              lambda: self.quarantined_devices),
+            ("result_entries", "result-cache entries resident",
+             lambda: self.result_entries),
+            ("result_bytes", "result-cache payload bytes on disk",
+             lambda: self.result_bytes),
             ("device_busy_fraction",
              "fraction of the streamed span with a window in flight",
              self.device_busy_fraction),
@@ -376,6 +395,8 @@ class ServerMetrics:
             "snapshot_tiers": {
                 t: dict(row) for t, row in self.snapshot_tiers.items()
             },
+            "result_entries": self.result_entries,
+            "result_bytes": self.result_bytes,
             "shards": [dict(s) for s in self.shards],
             "quarantined_devices": self.quarantined_devices,
             "uptime_seconds": time.perf_counter() - self._t0,

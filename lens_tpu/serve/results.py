@@ -1,0 +1,518 @@
+"""Durable content-addressed RESULT cache: a CDN for simulations.
+
+The serving determinism contract (pinned since round 8, re-pinned at
+every layer since: solo == co-batched == pipelined == mesh-placed,
+bit for bit) means a request's ``.lens`` log is a pure function of
+``(bucket config, seed, overrides, n_agents, horizon, emit, prefix)``.
+That makes whole RESULTS cacheable the same way round 16 made prefix
+STATES cacheable: a completed request's log, filed under the request's
+content address, can serve every later identical submission with zero
+device windows and zero lanes — the submit short-circuits admission
+entirely and clients replay the bytes.
+
+Three pieces live here:
+
+- :func:`request_fingerprint` — the content address: sha256 over the
+  bytes-relevant coordinates of a request's canonical WAL-JSON form
+  (``serve.server._request_to_json``). ``deadline``/``tenant``/
+  ``priority``/``hold_state`` never touch the emitted bytes and are
+  excluded, so requests differing only in those hit the same entry.
+  Spelling-level aliases (``seed: 3.0`` vs ``3``, override dict
+  ordering, ``emit: {"every": 1}`` vs no emit block) are folded by
+  ``ScenarioRequest.from_mapping``'s canonicalization BEFORE the
+  request reaches serialization — one spelling in, one key out.
+- :class:`ResultCache` — the disk store, the exact protocol of the
+  snapshot disk tier (``serve/tiers.py``): payload written to a
+  per-pid tmp name then ``os.replace``'d (readers see whole entries
+  or nothing), a ``.meta.json`` sidecar written after the payload (a
+  sidecar attests a complete entry; a kill between the two leaves a
+  harmless orphan the scan ignores), a construction-time scan that
+  re-adopts every complete entry (restart-warm, like
+  ``BENCH_TIER_CPU_r16.json``'s 0-miss restart row), and a bucket
+  fingerprint guard (``result_meta.json``) refusing entries recorded
+  under a bits-relevant different bucket config. Its byte budget and
+  LRU eviction are its own — result bytes never compete with snapshot
+  tiers for budget.
+- :meth:`ResultCache.serve` — the replay: the cached log's bytes are
+  copied to the hitting request's own ``<rid>.lens`` with ONE frame
+  rewritten — the header, which embeds the experiment id (= the
+  donor's rid) and so must be re-minted for the hitting rid. Every
+  frame after the header is rid-free (SEGMENT records carry only
+  trajectory + times), so the spliced copy is byte-identical to what
+  the hitting request's own solo run would have written
+  (``tests/test_results.py`` pins it), and ``tail_frames`` replay /
+  the front door's SSE stream serve it unchanged.
+
+See docs/serving.md, "Suffix dedup & result cache".
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from lens_tpu.emit.log import (
+    SEP,
+    encode_record,
+    frame,
+    iter_frames,
+    make_header,
+)
+from lens_tpu.utils import flatten_paths
+
+#: The cache directory's identity file — the same guard as the snapshot
+#: tier dir's ``tier_meta.json``: a result's content address includes
+#: the bucket NAME, not its bits-relevant config, so the directory
+#: itself carries the bucket fingerprint and a mismatch is refused.
+RESULT_META = "result_meta.json"
+
+_META_SUFFIX = ".meta.json"
+_ENTRY_PREFIX = "res_"
+_ENTRY_SUFFIX = ".lens"
+
+#: Request keys that shape the emitted bytes. Everything else
+#: (deadline, tenant, priority, hold_state) is scheduling/billing
+#: policy: two requests differing only there stream identical records,
+#: so they SHARE a cache entry and an in-flight dedup lane.
+_BYTES_RELEVANT = (
+    "composite", "seed", "horizon", "overrides", "n_agents", "emit",
+    "prefix",
+)
+
+
+def request_fingerprint(payload: Mapping[str, Any]) -> str:
+    """The request's result content address: sha256 hex over the
+    bytes-relevant keys of its canonical WAL-JSON form
+    (``_request_to_json`` output — the same mapping ``submit``
+    accepts). ``json.dumps(sort_keys=True)`` canonicalizes mapping
+    order recursively, so override trees hash identically however
+    their dicts were built; value-level aliases are already folded by
+    ``ScenarioRequest.from_mapping``."""
+    core = {
+        k: payload[k]
+        for k in _BYTES_RELEVANT
+        if payload.get(k) is not None
+    }
+    blob = json.dumps(
+        core, sort_keys=True, separators=(",", ":")
+    ).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def log_config(request) -> Dict[str, Any]:
+    """The ``.lens`` header config for one request — the ONE encoding
+    of a request into its log's self-description, shared by the live
+    sink (``SimServer._make_sink``) and cache replay's header splice
+    (:meth:`ResultCache.serve`), so a cache hit's header is byte-equal
+    to the one the hitting request's own run would have written."""
+    req = request
+    return {
+        "composite": req.composite,
+        "seed": int(req.seed),
+        "horizon": float(req.horizon),
+        "n_agents": req.n_agents,
+        "overrides": {
+            SEP.join(map(str, p)): np.asarray(v).tolist()
+            for p, v in flatten_paths(req.overrides or {})
+        },
+        "emit": dict(req.emit or {}),
+        # a forked run's rows are SUFFIX-only with divergent
+        # overrides applied at the fork point — without the prefix
+        # declaration the file would misdescribe itself as a full
+        # t=0 run
+        "prefix": (
+            {
+                "horizon": float(req.prefix["horizon"]),
+                "overrides": {
+                    SEP.join(map(str, p)): np.asarray(v).tolist()
+                    for p, v in flatten_paths(
+                        req.prefix.get("overrides") or {}
+                    )
+                },
+            }
+            if req.prefix
+            else None
+        ),
+    }
+
+
+@dataclass
+class _Entry:
+    fingerprint: str
+    nbytes: int
+    used: float  # last-use wall stamp (LRU order; survives restarts)
+    hits: int = 0
+    created: float = 0.0
+    request: Optional[Dict[str, Any]] = field(default=None)
+
+
+class ResultCache:
+    """Content-addressed ``.lens`` result store over one directory.
+
+    Single-writer-per-entry by content address (identical fingerprints
+    write identical bytes, so concurrent writers racing one entry are
+    harmless — last rename wins with the same content); multi-process
+    tolerant the same way the shared snapshot tier dir is: per-pid tmp
+    names, ``os.replace`` publication, and every read path treating a
+    vanished file (a peer's eviction) as a plain miss.
+
+    Parameters
+    ----------
+    dir:
+        The cache directory (created if missing). One
+        ``res_<digest>.lens`` payload + ``.meta.json`` sidecar per
+        entry, plus the ``result_meta.json`` fingerprint guard.
+    budget_bytes:
+        Byte budget over payload sizes (None = unbounded). Past it,
+        least-recently-USED entries are deleted — results have no
+        lower tier to demote to.
+    fingerprint:
+        The server's bits-relevant bucket fingerprint
+        (``serve.wal.buckets_fingerprint``); verified against (or
+        pinned into) ``result_meta.json``. ``None`` skips the check —
+        the inspection CLI's mode, which never serves hits.
+    """
+
+    def __init__(
+        self,
+        dir: str,
+        budget_bytes: Optional[int] = None,
+        fingerprint: Optional[str] = None,
+    ):
+        if budget_bytes is not None and budget_bytes <= 0:
+            raise ValueError(
+                f"budget_bytes={budget_bytes} must be > 0 (or None "
+                f"for unbounded)"
+            )
+        self.dir = os.path.abspath(dir)
+        self.budget_bytes = budget_bytes
+        os.makedirs(self.dir, exist_ok=True)
+        if fingerprint is not None:
+            self._check_fingerprint(fingerprint)
+        self._entries: Dict[str, _Entry] = {}
+        # lifetime tallies (delta-synced into the server's metrics
+        # registry at gauge refresh, like the snapshot store's)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.stored = 0
+        # fault seams (tests): set by the owning server so a FaultPlan
+        # kill can land between the tmp write and the rename
+        self.faults: Any = None
+        self._scan()
+
+    # -- directory protocol (the tiers.py idioms) ----------------------------
+
+    def _check_fingerprint(self, fingerprint: str) -> None:
+        path = os.path.join(self.dir, RESULT_META)
+        if os.path.exists(path):
+            with open(path) as f:
+                have = json.load(f).get("fingerprint")
+            if have != fingerprint:
+                raise ValueError(
+                    f"{self.dir} holds results for a server with "
+                    f"bucket fingerprint {have!r}, not "
+                    f"{fingerprint!r} — the bucket configuration "
+                    f"changed in a bits-relevant way, so its cached "
+                    f"results would replay a different simulation. "
+                    f"Use a fresh results dir (or restore the "
+                    f"original buckets)."
+                )
+            return
+        # per-pid tmp: cluster workers and the router construct their
+        # caches over ONE shared dir concurrently at bring-up; a
+        # shared tmp name would let one replace consume another's file
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"fingerprint": fingerprint}, f)
+        os.replace(tmp, path)
+
+    def _name(self, fp: str) -> str:
+        return f"{_ENTRY_PREFIX}{fp[:32]}{_ENTRY_SUFFIX}"
+
+    def _path(self, fp: str) -> str:
+        return os.path.join(self.dir, self._name(fp))
+
+    def _write_sidecar(self, fp: str, entry: _Entry) -> None:
+        path = self._path(fp) + _META_SUFFIX
+        tmp = f"{path}.tmp-{os.getpid()}"
+        payload = {
+            "fingerprint": fp,
+            "nbytes": int(entry.nbytes),
+            "created": entry.created,
+            "used": entry.used,
+            "hits": int(entry.hits),
+        }
+        if entry.request is not None:
+            payload["request"] = entry.request
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    def _scan(self) -> None:
+        """Adopt every complete entry the directory already holds —
+        the restart-warm path. Torn entries (payload without its
+        sidecar: a kill between the payload rename and the sidecar
+        write) are skipped; the rename protocol guarantees a present
+        payload whose sidecar exists was completely WRITTEN, and the
+        size check guards the unsynced-page-cache case (``put`` does
+        not fsync): a payload truncated by a host crash disagrees
+        with the byte count its sidecar recorded and is demoted to a
+        miss."""
+        for meta in sorted(glob.glob(os.path.join(
+            self.dir, f"{_ENTRY_PREFIX}*{_ENTRY_SUFFIX}{_META_SUFFIX}"
+        ))):
+            try:
+                with open(meta) as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue  # torn sidecar: the entry never happened
+            fp = data.get("fingerprint")
+            if not fp or fp in self._entries:
+                continue
+            payload = meta[: -len(_META_SUFFIX)]
+            try:
+                if os.path.getsize(payload) != int(
+                    data.get("nbytes", -1)
+                ):
+                    continue  # truncated by a host crash: a miss
+            except OSError:
+                continue  # sidecar outlived its payload
+            self._entries[fp] = _Entry(
+                fingerprint=fp,
+                nbytes=int(data.get("nbytes", 0)),
+                used=float(data.get("used", 0.0)),
+                hits=int(data.get("hits", 0)),
+                created=float(data.get("created", 0.0)),
+                request=data.get("request"),
+            )
+
+    def refresh(self, fp: str) -> bool:
+        """Adopt ONE fingerprint published by a peer process since our
+        scan (cluster workers and the router share a results dir; the
+        rename protocol makes a complete entry visible atomically).
+        Returns True if ``fp`` is now resident. Cheap enough for the
+        miss path: one stat pair on a miss, nothing on a hit."""
+        if fp in self._entries:
+            return True
+        meta = self._path(fp) + _META_SUFFIX
+        try:
+            with open(meta) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        if data.get("fingerprint") != fp:
+            return False
+        try:
+            if os.path.getsize(self._path(fp)) != int(
+                data.get("nbytes", -1)
+            ):
+                return False  # truncated by a host crash (see _scan)
+        except OSError:
+            return False
+        self._entries[fp] = _Entry(
+            fingerprint=fp,
+            nbytes=int(data.get("nbytes", 0)),
+            used=float(data.get("used", 0.0)),
+            hits=int(data.get("hits", 0)),
+            created=float(data.get("created", 0.0)),
+            request=data.get("request"),
+        )
+        return True
+
+    # -- size / inspection ---------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, fp: str) -> bool:
+        return fp in self._entries
+
+    def total_bytes(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """One inspection row per entry (the ``cache`` CLI's table),
+        LRU-first — the order eviction would take them."""
+        now = time.time()
+        out = []
+        for fp, e in sorted(
+            self._entries.items(), key=lambda kv: kv[1].used
+        ):
+            req = e.request or {}
+            out.append({
+                "fingerprint": fp,
+                "name": self._name(fp),
+                "nbytes": e.nbytes,
+                "hits": e.hits,
+                "age_s": max(now - e.created, 0.0) if e.created else None,
+                "idle_s": max(now - e.used, 0.0) if e.used else None,
+                "composite": req.get("composite"),
+                "horizon": req.get("horizon"),
+            })
+        return out
+
+    # -- writes --------------------------------------------------------------
+
+    def put(
+        self,
+        fp: str,
+        src_path: str,
+        request: Optional[Mapping[str, Any]] = None,
+    ) -> bool:
+        """File one completed request's log under its fingerprint:
+        copy to a per-pid tmp name, rename, THEN write the sidecar —
+        a kill anywhere in between leaves either nothing or an orphan
+        payload the scan ignores, never a half-entry that could
+        serve. No fsync on purpose: this runs on the scheduler thread
+        between ticks, an fsync per completed request measurably taxes
+        the all-miss path (bench_serve --cdn pins it <=2%), and the
+        cache is a rebuildable optimization, not the recovery record
+        — against process death the rename ordering alone holds, and
+        a HOST crash that tears page cache can at worst truncate a
+        payload, which the scan demotes to a miss by checking it
+        against the sidecar's byte count. Idempotent per fingerprint
+        (the content address guarantees a present entry's bytes
+        match). Returns whether a new entry was filed."""
+        if fp in self._entries:
+            return False
+        dst = self._path(fp)
+        tmp = f"{dst}.tmp-{os.getpid()}"
+        try:
+            nbytes = os.path.getsize(src_path)
+            shutil.copyfile(src_path, tmp)
+            if self.faults is not None:
+                # seam for the SIGKILL-mid-write drill: the payload
+                # exists only under its tmp name here — a scan must
+                # see no entry
+                self.faults.kill("result.tmp_written")
+            os.replace(tmp, dst)
+            if self.faults is not None:
+                # payload renamed, sidecar not yet written: an orphan
+                # payload the scan skips (and a rerun re-files over)
+                self.faults.kill("result.renamed")
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        now = time.time()
+        entry = _Entry(
+            fingerprint=fp,
+            nbytes=int(nbytes),
+            used=now,
+            created=now,
+            request=dict(request) if request is not None else None,
+        )
+        self._write_sidecar(fp, entry)
+        self._entries[fp] = entry
+        self.stored += 1
+        self._evict_to_budget()
+        return True
+
+    def _evict_to_budget(self) -> None:
+        if self.budget_bytes is None:
+            return
+        self._shrink_to(self.budget_bytes)
+
+    def _shrink_to(self, max_bytes: int) -> List[str]:
+        """Delete least-recently-used entries until total payload
+        bytes fit ``max_bytes``; returns the evicted fingerprints.
+        Deletion order is payload first, then sidecar — the reverse of
+        publication, so a kill mid-evict leaves a sidecar-without-
+        payload the scan already skips."""
+        evicted: List[str] = []
+        by_lru = sorted(
+            self._entries.items(), key=lambda kv: kv[1].used
+        )
+        total = self.total_bytes()
+        for fp, e in by_lru:
+            if total <= max_bytes:
+                break
+            path = self._path(fp)
+            for victim in (path, path + _META_SUFFIX):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass  # a peer already evicted it
+            del self._entries[fp]
+            total -= e.nbytes
+            self.evictions += 1
+            evicted.append(fp)
+        return evicted
+
+    def gc(self, max_bytes: int) -> List[str]:
+        """Explicit LRU eviction down to ``max_bytes`` (the ``cache``
+        CLI's ``--max-mb``); returns the evicted fingerprints."""
+        return self._shrink_to(max(int(max_bytes), 0))
+
+    # -- reads ---------------------------------------------------------------
+
+    def serve(
+        self, fp: str, rid: str, config: Mapping[str, Any], dst: str
+    ) -> bool:
+        """Replay one cached result as ``rid``'s own log at ``dst``:
+        every frame copied verbatim except the first — the header,
+        re-minted for the hitting rid via :func:`log_config`'s shared
+        encoding (so the spliced file is byte-equal to the rid's own
+        solo run). Written tmp+rename like every other artifact, so a
+        kill mid-replay leaves no torn ``<rid>.lens`` for recovery to
+        trust. Any failure (entry vanished under a peer's eviction, a
+        torn donor) degrades to a MISS — the caller falls through to
+        the normal admission path."""
+        entry = self._entries.get(fp)
+        if entry is None:
+            self.misses += 1
+            return False
+        src = self._path(fp)
+        tmp = f"{dst}.tmp-{os.getpid()}"
+        # a restart-warm server may hit before any cold run created
+        # its out dir (real sinks make it lazily)
+        parent = os.path.dirname(dst)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        try:
+            frames = iter_frames(src, with_offsets=True)
+            try:
+                _, first_end = next(frames)
+            finally:
+                frames.close()
+            with open(tmp, "wb") as out:
+                out.write(frame(encode_record(
+                    make_header(rid, config)
+                )))
+                with open(src, "rb") as inp:
+                    inp.seek(first_end)
+                    shutil.copyfileobj(inp, out)
+                out.flush()
+                os.fsync(out.fileno())
+            os.replace(tmp, dst)
+        except (OSError, ValueError, StopIteration):
+            # vanished/torn donor: forget it so later submits miss
+            # cleanly and recompute (the prewarm torn-spill repair)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            self._entries.pop(fp, None)
+            self.misses += 1
+            return False
+        entry.used = time.time()
+        entry.hits += 1
+        self.hits += 1
+        try:
+            # best-effort: the sidecar's hit/used stamps feed the CLI
+            # table and cross-restart LRU; losing one update is fine
+            self._write_sidecar(fp, entry)
+        except OSError:
+            pass
+        return True

@@ -124,6 +124,11 @@ from lens_tpu.serve.metrics import (
     request_timing_row,
     write_server_meta,
 )
+from lens_tpu.serve.results import (
+    ResultCache,
+    log_config,
+    request_fingerprint,
+)
 from lens_tpu.serve.snapshots import (
     DEVICE,
     SnapshotStore,
@@ -141,6 +146,7 @@ from lens_tpu.serve.streamer import (
 from lens_tpu.parallel.mesh import serve_devices
 from lens_tpu.serve.wal import (
     BEGIN,
+    COALESCE,
     HOLD,
     QUARANTINE,
     RELEASE,
@@ -590,6 +596,8 @@ class SimServer:
         device_watchdog_s: Optional[float] = None,
         trace_dir: Optional[str] = None,
         metrics_interval_s: Optional[float] = None,
+        result_cache_mb: Optional[float] = None,
+        dedup: str = "off",
     ):
         if not buckets:
             raise ValueError("SimServer needs at least one bucket")
@@ -626,6 +634,26 @@ class SimServer:
             raise ValueError(
                 f"host_budget_mb={host_budget_mb} must be >= 0"
             )
+        if dedup not in ("on", "off"):
+            raise ValueError(
+                f"unknown dedup {dedup!r}; known: on, off"
+            )
+        if result_cache_mb is not None:
+            if result_cache_mb <= 0:
+                raise ValueError(
+                    f"result_cache_mb={result_cache_mb} must be > 0"
+                )
+            if sink != "log":
+                raise ValueError(
+                    "result_cache_mb needs sink='log': the cache "
+                    "stores and replays whole .lens result logs"
+                )
+            if not (tier_dir or recover_dir):
+                raise ValueError(
+                    "result_cache_mb needs tier_dir or recover_dir "
+                    "(a durable directory for the cached results to "
+                    "live in)"
+                )
         if metrics_interval_s is not None:
             if metrics_interval_s < 0:
                 raise ValueError(
@@ -736,6 +764,36 @@ class SimServer:
             self.snapshots = SnapshotStore(budget_bytes=budget_bytes)
         if self.trace:
             self.snapshots.trace = self.trace
+        # -- request-stream CDN (round 18, docs/serving.md "Suffix
+        # dedup & result cache"): a durable content-addressed RESULT
+        # cache (whole .lens logs, served at submit with zero device
+        # windows) + in-flight suffix dedup (identical concurrent
+        # requests coalesce onto ONE lane, fanning out at the
+        # streamer). Both off by default: the dormant path is the
+        # round-17 server bit for bit. --
+        self.result_cache_mb = result_cache_mb
+        self.dedup = dedup
+        self._result_cache: Optional[ResultCache] = None
+        self._result_evictions_seen = 0
+        # DONE tickets awaiting cache filing (appended by the stream
+        # thread's completion callback, drained on the scheduler
+        # thread each tick — list.append is atomic, same handoff
+        # discipline as _sink_failures)
+        self._cache_pending: List[Ticket] = []
+        # dedup state: fingerprint -> the QUEUED ticket later
+        # identical submits may attach to; leader rid -> its attached
+        # follower tickets (never queued, never own a lane)
+        self._dedup_leaders: Dict[str, Ticket] = {}
+        self._dedup_groups: Dict[str, List[Ticket]] = {}
+        if result_cache_mb is not None:
+            self._result_cache = ResultCache(
+                os.path.join(tier_dir or recover_dir, "results"),
+                budget_bytes=int(float(result_cache_mb) * 2**20),
+                fingerprint=self._fingerprint,
+            )
+            # the cache's kill seams fire under this server's plan
+            # (the SIGKILL-mid-write durability drills)
+            self._result_cache.faults = self.faults
         # counters mirrored from the store into the metrics registry
         # (delta-synced at gauge refresh: the store is scheduler-
         # thread-only, the registry is the export surface)
@@ -800,6 +858,7 @@ class SimServer:
             "check_finite", "watchdog_s",
             "sink_errors", "recover_dir", "faults", "mesh",
             "device_watchdog_s", "trace_dir", "metrics_interval_s",
+            "result_cache_mb", "dedup",
         )
         server_kwargs = {
             k: kwargs.pop(k) for k in server_keys if k in kwargs
@@ -862,6 +921,37 @@ class SimServer:
         ticket = self._build_ticket(
             request, rid if rid is not None else self.queue.next_id()
         )
+        # request-stream CDN (round 18): a durable cache hit serves
+        # the whole result at submit — no queue, no lane, no device
+        # window; an identical IN-FLIGHT request absorbs this one as a
+        # follower on its lane. Both run after validation (malformed
+        # requests still raise here) and neither consumes queue depth,
+        # so duplicates can never be refused by backpressure.
+        if (
+            self._result_cache is not None
+            and not request.hold_state
+            and self._serve_cached(ticket)
+        ):
+            return ticket.request_id
+        if self.dedup == "on" and self._try_coalesce(ticket):
+            self.tickets[ticket.request_id] = ticket
+            self._metrics.inc("submitted")
+            self._metrics.tenant_inc(request.tenant, "admitted")
+            if self._wal is not None:
+                self._wal.append({
+                    "event": SUBMIT,
+                    "rid": ticket.request_id,
+                    "request": _request_to_json(request),
+                })
+                # audit fact, not recovery state: replayed SUBMITs
+                # re-coalesce through the same deterministic logic
+                self._wal.append({
+                    "event": COALESCE,
+                    "rid": ticket.request_id,
+                    "leader": ticket.leader,
+                })
+                self.faults.kill("submit.walled")
+            return ticket.request_id
         try:
             self.queue.push(ticket, retry_after=self._retry_after())
         except QueueFull:
@@ -927,6 +1017,15 @@ class SimServer:
                 if request.hold_state
                 else None
             ),
+            # the result/dedup content address, computed only when a
+            # CDN knob is armed (both off: no hashing on the submit
+            # path, the round-17 cost profile exactly)
+            fingerprint=(
+                request_fingerprint(_request_to_json(request))
+                if self._result_cache is not None
+                or self.dedup == "on"
+                else None
+            ),
         )
 
     def _register(self, ticket: Ticket) -> None:
@@ -938,6 +1037,16 @@ class SimServer:
             self._resolve_prefix(
                 ticket, self.buckets[ticket.request.composite]
             )
+        if (
+            self.dedup == "on"
+            and ticket.fingerprint is not None
+            and not ticket.internal
+        ):
+            # this queued ticket is now the lane later identical
+            # submits coalesce onto (latest queued wins; attachment
+            # is refused once it stops being QUEUED, and the entry is
+            # dropped at retirement)
+            self._dedup_leaders[ticket.fingerprint] = ticket
         self._metrics.queue_depth = len(self.queue)
 
     def _validate_request(
@@ -1048,6 +1157,258 @@ class SimServer:
             if request.n_agents is not None
             else bucket.cfg["n_agents"]
         )
+
+    # -- request-stream CDN (round 18) ---------------------------------------
+
+    def _serve_cached(self, t: Ticket) -> bool:
+        """Serve one submit whole from the durable result cache: the
+        cached log's bytes are replayed as the new rid's own
+        ``<rid>.lens`` (header re-minted, every other frame verbatim —
+        byte-equal to what this request's own cold run would write),
+        and the ticket is born terminal — no queue, no lane, zero
+        device windows. Any replay failure degrades to a miss and the
+        caller falls through to the normal path. ``hold_state``
+        requests never take this path (their product includes a
+        pinned device snapshot only a real lane can capture)."""
+        # results the streamer completed since the last tick file NOW
+        # (the tick's own sweep may not have run yet — an idle server's
+        # final completions land between ticks, and a submit must see
+        # them; submit and tick are serialized by the caller contract)
+        self._sweep_result_cache()
+        fp = t.fingerprint
+        if fp not in self._result_cache \
+                and not self._result_cache.refresh(fp):
+            # refresh: under a cluster, a PEER worker (or the router)
+            # may have filed this fingerprint into the shared results
+            # dir since our scan
+            self._metrics.inc("result_misses")
+            return False
+        rid = t.request_id
+        path = os.path.join(self.out_dir, f"{rid}.lens")
+        t0 = time.perf_counter()
+        if not self._result_cache.serve(
+            fp, rid, log_config(t.request), path
+        ):
+            # the entry vanished under a peer's eviction or its donor
+            # was torn: an honest miss, already forgotten by the cache
+            self._metrics.inc("result_misses")
+            return False
+        now = time.perf_counter()
+        pool = self.buckets[t.request.composite].pool
+        t.result_path = path
+        t.status = DONE
+        t.steps_done = t.horizon_steps
+        t.emit_count = t.horizon_steps // pool.emit_every
+        # the replay IS the stream: finished and streamed the moment
+        # the rename landed (admitted_at stays None — the timing row
+        # and front-door status are None-tolerant for tickets that
+        # never touched a lane)
+        t.finished_at = now
+        t.streamed_at = now
+        t.mark_stage("served from result cache", self._ticks)
+        self.tickets[rid] = t
+        self._metrics.inc("submitted")
+        self._metrics.inc("result_hits")
+        self._metrics.inc(
+            "device_seconds_saved",
+            -(-(t.horizon_steps - t.steps_base) // pool.window_steps)
+            * self._metrics.avg_window_seconds(),
+        )
+        self._metrics.tenant_inc(t.request.tenant, "admitted")
+        self._metrics.observe_request(0.0, now - t.submitted_at)
+        self.trace.emit_span(
+            "result.replay", t0, now, track=REQUEST_TRACK,
+            rid=rid, tick=self._ticks,
+        )
+        if self._wal is not None:
+            # the full terminal fact set, so recovery materializes the
+            # hit over its on-disk log instead of re-running it (the
+            # spliced file landed — rename protocol — before any of
+            # these events could)
+            self._wal.append({
+                "event": SUBMIT,
+                "rid": rid,
+                "request": _request_to_json(t.request),
+            })
+            self._wal.append({
+                "event": RETIRE,
+                "rid": rid,
+                "status": DONE,
+                "error": None,
+                "steps": t.steps_done,
+            })
+            self._wal.append({"event": STREAMED, "rid": rid})
+            self.faults.kill("submit.walled")
+        return True
+
+    def _try_coalesce(self, t: Ticket) -> bool:
+        """Attach one submit as a FOLLOWER of an identical QUEUED
+        request, if there is one: the follower never queues and never
+        owns a lane — it rides the leader's per-lane stream with its
+        own sink (round-18 suffix dedup). Attachment closes at the
+        leader's admission (its tick also dispatches the first
+        window); later duplicates run solo — or hit the durable cache
+        once the leader's result lands. ``hold_state`` submits always
+        run their own lane (their retirement pins a device
+        snapshot)."""
+        if t.request.hold_state or t.internal:
+            return False
+        leader = (
+            self._dedup_leaders.get(t.fingerprint)
+            if t.fingerprint is not None
+            else None
+        )
+        if (
+            leader is None
+            or leader is t
+            or leader.status != QUEUED
+            or leader.cancel_requested
+        ):
+            return False
+        self._attach_follower(t, leader)
+        return True
+
+    def _attach_follower(self, t: Ticket, leader: Ticket) -> None:
+        t.leader = leader.request_id
+        t.status = QUEUED
+        self._dedup_groups.setdefault(
+            leader.request_id, []
+        ).append(t)
+        t.mark_stage(
+            f"coalesced onto {leader.request_id}", self._ticks
+        )
+        self._metrics.inc("suffix_coalesced")
+        self.trace.instant(
+            "dedup.coalesced", rid=t.request_id,
+            leader=leader.request_id, tick=self._ticks,
+        )
+
+    def _resolve_group(
+        self, leader: Ticket, followers: List[Ticket], status: str
+    ) -> None:
+        """Propagate a leader's terminal fact to its attached
+        followers. DONE retires every follower DONE (their streams
+        already carry the same records). FAILED — divergence, sink
+        failure, admission error — fails them with the cause: their
+        records rode the same poisoned lane. CANCELLED/TIMEOUT are the
+        LEADER'S facts only (deadlines are excluded from the
+        fingerprint, so followers may outlive their leader): each
+        follower detaches and re-queues as an independent request —
+        sink restarted, counters reset — re-coalescing among
+        themselves so the group still costs one lane."""
+        if status == DONE:
+            pool = self.buckets[leader.request.composite].pool
+            for f in followers:
+                self._metrics.inc(
+                    "device_seconds_saved",
+                    -(-(f.horizon_steps - f.steps_base)
+                      // pool.window_steps)
+                    * self._metrics.avg_window_seconds(),
+                )
+                self._finish(f, DONE)
+                self._metrics.inc("retired")
+            return
+        if status in (FAILED, MIGRATED):
+            # MIGRATED is unreachable (withdraw refuses leaders with
+            # followers) but fail-closed beats silently parking them
+            cause = leader.error or f"leader {status}"
+            for f in followers:
+                f.error = (
+                    f"coalesced leader {leader.request_id} "
+                    f"{status}: {cause}"
+                )
+                self._finish(f, FAILED)
+                self._metrics.inc("failed")
+            return
+        bucket = self.buckets[leader.request.composite]
+        for f in followers:
+            self._reset_follower(f, bucket)
+            f.leader = None
+            if f.cancel_requested:
+                self._finish(f, CANCELLED)
+                self._metrics.inc("cancelled")
+                continue
+            f.mark_stage(
+                f"detached from {status} leader "
+                f"{leader.request_id}", self._ticks,
+            )
+            self.trace.instant(
+                "dedup.detached", rid=f.request_id,
+                tick=self._ticks, leader=leader.request_id,
+            )
+            if self.dedup == "on" and self._try_coalesce(f):
+                continue
+            # force: these requests were already accepted once; the
+            # client backpressure bound must not drop them now
+            self.queue.push(f, retry_after=0.0, force=True)
+            if self.dedup == "on" and f.fingerprint is not None:
+                self._dedup_leaders[f.fingerprint] = f
+            if f.prefix_key is not None:
+                self._resolve_prefix(f, bucket)
+        self._metrics.queue_depth = len(self.queue)
+
+    def _reset_follower(self, f: Ticket, bucket: _Bucket) -> None:
+        """Void a follower's progress so a re-run regenerates its
+        complete stream (the displaced-ticket reset, minus the lane
+        bookkeeping followers never had): restart the sink, rewind the
+        step/emit counters, clear the stream marks and any parked sink
+        failure of the dead incarnation."""
+        sink = self._results.pop(f.request_id, None)
+        if sink is not None:
+            try:
+                sink.close()
+            except Exception:
+                pass  # a torn sink must not abort the detach
+        self._stream_done.pop(f.request_id, None)
+        f.status = QUEUED
+        f.shard = None
+        f.admitted_at = None
+        f.steps_done = f.steps_base
+        f.emit_count = f.steps_base // bucket.pool.emit_every
+        f.first_window_at = None
+        f.streamed_at = None
+        f.requeued_at = time.perf_counter()
+        f.requeues += 1
+        with self._sink_fail_lock:
+            f.sink_closed = False
+            self._sink_failures.pop(f.request_id, None)
+
+    def _sweep_result_cache(self) -> None:
+        """File completed results into the durable cache (scheduler
+        thread; the stream thread only parks DONE tickets on the
+        pending list). Runs AFTER the tick's quarantine sweep so a
+        divergence detected with the usual one-window lag flips the
+        ticket before it can be filed. Honest limit (docs/serving.md):
+        a divergence only detectable after ``close()`` — the final
+        window's flags with no further tick — can still file a
+        poisoned entry; ``check_finite="window"`` servers that care
+        should tick once past the last retirement."""
+        if self._result_cache is None or not self._cache_pending:
+            return
+        pending, self._cache_pending = self._cache_pending, []
+        for t in pending:
+            if (
+                t.status != DONE
+                or t.diverged
+                or t.sink_closed
+                or t.internal
+                or t.warm
+                or t.fingerprint is None
+                or t.result_path is None
+                or t.fingerprint in self._result_cache
+            ):
+                continue
+            t0 = time.perf_counter()
+            if self._result_cache.put(
+                t.fingerprint, t.result_path,
+                request=_request_to_json(t.request),
+            ):
+                self.trace.emit_span(
+                    "result.store", t0, time.perf_counter(),
+                    track=SCHED_TRACK, rid=t.request_id,
+                    tick=self._ticks,
+                )
+                self.faults.kill("result.cached")
 
     def _resolve_prefix(self, t: Ticket, bucket: _Bucket) -> None:
         """Route a prefix-declaring ticket through the snapshot store:
@@ -1458,6 +1819,24 @@ class SimServer:
                 },
             },
             "tenants": self._metrics.tenants,
+            **(
+                {
+                    "results": {
+                        "entries": self._metrics.result_entries,
+                        "bytes": self._metrics.result_bytes,
+                        "hits": c["result_hits"],
+                        "misses": c["result_misses"],
+                        "coalesced": c["suffix_coalesced"],
+                        "evictions": c["result_evictions"],
+                        "device_seconds_saved": (
+                            c["device_seconds_saved"]
+                        ),
+                    }
+                }
+                if self._result_cache is not None
+                or self.dedup == "on"
+                else {}
+            ),
         }
 
     def reset_samples(self) -> None:
@@ -1502,6 +1881,20 @@ class SimServer:
             )
             self._rejected_seen = stats["rejected"]
         self._metrics.quarantined_devices = len(self._quarantined)
+        if self._result_cache is not None:
+            self._metrics.result_entries = len(self._result_cache)
+            self._metrics.result_bytes = (
+                self._result_cache.total_bytes()
+            )
+            evicted = self._result_cache.evictions
+            if evicted > self._result_evictions_seen:
+                # delta-sync like snapshot_rejected above: the cache
+                # object counts, the registry counter exports
+                self._metrics.inc(
+                    "result_evictions",
+                    evicted - self._result_evictions_seen,
+                )
+                self._result_evictions_seen = evicted
         self._metrics.shards = self._shard_gauges()
 
     def _shard_gauges(self) -> List[Dict[str, Any]]:
@@ -1608,6 +2001,12 @@ class SimServer:
         is reclaimed at the next tick (already-streamed records are
         kept). Returns the resulting status."""
         t = self._ticket(request_id)
+        if t.leader is not None and t.status in (QUEUED, RUNNING):
+            # a coalesced follower never owns a queue slot or a lane —
+            # the scheduler's follower sweep detaches its sink from
+            # the leader's stream without touching the shared lane
+            t.cancel_requested = True
+            return t.status
         if t.status == QUEUED and self.queue.drop(t):
             self._finish(t, CANCELLED)
             self._metrics.inc("cancelled")
@@ -1661,6 +2060,17 @@ class SimServer:
                 f"request {request_id} is coalesced onto an in-flight "
                 f"prefix run here; it migrates only before or after "
                 f"the prefix resolves"
+            )
+        if t.leader is not None:
+            raise ValueError(
+                f"request {request_id} rides leader {t.leader}'s lane "
+                f"on this host (suffix dedup); followers do not "
+                f"migrate"
+            )
+        if self._dedup_groups.get(request_id):
+            raise ValueError(
+                f"request {request_id} leads a coalesced group here; "
+                f"its followers' streams fan out from this host"
             )
         if t.carry_state is not None:
             raise ValueError(
@@ -1889,6 +2299,40 @@ class SimServer:
         if self._warm_pending:
             did_work |= self._preempt_warm_lanes()
 
+        # 2c. coalesced followers: a follower's cancel/deadline
+        #     DETACHES it from its group — its own sink closes (in
+        #     stream order, keeping partial records), the leader's
+        #     lane runs on untouched. Followers live outside the
+        #     queue and the lane map, so neither sweep above sees
+        #     them.
+        if self._dedup_groups:
+            for leader_rid, group in list(self._dedup_groups.items()):
+                for f in list(group):
+                    if not (f.cancel_requested or f.expired(now)):
+                        continue
+                    group.remove(f)
+                    status = (
+                        CANCELLED if f.cancel_requested else TIMEOUT
+                    )
+                    self._finish(f, status)
+                    self._metrics.inc(
+                        "cancelled" if status == CANCELLED
+                        else "timeouts"
+                    )
+                    self.trace.instant(
+                        "dedup.detached", rid=f.request_id,
+                        tick=self._ticks, leader=leader_rid,
+                        status=status,
+                    )
+                    did_work = True
+                if not group:
+                    self._dedup_groups.pop(leader_rid, None)
+
+        # 2d. file freshly-completed results into the durable cache
+        #     (after the 0b quarantine sweep above, so a divergence
+        #     caught with its one-window lag flips the ticket first)
+        self._sweep_result_cache()
+
         # 3. admission: FIFO over the queue, per-bucket free lanes;
         #    forks waiting on an in-flight prefix are skipped in place
         free = {
@@ -1942,7 +2386,12 @@ class SimServer:
             for b in self.buckets.values()
             for s in b.shards
         )
-        return did_work
+        # completed results parked for the durable cache count as
+        # work-in-flight: the stream thread can land one during this
+        # tick's drain, and reporting idle before the 2d sweep files
+        # it would let run_until_idle return with publication pending
+        # (a repeat submit right after idle would then race a miss)
+        return did_work or bool(self._cache_pending)
 
     def run_until_idle(self, max_ticks: Optional[int] = None) -> int:
         """Drive ``tick`` until nothing is queued or running (the
@@ -1959,10 +2408,11 @@ class SimServer:
                 # reporting idle (also surfaces stream errors here)
                 if self._streamer is not None:
                     self._streamer.drain()
-                if self._sink_failures:
-                    # a scoped sink failure landed during the final
-                    # drain: tick once more so it retires FAILED
-                    # before this reports idle
+                if self._sink_failures or self._cache_pending:
+                    # a scoped sink failure or a cache-bound result
+                    # landed during the final drain: tick once more
+                    # so it retires FAILED / files into the result
+                    # cache before this reports idle
                     continue
                 return ticks
             if max_ticks is not None and ticks >= max_ticks:
@@ -2135,6 +2585,18 @@ class SimServer:
             self._results[t.request_id] = self._make_sink(t)
             if self._streamer is not None:
                 self._stream_done[t.request_id] = threading.Event()
+        # attached followers come alive with their leader's lane: each
+        # gets its OWN sink (and stream event) here, fed by fan-out
+        # slices at every window — but no lane, and no place in the
+        # admitted counter (they scatter nothing)
+        for f in self._dedup_groups.get(t.request_id, ()):
+            f.status = RUNNING
+            f.shard = shard.index
+            f.admitted_at = now
+            f.mark_stage("admitted (coalesced)", self._ticks)
+            self._results[f.request_id] = self._make_sink(f)
+            if self._streamer is not None:
+                self._stream_done[f.request_id] = threading.Event()
         self._metrics.inc("admitted")
         self.faults.kill("admitted")
 
@@ -2588,6 +3050,18 @@ class SimServer:
             self._finish(t, FAILED)
             self._metrics.inc("failed")
             return
+        # displaced leader: its followers' sinks also carry partial
+        # records from the dead device — restart them alongside the
+        # leader so every fanned-out stream regenerates complete
+        for f in self._dedup_groups.get(t.request_id, ()):
+            self._reset_follower(f, bucket)
+            f.mark_stage(
+                f"requeued off quarantined device {dead} "
+                f"(coalesced)", self._ticks,
+            )
+        if self.dedup == "on" and t.fingerprint is not None:
+            # back in the queue, the leader can pick up NEW followers
+            self._dedup_leaders[t.fingerprint] = t
         # force: failover re-queues already-admitted work; bouncing it
         # off the client backpressure bound would drop accepted
         # requests
@@ -2720,7 +3194,8 @@ class SimServer:
                 if retire:
                     retiring.append((lane, t))
                 continue
-            job = self._lane_slice(pool, t, lane, before)
+            data = self._lane_slice(pool, t, lane, before)
+            job = data
             if job is not None:
                 slices.append(job)
             elif retire and pipelined:
@@ -2745,6 +3220,49 @@ class SimServer:
                     job.close_after = True
                     job.on_close = self._completion_cb(t)
                 retiring.append((lane, t))
+            # suffix-dedup fan-out: every attached follower mirrors
+            # this window into its OWN sink — the leader's row
+            # selection (idx/times/paths) verbatim, so each follower's
+            # log is byte-equal to its solo run; error scope stays
+            # per-follower (one torn follower sink never touches the
+            # leader or its siblings)
+            for f in self._dedup_groups.get(t.request_id, ()):
+                f.steps_done = t.steps_done
+                f.emit_count = t.emit_count
+                if f.first_window_at is None:
+                    f.first_window_at = t0
+                f.mark_stage(
+                    "window dispatched", self._ticks, t.stage_info
+                )
+                fjob = None
+                if data is not None:
+                    fjob = LaneSlice(
+                        f.request_id,
+                        self._results[f.request_id],
+                        lane=lane,
+                        idx=data.idx,
+                        times=data.times,
+                        paths=data.paths,
+                        on_error=(
+                            self._sink_error_cb(f)
+                            if self.sink_errors == "request"
+                            else None
+                        ),
+                    )
+                elif retire and pipelined:
+                    fjob = LaneSlice(
+                        f.request_id, self._results[f.request_id],
+                        on_error=(
+                            self._sink_error_cb(f)
+                            if self.sink_errors == "request"
+                            else None
+                        ),
+                    )
+                if fjob is not None:
+                    slices.append(fjob)
+                    if retire and pipelined:
+                        fjob.close_after = True
+                        fjob.on_close = self._completion_cb(f)
 
         if not pipelined:
             # append BEFORE retiring: _finish closes sinks inline in
@@ -3033,6 +3551,11 @@ class SimServer:
                     t.finished_at - t.submitted_at,
                 )
             self._mark_streamed(t)
+            if self._result_cache is not None and not t.internal:
+                # the log is complete and closed — hand it to the
+                # scheduler's next cache sweep (list.append is atomic;
+                # the sweep runs on the scheduler thread)
+                self._cache_pending.append(t)
             ev = self._stream_done.get(t.request_id)
             if ev is not None:
                 ev.set()
@@ -3084,12 +3607,29 @@ class SimServer:
                     w.error = t.error or f"prefix run {status}"
                     self._finish(w, FAILED)
                     self._metrics.inc("failed")
+        if t.fingerprint is not None \
+                and self._dedup_leaders.get(t.fingerprint) is t:
+            # a terminal leader must stop accepting attachments
+            del self._dedup_leaders[t.fingerprint]
+        if t.leader is not None:
+            # a follower retiring on its own (sink failure, shutdown)
+            # must leave its leader's group, or the leader's terminal
+            # propagation would re-finish it over this status
+            group = self._dedup_groups.get(t.leader)
+            if group is not None and t in group:
+                group.remove(t)
+        followers = self._dedup_groups.pop(t.request_id, None)
+        if followers:
+            self._resolve_group(t, followers, status)
         sink = self._results.get(t.request_id)
         pipelined_done = self._streamer is not None and status == DONE
         if sink is not None and not t.sink_closed:
             if self._streamer is None:
                 sink.close()
                 self._mark_streamed(t)
+                if status == DONE and self._result_cache is not None \
+                        and not t.internal:
+                    self._cache_pending.append(t)
             elif status != DONE:
                 # cancel/timeout of a RUNNING request: its last window
                 # may still be queued on the streamer — close in FIFO
@@ -3235,6 +3775,13 @@ class SimServer:
         request = self._effective_request(rid, recs)
         if rec.get("event") == SUBMIT:
             ticket = self._build_ticket(request, rid)
+            if self.dedup == "on" and self._try_coalesce(ticket):
+                # the group re-forms deterministically from replayed
+                # SUBMITs in submission order (the leader re-queued
+                # first and re-registered) — no duplicate WAL events
+                self.tickets[rid] = ticket
+                self._metrics.inc("submitted")
+                return
         else:
             # a continuation: re-arm only the extension, seeded from
             # the parent's spilled snapshot (present by WAL ordering:
@@ -3323,6 +3870,24 @@ class SimServer:
             self._pending_prefix.clear()
         except BaseException as e:
             first_error = e
+        # coalesced followers still riding an unfinished leader fail
+        # the same way: their shared lane will never retire now, and a
+        # follower parked QUEUED forever would read as still pending
+        try:
+            for leader_rid, followers in list(
+                self._dedup_groups.items()
+            ):
+                for f in followers:
+                    f.error = (
+                        f"server closed while coalesced onto "
+                        f"in-flight leader {leader_rid}"
+                    )
+                    f.leader = None  # detach before _finish re-walks
+                    self._finish(f, FAILED)
+                    self._metrics.inc("failed")
+            self._dedup_groups.clear()
+        except BaseException as e:
+            first_error = first_error or e
         if self._streamer is not None:
             try:
                 self._streamer.close()
@@ -3333,6 +3898,12 @@ class SimServer:
                 sink.close()
             except BaseException as e:
                 first_error = first_error or e
+        try:
+            # results completed by the streamer's final drain still
+            # file into the durable cache before the handle is lost
+            self._sweep_result_cache()
+        except BaseException as e:
+            first_error = first_error or e
         # drop every ticket's snapshot pin (held states, unscattered
         # carries) — every acquire pairs with a release even on the
         # close path, so a refcount imbalance surfaces HERE as an

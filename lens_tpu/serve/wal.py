@@ -62,6 +62,13 @@ STREAMED = "streamed"    # {rid} records durably on disk
 HOLD = "hold"            # {rid, key, name} held snapshot spilled
 RELEASE = "release"      # {rid} hold dropped
 QUARANTINE = "device_quarantined"  # {shard, reason} observability only
+#: {rid, leader}: the request coalesced onto an identical in-flight
+#: leader's lane (round-18 suffix dedup). Observability/audit only —
+#: recovery does NOT replay attachments from it: re-running the
+#: recovered SUBMITs through the same deterministic coalescing logic
+#: re-forms (or re-runs) each group from the requests themselves, so
+#: the event can never disagree with what recovery actually does.
+COALESCE = "coalesced"
 
 
 def classify_events(events: Sequence[Mapping[str, Any]]):

@@ -47,6 +47,8 @@ tests/test_snapshots.py
 tests/test_tiers.py
 tests/test_faults.py
 tests/test_recovery.py
+tests/test_results.py
+tests/test_dedup.py
 tests/test_frontdoor.py
 tests/test_cluster.py
 tests/test_sweep.py
@@ -70,7 +72,7 @@ BATCHES=(
   "tests/test_adi.py"
   "tests/test_parallel.py tests/test_distributed.py"
   "tests/test_multispecies.py tests/test_ensemble.py"
-  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_tiers.py tests/test_faults.py tests/test_recovery.py tests/test_frontdoor.py tests/test_metrics.py tests/test_obs.py"
+  "tests/test_serve.py tests/test_streamer.py tests/test_snapshots.py tests/test_tiers.py tests/test_faults.py tests/test_recovery.py tests/test_results.py tests/test_dedup.py tests/test_frontdoor.py tests/test_metrics.py tests/test_obs.py"
   "tests/test_sweep.py tests/test_cli.py"
   "tests/test_cluster.py"
   "tests/test_experiment.py"

@@ -1,0 +1,323 @@
+"""In-flight suffix dedup (round 18): identical concurrent requests
+cost one lane.
+
+A follower never queues and never owns a lane — it rides its leader's
+per-lane stream with its OWN sink, so its ``.lens`` log is byte-equal
+to the log its solo run would write (the determinism contract makes
+the shared window bytes its window bytes). Pinned here:
+
+- **Bytes**: follower log == its own solo run's log, bitwise — deterministic
+  AND stochastic composites, pipeline on, through SSE.
+- **Lifecycle**: follower cancel detaches without touching the leader;
+  leader FAILED poisons followers with the cause; leader
+  CANCELLED/TIMEOUT detaches followers back to independent requests.
+- **Migration**: coalesced tickets refuse withdrawal (both ends).
+- **Recovery**: replayed SUBMITs re-coalesce deterministically.
+- **Off switch**: both knobs off leaves the round-17 submit path
+  untouched (no fingerprint hashing, no results state).
+"""
+
+import json
+import os
+
+import pytest
+
+from lens_tpu.serve import (
+    CANCELLED,
+    DONE,
+    FAILED,
+    ScenarioRequest,
+    SimServer,
+)
+from lens_tpu.serve.faults import FaultPlan
+from lens_tpu.serve.metrics import request_timing_row
+
+BASE = {"composite": "toggle_colony", "seed": 7, "horizon": 32.0}
+
+
+def _server(tmp_path, tag, **kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    kw.setdefault("sink", "log")
+    kw.setdefault("out_dir", str(tmp_path / f"{tag}_out"))
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+def _lens(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _solo_reference(tmp_path, reqs, tag="ref", composite=None, **kw):
+    """Each request served with dedup OFF: what every rid's own solo
+    run writes (solo == co-batched is already pinned upstream)."""
+    kw.setdefault("out_dir", str(tmp_path / f"{tag}_out"))
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    kw.setdefault("sink", "log")
+    srv = SimServer.single_bucket(
+        composite or "toggle_colony", **kw
+    )
+    rids = [srv.submit(dict(r)) for r in reqs]
+    srv.run_until_idle(max_ticks=500)
+    out = {r: _lens(srv.status(r)["result_path"]) for r in rids}
+    srv.close()
+    return out
+
+
+class TestCoalesce:
+    def test_followers_ride_one_lane_bitwise(self, tmp_path):
+        ref = _solo_reference(tmp_path, [BASE] * 3)
+        srv = _server(tmp_path, "dd", dedup="on")
+        rids = [srv.submit(dict(BASE)) for _ in range(3)]
+        srv.run_until_idle(max_ticks=300)
+        m = srv.metrics()["counters"]
+        assert m["suffix_coalesced"] == 2
+        assert m["admitted"] == 1  # one lane for the whole group
+        assert m["device_seconds_saved"] > 0
+        for rid in rids:
+            st = srv.status(rid)
+            assert st["status"] == DONE
+            assert st["steps_done"] == st["horizon_steps"]
+            assert _lens(st["result_path"]) == ref[rid], rid
+        # satellite: a follower's timing row is complete — it came
+        # alive at its leader's admission and streamed to the end
+        row = request_timing_row(srv.tickets[rids[1]], 0.0)
+        assert row["admitted"] is not None
+        assert row["first_window"] is not None
+        assert row["last_streamed"] is not None
+        srv.close()
+
+    def test_stochastic_composite_pipelined(self, tmp_path):
+        """hybrid_cell is stochastic: byte equality is meaningful, not
+        an ODE's inevitability."""
+        req = {"composite": "hybrid_cell", "seed": 3, "horizon": 8.0}
+        ref = _solo_reference(
+            tmp_path, [req] * 2, composite="hybrid_cell", window=4,
+        )
+        srv = SimServer.single_bucket(
+            "hybrid_cell", lanes=2, window=4, capacity=16,
+            sink="log", out_dir=str(tmp_path / "sto_out"),
+            dedup="on", pipeline="on",
+        )
+        a = srv.submit(dict(req))
+        b = srv.submit(dict(req))
+        srv.run_until_idle(max_ticks=300)
+        assert srv.metrics()["counters"]["suffix_coalesced"] == 1
+        assert _lens(srv.status(a)["result_path"]) == ref[a]
+        assert _lens(srv.status(b)["result_path"]) == ref[b]
+        srv.close()
+
+    def test_distinct_requests_never_coalesce(self, tmp_path):
+        srv = _server(tmp_path, "dis", dedup="on")
+        srv.submit(dict(BASE))
+        srv.submit({**BASE, "seed": 8})
+        srv.submit({**BASE, "hold_state": True})  # holds run alone
+        srv.run_until_idle(max_ticks=300)
+        m = srv.metrics()["counters"]
+        assert m["suffix_coalesced"] == 0 and m["admitted"] == 3
+        srv.close()
+
+
+class TestLifecycle:
+    def test_follower_cancel_leaves_leader_green(self, tmp_path):
+        ref = _solo_reference(tmp_path, [BASE])
+        srv = _server(tmp_path, "fc", dedup="on")
+        leader = srv.submit(dict(BASE))
+        follower = srv.submit(dict(BASE))
+        assert srv.cancel(follower) in (CANCELLED, "queued")
+        srv.run_until_idle(max_ticks=300)
+        assert srv.status(follower)["status"] == CANCELLED
+        st = srv.status(leader)
+        assert st["status"] == DONE
+        assert _lens(st["result_path"]) == ref[leader]
+        srv.close()
+
+    def test_leader_cancel_detaches_follower_to_solo(self, tmp_path):
+        ref = _solo_reference(tmp_path, [BASE] * 2)
+        srv = _server(tmp_path, "lc", dedup="on")
+        leader = srv.submit(dict(BASE))
+        follower = srv.submit(dict(BASE))
+        srv.cancel(leader)
+        srv.run_until_idle(max_ticks=300)
+        assert srv.status(leader)["status"] == CANCELLED
+        st = srv.status(follower)
+        assert st["status"] == DONE
+        # the detached follower re-ran independently; its log is still
+        # its solo run's, bitwise
+        assert _lens(st["result_path"]) == ref["req-000001"]
+        srv.close()
+
+    def test_leader_failure_poisons_followers_with_cause(self, tmp_path):
+        plan = FaultPlan([{"kind": "io_error", "request": "req-000000"}])
+        srv = _server(
+            tmp_path, "lf", dedup="on", sink_errors="request",
+            faults=plan, lanes=1, window=4,
+        )
+        leader = srv.submit(dict(BASE))
+        follower = srv.submit(dict(BASE))
+        srv.run_until_idle(max_ticks=300)
+        assert srv.status(leader)["status"] == FAILED
+        st = srv.status(follower)
+        assert st["status"] == FAILED
+        assert leader in st["error"]  # the cause names the leader
+        srv.close()
+
+    def test_coalesced_tickets_refuse_withdrawal(self, tmp_path):
+        srv = _server(tmp_path, "wd", dedup="on")
+        leader = srv.submit(dict(BASE))
+        srv.submit(dict(BASE))
+        with pytest.raises(ValueError, match="followers do not migrate"):
+            srv.withdraw("req-000001")
+        with pytest.raises(ValueError, match="coalesced group"):
+            srv.withdraw(leader)  # nor leaders with followers
+        srv.close()
+
+
+class TestRecovery:
+    def test_replayed_submits_recoalesce(self, tmp_path):
+        ref = _solo_reference(tmp_path, [BASE] * 2)
+        out, wal = tmp_path / "rc_out", tmp_path / "rc_wal"
+        srv = _server(
+            tmp_path, "rc", dedup="on", out_dir=str(out),
+            recover_dir=str(wal),
+        )
+        srv.submit(dict(BASE))
+        srv.submit(dict(BASE))
+        del srv  # vanish with both still queued (coalesced)
+        srv2 = _server(
+            tmp_path, "rc", dedup="on", out_dir=str(out),
+            recover_dir=str(wal),
+        )
+        m = srv2.metrics()["counters"]
+        assert m["recovered"] == 2
+        assert m["suffix_coalesced"] == 1  # re-coalesced on replay
+        srv2.run_until_idle(max_ticks=300)
+        for rid, data in ref.items():
+            st = srv2.status(rid)
+            assert st["status"] == DONE
+            assert _lens(st["result_path"]) == data, rid
+        srv2.close()
+
+
+class TestKnobsOff:
+    def test_default_server_skips_all_cdn_state(self, tmp_path):
+        ref = _solo_reference(tmp_path, [BASE])
+        srv = _server(tmp_path, "off")
+        rid = srv.submit(dict(BASE))
+        # the round-17 submit path exactly: no content address hashed,
+        # no dedup bookkeeping, no results dir, no results gauges
+        assert srv.tickets[rid].fingerprint is None
+        srv.run_until_idle(max_ticks=300)
+        assert _lens(srv.status(rid)["result_path"]) == ref[rid]
+        m = srv.metrics()
+        assert m["counters"]["suffix_coalesced"] == 0
+        assert m["counters"]["result_hits"] == 0
+        assert m["result_entries"] == 0
+        assert "results" not in srv.status(rid)["server"]
+        srv.close()
+
+
+class TestClusterCdn:
+    def test_router_answers_repeats_and_workers_coalesce(self, tmp_path):
+        from lens_tpu.cluster import ClusterServer
+        from lens_tpu.emit.log import iter_frames
+
+        ref = _solo_reference(tmp_path, [BASE])
+        body_ref = list(iter_frames(
+            str(tmp_path / "ref_out" / "req-000000.lens")
+        ))[1:]
+        cs = ClusterServer(
+            {"toggle_colony": {"lanes": 2, "window": 8,
+                               "capacity": 16}},
+            hosts=2, cluster_dir=str(tmp_path / "cluster"),
+            local=True, result_cache_mb=64, dedup="on",
+        )
+        try:
+            r1 = cs.submit(dict(BASE))
+            cs.run_until_idle()
+            assert list(iter_frames(cs.result(r1)))[1:] == body_ref
+            # the repeat is answered AT THE ROUTER: terminal with no
+            # host placement, served from the shared results dir the
+            # worker published into at completion
+            r2 = cs.submit(dict(BASE))
+            t2 = cs.tickets[r2]
+            assert t2.status == DONE and t2.host is None
+            assert list(iter_frames(cs.result(r2)))[1:] == body_ref
+            m = cs.metrics()
+            assert m["counters"]["router_result_hits"] == 1
+            assert m["results"]["entries"] >= 1
+        finally:
+            cs.close()
+
+
+class TestSseBytes:
+    """The front door streams a cache hit / a follower byte-identically
+    to the underlying log (SSE payload == file, the round-15 pin,
+    extended to tickets that never touched a lane)."""
+
+    def test_sse_stream_of_cached_hit_matches_log(self, tmp_path):
+        import http.client
+
+        from lens_tpu.frontdoor import FrontDoor, decode_record_events
+
+        out = str(tmp_path / "door_out")
+        server = SimServer.single_bucket(
+            "minimal_ode", capacity=4, lanes=2, window=4,
+            sink="log", out_dir=out, dedup="on",
+            result_cache_mb=32,
+            recover_dir=str(tmp_path / "door_wal"),
+        )
+        fd = FrontDoor(server, own_server=True)
+        fd.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", fd.port, timeout=60
+            )
+
+            def call(method, path, body=None):
+                conn.request(
+                    method, path,
+                    body=json.dumps(body) if body is not None
+                    else None,
+                )
+                r = conn.getresponse()
+                raw = r.read()
+                return r.status, raw
+
+            body = {"seed": 11, "horizon": 8.0}
+            code, sub = call("POST", "/v1/requests", body)
+            assert code == 202
+            rid1 = json.loads(sub)["rid"]
+            import time as _time
+
+            def wait_done(rid):
+                for _ in range(600):
+                    code, raw = call("GET", f"/v1/requests/{rid}")
+                    st = json.loads(raw)
+                    if st["status"] == "done" and \
+                            st["timing"]["last_streamed"] is not None:
+                        return st
+                    _time.sleep(0.02)
+                raise AssertionError(f"{rid} never finished: {st}")
+
+            wait_done(rid1)  # fully streamed: the result is filable
+            # the repeat is a durable cache hit: served whole at the
+            # admission thread's submit, and its SSE stream is the
+            # spliced log, bitwise
+            code, sub = call("POST", "/v1/requests", body)
+            assert code == 202
+            rid2 = json.loads(sub)["rid"]
+            st = wait_done(rid2)
+            assert st["timing"]["admitted"] is None  # lane-less ticket
+            code, raw = call("GET", f"/v1/requests/{rid2}/stream")
+            assert code == 200
+            sse_bytes, end = decode_record_events(raw)
+            assert end["status"] == "done" and end["error"] is None
+            with open(os.path.join(out, f"{rid2}.lens"), "rb") as f:
+                assert sse_bytes == f.read()
+            conn.close()
+        finally:
+            fd.close()

@@ -107,7 +107,8 @@ class TestFaultPlan:
         assert KILL_SEAMS == (
             "submit.walled", "resubmit.walled", "admitted",
             "window.dispatched", "hold.spilled", "retired.walled",
-            "streamed.walled",
+            "streamed.walled", "result.tmp_written", "result.renamed",
+            "result.cached",
         )
 
 

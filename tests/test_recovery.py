@@ -330,8 +330,11 @@ class TestKillPointsExhaustive:
     @pytest.mark.parametrize(
         "seam",
         # resubmit.walled needs a resubmit-driving client (covered
-        # in-process above); the CLI list exercises the rest
-        [s for s in KILL_SEAMS if s != "resubmit.walled"],
+        # in-process above); result.* seams fire only with the result
+        # cache armed (tests/test_results.py runs that drill); the CLI
+        # list exercises the rest
+        [s for s in KILL_SEAMS
+         if s != "resubmit.walled" and not s.startswith("result.")],
     )
     def test_kill_everywhere_recovers_bitwise(
         self, tmp_path, repo_root, seam

@@ -1,0 +1,465 @@
+"""The durable result cache (serve/results.py): a CDN for simulations.
+
+Round 18's determinism dividend: a request's ``.lens`` log is a pure
+function of its bytes-relevant coordinates, so a completed log filed
+under the request's content address serves every later identical
+submission whole — zero device windows, zero lanes. Pinned here:
+
+- **Addressing**: spelling-level aliases (override dict order, folded
+  emit defaults, int-vs-float horizon) share one fingerprint;
+  scheduling-only keys (deadline, tenant, priority) never touch it;
+  bytes-relevant differences always split it.
+- **Disk protocol**: tmp+rename publication, sidecar-attested scans,
+  torn entries ignored, peer refresh, LRU GC — the tiers.py idioms.
+- **Replay**: a hit's spliced log is byte-equal to the log the hitting
+  request's own cold run writes (header re-minted, body verbatim).
+- **Crash**: SIGKILL between the payload write and the sidecar leaves
+  no entry that could serve; recovery re-runs and re-files bitwise.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lens_tpu.emit.log import (
+    encode_record,
+    frame,
+    iter_frames,
+    make_header,
+)
+from lens_tpu.serve import DONE, QUEUED, RUNNING, ScenarioRequest, SimServer
+from lens_tpu.serve.metrics import request_timing_row
+from lens_tpu.serve.results import (
+    RESULT_META,
+    ResultCache,
+    log_config,
+    request_fingerprint,
+)
+from lens_tpu.serve.server import _request_to_json
+
+
+def _fp(mapping):
+    req = ScenarioRequest.from_mapping(mapping)
+    return request_fingerprint(_request_to_json(req))
+
+
+BASE = {"composite": "toggle_colony", "seed": 7, "horizon": 32.0}
+
+
+class TestFingerprint:
+    """One meaning, one content address."""
+
+    def test_alias_spellings_share_fingerprint(self):
+        ref = _fp({
+            **BASE,
+            "overrides": {"global": {"volume": 1.1},
+                          "cell": {"protein": 2.0}},
+        })
+        aliases = [
+            # int horizon spells the same float
+            {**BASE, "horizon": 32,
+             "overrides": {"global": {"volume": 1.1},
+                           "cell": {"protein": 2.0}}},
+            # override tree built in the other insertion order
+            {**BASE,
+             "overrides": {"cell": {"protein": 2.0},
+                           "global": {"volume": 1.1}}},
+            # a fully-default emit block folds away
+            {**BASE, "emit": {"every": 1},
+             "overrides": {"global": {"volume": 1.1},
+                           "cell": {"protein": 2.0}}},
+            {**BASE, "emit": {"every": 1, "paths": []},
+             "overrides": {"global": {"volume": 1.1},
+                           "cell": {"protein": 2.0}}},
+        ]
+        for alias in aliases:
+            assert _fp(alias) == ref, alias
+
+    def test_scheduling_keys_never_touch_the_address(self):
+        ref = _fp(BASE)
+        for extra in (
+            {"deadline": 5.0},
+            {"tenant": "acme"},
+            {"priority": "interactive"},
+        ):
+            assert _fp({**BASE, **extra}) == ref, extra
+
+    def test_bytes_relevant_differences_split_the_address(self):
+        ref = _fp(BASE)
+        assert _fp({**BASE, "seed": 8}) != ref
+        assert _fp({**BASE, "horizon": 16.0}) != ref
+        assert _fp({**BASE, "emit": {"every": 2}}) != ref
+        assert _fp({**BASE, "n_agents": 2}) != ref
+        # leaf dtype is deliberately NOT folded: it can change the
+        # simulated bits, so int-vs-float leaves stay distinct keys
+        assert _fp({**BASE, "overrides": {"g": {"v": 1}}}) \
+            != _fp({**BASE, "overrides": {"g": {"v": 1.0}}})
+
+
+def _donor(tmp_path, rid="req-000042", nrec=3):
+    """A synthetic .lens log: header + ``nrec`` rid-free records."""
+    cfg = {"composite": "toggle_colony", "seed": 1}
+    path = str(tmp_path / f"{rid}.lens")
+    with open(path, "wb") as f:
+        f.write(frame(encode_record(make_header(rid, cfg))))
+        for i in range(nrec):
+            f.write(frame(encode_record({"x": np.arange(4) + i})))
+    return path, cfg
+
+
+class TestDiskProtocol:
+    """tmp+rename publication, sidecar-attested scans, peer refresh."""
+
+    def test_put_publishes_payload_then_sidecar(self, tmp_path):
+        src, _ = _donor(tmp_path)
+        cache = ResultCache(str(tmp_path / "res"))
+        assert cache.put("f" * 64, src, request={"composite": "t"})
+        assert len(cache) == 1
+        assert cache.total_bytes() == os.path.getsize(src)
+        names = sorted(os.listdir(cache.dir))
+        assert not [n for n in names if ".tmp" in n]
+        assert any(n.endswith(".lens") for n in names)
+        assert any(n.endswith(".meta.json") for n in names)
+        # idempotent per content address
+        assert not cache.put("f" * 64, src)
+        assert cache.stored == 1
+
+    def test_scan_adopts_complete_entries_only(self, tmp_path):
+        src, _ = _donor(tmp_path)
+        d = str(tmp_path / "res")
+        cache = ResultCache(d)
+        cache.put("a" * 64, src)
+        # torn states a crash can leave: payload without sidecar
+        # (kill after rename), sidecar without payload (kill
+        # mid-evict), and a bare tmp file (kill before rename)
+        with open(os.path.join(d, "res_" + "b" * 32 + ".lens"),
+                  "wb") as f:
+            f.write(b"orphan payload")
+        with open(os.path.join(
+            d, "res_" + "c" * 32 + ".lens.meta.json"
+        ), "w") as f:
+            json.dump({"fingerprint": "c" * 64, "nbytes": 7}, f)
+        with open(os.path.join(
+            d, "res_" + "d" * 32 + ".lens.tmp-12345"
+        ), "wb") as f:
+            f.write(b"half a payload")
+        fresh = ResultCache(d)
+        assert len(fresh) == 1 and ("a" * 64) in fresh
+
+    def test_refresh_adopts_a_peer_published_entry(self, tmp_path):
+        src, _ = _donor(tmp_path)
+        d = str(tmp_path / "res")
+        mine = ResultCache(d)
+        peer = ResultCache(d)
+        peer.put("a" * 64, src)
+        assert ("a" * 64) not in mine  # scanned before the peer wrote
+        assert mine.refresh("a" * 64)
+        assert ("a" * 64) in mine
+        assert not mine.refresh("f" * 64)  # honest miss stays a miss
+
+    def test_serve_splices_header_keeps_body_verbatim(self, tmp_path):
+        src, cfg = _donor(tmp_path, nrec=4)
+        cache = ResultCache(str(tmp_path / "res"))
+        fp = "a" * 64
+        cache.put(fp, src)
+        dst = str(tmp_path / "hit" / "req-000077.lens")
+        assert cache.serve(fp, "req-000077", cfg, dst)
+        got = list(iter_frames(dst))
+        ref = list(iter_frames(src))
+        assert got[1:] == ref[1:]  # every body frame byte-equal
+        from lens_tpu.emit.log import decode_record
+        header = decode_record(got[0])["__header__"]
+        assert str(np.asarray(header["experiment_id"])) == "req-000077"
+        assert cache.hits == 1
+
+    def test_vanished_donor_degrades_to_a_forgotten_miss(self, tmp_path):
+        src, cfg = _donor(tmp_path)
+        cache = ResultCache(str(tmp_path / "res"))
+        fp = "a" * 64
+        cache.put(fp, src)
+        os.remove(cache._path(fp))  # a peer's eviction won the race
+        dst = str(tmp_path / "req-000001.lens")
+        assert not cache.serve(fp, "req-000001", cfg, dst)
+        assert fp not in cache and cache.misses == 1
+        assert not os.path.exists(dst)
+
+    def test_gc_evicts_lru_first(self, tmp_path):
+        src, cfg = _donor(tmp_path)
+        size = os.path.getsize(src)
+        cache = ResultCache(str(tmp_path / "res"))
+        for c in "abc":
+            cache.put(c * 64, src)
+        # touch "a": "b" becomes the LRU victim
+        assert cache.serve(
+            "a" * 64, "req-000001", cfg, str(tmp_path / "t.lens")
+        )
+        evicted = cache.gc(2 * size)
+        assert evicted == ["b" * 64]
+        assert cache.evictions == 1 and len(cache) == 2
+        assert not glob.glob(os.path.join(cache.dir, "*b" * 16 + "*"))
+
+    def test_budget_evicts_at_put(self, tmp_path):
+        src, _ = _donor(tmp_path)
+        size = os.path.getsize(src)
+        cache = ResultCache(
+            str(tmp_path / "res"), budget_bytes=2 * size + size // 2
+        )
+        for c in "abc":
+            cache.put(c * 64, src)
+        assert len(cache) == 2 and ("a" * 64) not in cache
+        with pytest.raises(ValueError, match="budget_bytes"):
+            ResultCache(str(tmp_path / "res2"), budget_bytes=0)
+
+    def test_bucket_fingerprint_guard(self, tmp_path):
+        d = str(tmp_path / "res")
+        ResultCache(d, fingerprint="aaaa")
+        ResultCache(d, fingerprint="aaaa")  # same config: fine
+        with pytest.raises(ValueError, match="fingerprint"):
+            ResultCache(d, fingerprint="bbbb")
+        ResultCache(d, fingerprint=None)  # inspection mode skips
+        assert os.path.exists(os.path.join(d, RESULT_META))
+
+
+def _server(tmp_path, tag, **kw):
+    kw.setdefault("lanes", 2)
+    kw.setdefault("window", 8)
+    kw.setdefault("capacity", 16)
+    kw.setdefault("sink", "log")
+    kw.setdefault("out_dir", str(tmp_path / f"{tag}_out"))
+    return SimServer.single_bucket("toggle_colony", **kw)
+
+
+def _lens(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class TestServerCacheHit:
+    """submit short-circuits admission whole on a durable hit."""
+
+    def _reference(self, tmp_path):
+        """The same request served twice COLD (no cache): what each
+        rid's own solo run writes."""
+        ref = _server(tmp_path, "ref")
+        a = ref.submit(dict(BASE))
+        b = ref.submit(dict(BASE))
+        ref.run_until_idle(max_ticks=300)
+        out = {r: _lens(ref.status(r)["result_path"]) for r in (a, b)}
+        ref.close()
+        return out
+
+    def test_hit_is_terminal_windowless_and_bitwise(self, tmp_path):
+        ref = self._reference(tmp_path)
+        srv = _server(
+            tmp_path, "cdn", result_cache_mb=64,
+            recover_dir=str(tmp_path / "cdn_wal"),
+        )
+        r1 = srv.submit(dict(BASE))
+        srv.run_until_idle(max_ticks=300)
+        cold_windows = srv.metrics()["counters"]["windows"]
+        r2 = srv.submit(dict(BASE))
+        # terminal at submit: no tick ran, no lane, no device window
+        st = srv.status(r2)
+        assert st["status"] == DONE
+        assert st["steps_done"] == st["horizon_steps"]
+        m = srv.metrics()
+        assert m["counters"]["windows"] == cold_windows
+        assert m["counters"]["result_hits"] == 1
+        assert m["counters"]["device_seconds_saved"] > 0
+        assert m["result_entries"] == 1 and m["result_bytes"] > 0
+        # the spliced log is byte-equal to r2's own cold solo run
+        assert _lens(st["result_path"]) == ref[r2]
+        assert _lens(srv.status(r1)["result_path"]) == ref[r1]
+        # satellite: the timing table stays complete for a ticket
+        # that never touched a lane (admitted/first_window honestly
+        # None, no AttributeError)
+        row = request_timing_row(srv.tickets[r2], 0.0)
+        assert row["admitted"] is None and row["first_window"] is None
+        assert row["last_streamed"] is not None
+        srv.close()
+
+    def test_restart_serves_warm_with_zero_windows(self, tmp_path):
+        wal = str(tmp_path / "wal")
+        srv = _server(
+            tmp_path, "warm", result_cache_mb=64, recover_dir=wal,
+        )
+        r1 = srv.submit(dict(BASE))
+        srv.run_until_idle(max_ticks=300)
+        cold_path = srv.status(r1)["result_path"]
+        srv.close()
+        srv2 = _server(
+            tmp_path, "warm", result_cache_mb=64, recover_dir=wal,
+        )
+        r = srv2.submit(dict(BASE))
+        assert srv2.status(r)["status"] == DONE
+        m = srv2.metrics()["counters"]
+        assert m["windows"] == 0 and m["result_hits"] == 1
+        # body equality, frame by frame (headers differ only in rid)
+        got = list(iter_frames(srv2.status(r)["result_path"]))
+        ref = list(iter_frames(cold_path))
+        assert got[1:] == ref[1:]
+        srv2.close()
+
+    def test_hold_state_requests_bypass_the_cache(self, tmp_path):
+        srv = _server(
+            tmp_path, "hold", result_cache_mb=64,
+            recover_dir=str(tmp_path / "hold_wal"),
+        )
+        srv.submit(dict(BASE))
+        srv.run_until_idle(max_ticks=300)
+        r = srv.submit({**BASE, "hold_state": True})
+        # a hold must run its own lane: its product includes a pinned
+        # device snapshot no cached log carries
+        assert srv.status(r)["status"] in (QUEUED, RUNNING)
+        srv.run_until_idle(max_ticks=300)
+        assert srv.status(r)["status"] == DONE
+        assert srv.metrics()["counters"]["result_hits"] == 0
+        srv.close()
+
+
+class TestCacheCLI:
+    """``python -m lens_tpu cache <dir>``: inspect + --max-mb GC."""
+
+    def _dir_with_entries(self, tmp_path):
+        src, _ = _donor(tmp_path)
+        cache = ResultCache(str(tmp_path / "res"))
+        cache.put("a" * 64, src,
+                  request={"composite": "toggle_colony",
+                           "horizon": 32.0})
+        cache.put("b" * 64, src)
+        return cache.dir, os.path.getsize(src)
+
+    def _cli(self, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "lens_tpu", "cache", *args],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+
+    def test_table_json_and_gc(self, tmp_path):
+        d, size = self._dir_with_entries(tmp_path)
+        proc = self._cli(d)
+        assert proc.returncode == 0, proc.stderr
+        assert "a" * 16 in proc.stdout
+        assert "toggle_colony" in proc.stdout
+        proc = self._cli(d, "--json")
+        assert proc.returncode == 0, proc.stderr
+        rows = json.loads(proc.stdout)["entries"]
+        assert {r["fingerprint"] for r in rows} == \
+            {"a" * 64, "b" * 64}
+        # GC down to one entry's worth of bytes
+        proc = self._cli(d, "--max-mb", str(1.5 * size / 2**20))
+        assert proc.returncode == 0, proc.stderr
+        assert len(ResultCache(d)) == 1
+
+
+_CLI_REQS = [
+    {"seed": 1, "horizon": 16.0},
+    {"seed": 2, "horizon": 16.0},
+]
+
+
+def _run_serve(args, cwd, expect_kill=False, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "lens_tpu", "serve", *args],
+        cwd=cwd, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        )
+    else:
+        assert proc.returncode == 0, (
+            f"rc={proc.returncode}\nstdout: {proc.stdout}\n"
+            f"stderr: {proc.stderr}"
+        )
+    return proc
+
+
+def _result_kill_drill(tmp_path, repo_root, seam):
+    """SIGKILL a real serve process at a result-cache publication
+    seam, recover over the same dirs, and require (a) the final logs
+    bitwise equal to an uninterrupted run's and (b) every entry the
+    cache dir holds is complete and servable — a kill can leave
+    orphans the scan ignores, never a torn entry that could serve."""
+    reqs = tmp_path / "reqs.json"
+    reqs.write_text(json.dumps(_CLI_REQS))
+    base = [
+        "--composite", "toggle_colony", "--capacity", "8",
+        "--lanes", "2", "--window", "4", "--requests", str(reqs),
+        "--result-cache-mb", "64",
+    ]
+    tag = seam.replace(".", "_")
+    ref_out = tmp_path / f"ref_{tag}"
+    _run_serve(
+        base + ["--out-dir", str(ref_out),
+                "--recover-dir", str(tmp_path / f"ref_wal_{tag}")],
+        repo_root,
+    )
+    out = tmp_path / f"out_{tag}"
+    wal = tmp_path / f"wal_{tag}"
+    faults = tmp_path / f"faults_{tag}.json"
+    faults.write_text(json.dumps([{"kind": "kill", "at": seam}]))
+    _run_serve(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal),
+                "--faults", str(faults)],
+        repo_root, expect_kill=True,
+    )
+    _run_serve(
+        base + ["--out-dir", str(out), "--recover-dir", str(wal)],
+        repo_root,
+    )
+    ref = {
+        os.path.basename(p): _lens(p)
+        for p in glob.glob(os.path.join(str(ref_out), "*.lens"))
+    }
+    assert ref
+    for name, data in ref.items():
+        assert _lens(os.path.join(str(out), name)) == data, name
+    cache = ResultCache(str(wal / "results"))
+    for row in cache.entries():
+        dst = str(tmp_path / f"probe_{tag}_{row['name']}")
+        assert cache.serve(
+            row["fingerprint"], "req-999999",
+            {"composite": "toggle_colony"}, dst,
+        ), f"adopted entry {row['fingerprint']} is torn"
+
+
+@pytest.fixture(scope="module")
+def repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestResultKillDrill:
+    """The quick-tier representative: kill with the payload still at
+    its tmp name — the scan must adopt nothing torn."""
+
+    def test_kill_mid_publication_recovers_bitwise(
+        self, tmp_path, repo_root
+    ):
+        _result_kill_drill(tmp_path, repo_root, "result.tmp_written")
+
+
+@pytest.mark.slow
+class TestResultKillDrillExhaustive:
+    """Every result-publication seam (the recovery suite's chaos
+    discipline, extended to the round-18 protocol)."""
+
+    @pytest.mark.parametrize(
+        "seam", ["result.tmp_written", "result.renamed", "result.cached"]
+    )
+    def test_kill_everywhere_recovers_bitwise(
+        self, tmp_path, repo_root, seam
+    ):
+        _result_kill_drill(tmp_path, repo_root, seam)
